@@ -1,0 +1,25 @@
+"""Ruleset compiler: SecLang/regex → bit-parallel NFA tables for TPU.
+
+Pipeline (SURVEY.md §7 "Ruleset compiler"):
+
+    SecLang rules (CRS v3 shaped) ──seclang.py──▶ Rule objects
+    Rule regex ──regex_ast.py──▶ AST
+    AST ──factors.py──▶ mandatory factor groups (class sequences)
+    factors ──bitap.py──▶ packed shift-and tables (uint32 words)
+    everything ──ruleset.py──▶ CompiledRuleset artifact (save/load = the
+                               framework's "checkpoint": versioned, hot-swappable)
+
+The TPU kernel (ops/) evaluates the bitap prefilter exactly; full-PCRE
+semantics (backrefs, lookaround, anchors) are recovered by the CPU confirm
+stage (models/confirm.py) that runs only on prefilter hits — the hybrid
+design named in SURVEY.md §7 "hard parts #1".
+"""
+
+from ingress_plus_tpu.compiler.regex_ast import (  # noqa: F401
+    RegexUnsupported,
+    parse_regex,
+)
+from ingress_plus_tpu.compiler.ruleset import (  # noqa: F401
+    CompiledRuleset,
+    compile_ruleset,
+)

@@ -1,0 +1,178 @@
+"""Pack factor groups into bit-parallel shift-and (bitap) tables.
+
+The scan recurrence, evaluated per input byte on TPU (ops/scan.py):
+
+    S' = ((S << 1) | INIT) & B[byte]          # uint32 words, lane-parallel
+    M |= S' & FINAL                           # sticky match accumulator
+
+Key packing property: every factor occupies a *contiguous bit range inside a
+single 32-bit word*, so the left shift never needs to carry across words —
+the kernel is purely element-wise over (batch, words), which vectorizes
+perfectly on the TPU VPU and shards trivially along the word axis (tensor
+parallelism, SURVEY.md §2.4).
+
+Cross-factor shift spill is harmless by construction: the bit shifted out of
+factor A's last position lands on factor B's start bit, which is OR'd with
+INIT (always active, unanchored search) before the AND — so the spilled bit
+changes nothing.  This mirrors the classic multi-pattern Baeza-Yates–Gonnet
+construction (see PAPERS.md: Hyperscan-style shift-and literature).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ingress_plus_tpu.compiler.factors import ClassSeq
+
+WORD_BITS = 32
+
+
+@dataclass
+class BitapTables:
+    """Packed scan tables + factor metadata.
+
+    Arrays (all numpy, ready for device upload):
+      byte_table   (256, n_words) uint32 — B[byte]: positional class masks
+      init_mask    (n_words,)     uint32 — factor start bits
+      final_mask   (n_words,)     uint32 — factor end bits
+      factor_word  (n_factors,)   int32  — word index of each factor's final bit
+      factor_bit   (n_factors,)   int32  — bit index of each factor's final bit
+      factor_rule_indptr / factor_rule_ids — CSR map factor → rule indices
+                   (many rules can share one deduped factor)
+      rule_nfactors (n_rules,)    int32  — 0 ⇒ rule has no prefilter (always
+                   confirm); >0 ⇒ rule fires iff ≥1 of its factors fires
+    """
+
+    byte_table: np.ndarray
+    init_mask: np.ndarray
+    final_mask: np.ndarray
+    factor_word: np.ndarray
+    factor_bit: np.ndarray
+    factor_rule_indptr: np.ndarray
+    factor_rule_ids: np.ndarray
+    rule_nfactors: np.ndarray
+    factor_len: np.ndarray  # (n_factors,) int32 — for streaming halo width
+
+    @property
+    def n_words(self) -> int:
+        return self.byte_table.shape[1]
+
+    @property
+    def n_factors(self) -> int:
+        return self.factor_word.shape[0]
+
+    @property
+    def max_factor_len(self) -> int:
+        return int(self.factor_len.max()) if self.n_factors else 0
+
+
+def pack_factors(
+    rule_factors: Sequence[List[ClassSeq]],
+    n_rules: int | None = None,
+) -> BitapTables:
+    """Pack per-rule factor groups into shared tables.
+
+    rule_factors[r] is rule r's alternative list (possibly empty = no
+    prefilter).  Identical ClassSeqs across rules are deduplicated.
+    """
+    if n_rules is None:
+        n_rules = len(rule_factors)
+
+    # Dedup factors; remember which rules own each.
+    uniq: Dict[ClassSeq, List[int]] = {}
+    for r, group in enumerate(rule_factors):
+        for seq in group:
+            if not (1 <= len(seq) <= WORD_BITS):
+                raise ValueError("factor length %d out of range" % len(seq))
+            uniq.setdefault(seq, []).append(r)
+
+    seqs = sorted(uniq.keys(), key=len, reverse=True)  # first-fit decreasing
+
+    # Bin-pack into words: each factor gets len(seq) contiguous bits.
+    word_used: List[int] = []
+    placements: List[Tuple[int, int]] = []  # (word, offset) per seq
+    for seq in seqs:
+        L = len(seq)
+        for w, used in enumerate(word_used):
+            if used + L <= WORD_BITS:
+                placements.append((w, used))
+                word_used[w] = used + L
+                break
+        else:
+            placements.append((len(word_used), 0))
+            word_used.append(L)
+    n_words = max(1, len(word_used))
+
+    byte_table = np.zeros((256, n_words), dtype=np.uint32)
+    init_mask = np.zeros((n_words,), dtype=np.uint32)
+    final_mask = np.zeros((n_words,), dtype=np.uint32)
+    factor_word = np.zeros((len(seqs),), dtype=np.int32)
+    factor_bit = np.zeros((len(seqs),), dtype=np.int32)
+    factor_len = np.zeros((len(seqs),), dtype=np.int32)
+
+    indptr = [0]
+    rule_ids: List[int] = []
+    rule_nfactors = np.zeros((n_rules,), dtype=np.int32)
+
+    for f, (seq, (w, off)) in enumerate(zip(seqs, placements)):
+        L = len(seq)
+        init_mask[w] |= np.uint32(1 << off)
+        final_mask[w] |= np.uint32(1 << (off + L - 1))
+        factor_word[f] = w
+        factor_bit[f] = off + L - 1
+        factor_len[f] = L
+        for j, cls in enumerate(seq):
+            bit = np.uint32(1 << (off + j))
+            for b in cls:
+                byte_table[b, w] |= bit
+        owners = sorted(set(uniq[seq]))
+        rule_ids.extend(owners)
+        indptr.append(len(rule_ids))
+        for r in owners:
+            rule_nfactors[r] += 1
+
+    return BitapTables(
+        byte_table=byte_table,
+        init_mask=init_mask,
+        final_mask=final_mask,
+        factor_word=factor_word,
+        factor_bit=factor_bit,
+        factor_rule_indptr=np.asarray(indptr, dtype=np.int32),
+        factor_rule_ids=np.asarray(rule_ids, dtype=np.int32),
+        rule_nfactors=rule_nfactors,
+        factor_len=factor_len,
+    )
+
+
+def reference_scan(tables: BitapTables, data: bytes) -> np.ndarray:
+    """Pure-numpy oracle for the scan recurrence.  Returns the sticky match
+    mask M (n_words,) uint32 after scanning ``data``.  Used by tests to
+    validate both the packing and the TPU kernels."""
+    S = np.zeros((tables.n_words,), dtype=np.uint32)
+    M = np.zeros((tables.n_words,), dtype=np.uint32)
+    B = tables.byte_table
+    init = tables.init_mask
+    final = tables.final_mask
+    for byte in data:
+        S = ((S << np.uint32(1)) | init) & B[byte]
+        M |= S & final
+    return M
+
+
+def matches_to_factors(tables: BitapTables, M: np.ndarray) -> np.ndarray:
+    """Match mask → boolean (n_factors,) factor-hit vector."""
+    return ((M[tables.factor_word] >> tables.factor_bit.astype(np.uint32)) & 1).astype(bool)
+
+
+def factors_to_rules(tables: BitapTables, factor_hits: np.ndarray) -> np.ndarray:
+    """Factor hits → boolean (n_rules,) rule prefilter-hit vector."""
+    n_rules = tables.rule_nfactors.shape[0]
+    out = np.zeros((n_rules,), dtype=bool)
+    hit_idx = np.nonzero(factor_hits)[0]
+    for f in hit_idx:
+        lo, hi = tables.factor_rule_indptr[f], tables.factor_rule_indptr[f + 1]
+        out[tables.factor_rule_ids[lo:hi]] = True
+    return out

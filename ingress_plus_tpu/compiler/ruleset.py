@@ -1,0 +1,371 @@
+"""Rule → compiled-artifact pipeline.
+
+``compile_ruleset`` turns format-neutral ``Rule`` objects (from seclang.py /
+sigpack.py) into a ``CompiledRuleset``: packed bitap tables + per-rule
+metadata arrays + confirm descriptors.  The artifact serializes to disk
+(npz + json) — this is the framework's checkpoint analog (SURVEY.md §5
+"Checkpoint/resume": versioned compiled-NFA tables, atomically hot-swapped
+on device like the reference's proton.db sync-node flow).
+
+Scan-variant model: each request stream (uri/args/headers/body) is scanned
+in up to five normalization variants:
+
+    0 raw         — bytes as received
+    1 urldec      — urlDecodeUni + removeNulls
+    2 urldec_html — urldec + htmlEntityDecode
+    3 squash_raw  — raw with all SQUASH_BYTES deleted (whitespace \\ ' " ^)
+    4 squash_dec  — urldec_html with all SQUASH_BYTES deleted
+
+A rule is assigned the variant matching its transform chain, so factor
+matching stays *sound* (never misses) while the CPU confirm stage applies
+the rule's exact transforms.  Soundness of the squash variants: deletion
+transforms (compressWhitespace / removeWhitespace / cmdLine) let attackers
+interleave deletable bytes inside a payload (``w"get`` → ``wget``); both
+the scanned stream AND the rule's factors have the same SQUASH_BYTES
+deleted, so the factor fires iff the post-transform text contains it.
+Factor positions whose class is a subset of SQUASH_BYTES are dropped
+(neighbors become adjacent, exactly as in the stream); positions whose
+class only partially overlaps are split points (survival is ambiguous).
+
+``normalizePath`` rules get factors split at path separators: nginx-style
+path normalization only deletes chunks that contain a '/', so any
+slash-free factor fragment present in the normalized text is literally
+present in the raw stream.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ingress_plus_tpu.compiler import factors as F
+from ingress_plus_tpu.compiler.bitap import BitapTables, pack_factors
+from ingress_plus_tpu.compiler.regex_ast import RegexUnsupported, parse_regex
+from ingress_plus_tpu.compiler.seclang import (
+    CLASSES,
+    CLASS_INDEX,
+    Rule,
+    STREAMS,
+    STREAM_INDEX,
+)
+
+VARIANTS = ("raw", "urldec", "urldec_html", "squash_raw", "squash_dec")
+N_SV = len(STREAMS) * len(VARIANTS)  # stream-variant row space
+
+_DECODE_TRANSFORMS = {
+    "urlDecode", "urlDecodeUni", "jsDecode", "cssDecode", "hexDecode",
+    "base64Decode",
+}
+_HTML_TRANSFORMS = {"htmlEntityDecode"}
+_WS_COLLAPSE = {"compressWhitespace", "removeWhitespace", "cmdLine"}
+_PATH_TRANSFORMS = {"normalizePath", "normalisePath", "normalizePathWin"}
+_WS_BYTES = frozenset([0x20, 0x09, 0x0A, 0x0D, 0x0C, 0x0B])
+# Bytes deleted by the squash variants (stream side AND factor side).
+# Superset of what cmdLine deletes; whitespace covers compress/remove.
+SQUASH_BYTES = _WS_BYTES | frozenset([0x5C, 0x27, 0x22, 0x5E])  # \ ' " ^
+_PATH_SEP_BYTES = frozenset([0x2F, 0x5C])  # / and \\
+
+SEVERITY_SCORE = {
+    "CRITICAL": 5, "ERROR": 4, "WARNING": 3, "NOTICE": 2, "INFO": 1, "DEBUG": 1,
+}
+
+# Operators that carry scannable content.
+_SCAN_OPERATORS = {
+    "rx", "pm", "pmf", "pmFromFile", "contains", "containsWord", "streq",
+    "beginsWith", "endsWith", "within", "detectSQLi", "detectXSS",
+}
+
+# Heuristic trigger factors for the strict-grammar detectors (libdetection
+# analog).  These gate the CPU confirm stage; soundness vs our own
+# models/libdetect implementation is asserted by tests/test_libdetect.py.
+_SQLI_TRIGGERS = [
+    "'", '"', "`", "--", "/*", "#", ";", "=", "union", "select", "sleep(",
+    "benchmark(", "0x", "||", "char(",
+]
+_XSS_TRIGGERS = ["<", ">", "javascript:", "on", "&#", "src=", "%3c", "%3e"]
+
+
+def _lit_seq(text: str, fold: bool) -> F.ClassSeq:
+    seq = []
+    for ch in text.encode("utf-8", "surrogateescape"):
+        s = frozenset([ch])
+        if fold:
+            if 0x41 <= ch <= 0x5A:
+                s = frozenset([ch, ch + 0x20])
+            elif 0x61 <= ch <= 0x7A:
+                s = frozenset([ch, ch - 0x20])
+        seq.append(s)
+    return tuple(seq)
+
+
+def _squash_group(group: F.Group) -> F.Group:
+    """Rewrite factors for the squash variants: positions whose class is
+    entirely deletable vanish (neighbors join, as in the squashed stream);
+    ambiguous positions (class partially deletable) split the factor; per
+    alternative the best fragment is kept (still mandatory)."""
+    out: F.Group = []
+    for seq in group:
+        frags: List[List[frozenset]] = [[]]
+        for cls in seq:
+            if cls <= SQUASH_BYTES:
+                continue  # deleted on both sides — neighbors become adjacent
+            if cls & SQUASH_BYTES:
+                frags.append([])  # ambiguous survival → split
+            else:
+                frags[-1].append(cls)
+        best = max(frags, key=lambda f: F.seq_bits(tuple(f)))
+        if not best:
+            return []  # an alternative squashes away entirely → unusable
+        out.append(tuple(best))
+    return out
+
+
+def _split_at(group: F.Group, split_bytes: frozenset) -> F.Group:
+    """Split factors at positions that may contain ``split_bytes`` and keep
+    the best fragment per alternative (used for normalizePath rules, whose
+    deletions always contain a path separator)."""
+    out: F.Group = []
+    for seq in group:
+        frags: List[List[frozenset]] = [[]]
+        for cls in seq:
+            if cls & split_bytes:
+                frags.append([])
+            else:
+                frags[-1].append(cls)
+        best = max(frags, key=lambda f: F.seq_bits(tuple(f)))
+        if not best:
+            return []
+        out.append(tuple(best))
+    return out
+
+
+@dataclass
+class RuleMeta:
+    """Per-rule compile result (everything the runtime needs off-device)."""
+
+    rule: Rule
+    index: int
+    variant: int
+    has_prefilter: bool
+    confirm: Dict  # JSON-serializable confirm descriptor
+
+
+@dataclass
+class CompiledRuleset:
+    """Device tables + metadata; the deployable/hot-swappable artifact."""
+
+    tables: BitapTables
+    rules: List[RuleMeta]
+    # (n_rules, N_SV) bool — which stream-variant rows count for each rule
+    rule_sv_mask: np.ndarray
+    rule_class: np.ndarray      # (n_rules,) int32 → CLASSES
+    rule_score: np.ndarray      # (n_rules,) int32 anomaly score
+    rule_action: np.ndarray     # (n_rules,) int32 0=pass 1=block 2=deny
+    rule_paranoia: np.ndarray   # (n_rules,) int32
+    rule_ids: np.ndarray        # (n_rules,) int64 CRS ids
+    version: str = ""
+
+    @property
+    def n_rules(self) -> int:
+        return len(self.rules)
+
+    @property
+    def classes(self) -> Tuple[str, ...]:
+        return tuple(CLASSES)
+
+    def fingerprint(self) -> str:
+        h = hashlib.sha256()
+        for a in (self.tables.byte_table, self.tables.init_mask,
+                  self.tables.final_mask, self.rule_sv_mask):
+            h.update(np.ascontiguousarray(a).tobytes())
+        return h.hexdigest()[:16]
+
+    # ---------------------------------------------------------- serialize
+
+    def save(self, path: str | Path) -> None:
+        """Write the checkpoint artifact: <path>.npz + <path>.json."""
+        path = Path(path)
+        t = self.tables
+        np.savez_compressed(
+            path.with_suffix(".npz"),
+            byte_table=t.byte_table, init_mask=t.init_mask,
+            final_mask=t.final_mask, factor_word=t.factor_word,
+            factor_bit=t.factor_bit, factor_rule_indptr=t.factor_rule_indptr,
+            factor_rule_ids=t.factor_rule_ids, rule_nfactors=t.rule_nfactors,
+            factor_len=t.factor_len, rule_sv_mask=self.rule_sv_mask,
+            rule_class=self.rule_class, rule_score=self.rule_score,
+            rule_action=self.rule_action, rule_paranoia=self.rule_paranoia,
+            rule_ids=self.rule_ids,
+        )
+        meta = {
+            "version": self.version or self.fingerprint(),
+            "n_rules": self.n_rules,
+            "classes": CLASSES,
+            "streams": STREAMS,
+            "variants": VARIANTS,
+            "confirm": [m.confirm for m in self.rules],
+        }
+        path.with_suffix(".json").write_text(json.dumps(meta))
+
+    @classmethod
+    def load(cls, path: str | Path) -> "CompiledRuleset":
+        path = Path(path)
+        z = np.load(path.with_suffix(".npz"))
+        meta = json.loads(path.with_suffix(".json").read_text())
+        tables = BitapTables(
+            byte_table=z["byte_table"], init_mask=z["init_mask"],
+            final_mask=z["final_mask"], factor_word=z["factor_word"],
+            factor_bit=z["factor_bit"],
+            factor_rule_indptr=z["factor_rule_indptr"],
+            factor_rule_ids=z["factor_rule_ids"],
+            rule_nfactors=z["rule_nfactors"], factor_len=z["factor_len"],
+        )
+        rules = []
+        action_names = {0: "pass", 1: "block", 2: "deny"}
+        for i, confirm in enumerate(meta["confirm"]):
+            rule = Rule(
+                rule_id=int(z["rule_ids"][i]),
+                operator=confirm["op"],
+                argument=confirm.get("arg", ""),
+                targets=list(confirm.get("targets", ["args"])),
+                transforms=confirm.get("transforms", []),
+                action=action_names[int(z["rule_action"][i])],
+            )
+            rules.append(RuleMeta(rule=rule, index=i,
+                                  variant=confirm.get("variant", 0),
+                                  has_prefilter=bool(tables.rule_nfactors[i]),
+                                  confirm=confirm))
+        return cls(
+            tables=tables, rules=rules, rule_sv_mask=z["rule_sv_mask"],
+            rule_class=z["rule_class"], rule_score=z["rule_score"],
+            rule_action=z["rule_action"], rule_paranoia=z["rule_paranoia"],
+            rule_ids=z["rule_ids"], version=meta["version"],
+        )
+
+
+def _rule_variant(rule: Rule) -> int:
+    t = set(rule.transforms)
+    if t & _WS_COLLAPSE:
+        return 4 if t & (_DECODE_TRANSFORMS | _HTML_TRANSFORMS) else 3
+    if t & _HTML_TRANSFORMS:
+        return 2
+    if t & _DECODE_TRANSFORMS:
+        return 1
+    return 0
+
+
+def _factor_group_for(rule: Rule) -> Tuple[F.Group, Dict]:
+    """Extract the rule's factor group + confirm descriptor."""
+    fold = "lowercase" in rule.transforms or rule.operator in ("pm", "pmFromFile", "pmf")
+    op = rule.operator
+    confirm: Dict = {
+        "op": op, "arg": rule.argument, "transforms": rule.transforms,
+        "fold": fold, "variant": _rule_variant(rule),
+    }
+
+    if op == "rx":
+        try:
+            ast = parse_regex(rule.argument, ignorecase=fold)
+            group = F.best_factor_group(ast) or []
+        except RegexUnsupported as e:
+            confirm["regex_unsupported"] = str(e)
+            group = []
+    elif op in ("pm", "pmf", "pmFromFile"):
+        # phrases (one per line, from @pmFromFile) or whitespace words
+        words = (rule.argument.split("\n") if "\n" in rule.argument
+                 else rule.argument.split())
+        words = [w for w in (w.strip() for w in words) if w]
+        confirm["words"] = words
+        group = [F.best_window(_lit_seq(w, fold=True)) for w in words]
+    elif op in ("contains", "containsWord", "streq", "beginsWith", "endsWith",
+                "within"):
+        group = [F.best_window(_lit_seq(rule.argument, fold))]
+    elif op == "detectSQLi":
+        group = [F.best_window(_lit_seq(w, True)) for w in _SQLI_TRIGGERS]
+    elif op == "detectXSS":
+        group = [F.best_window(_lit_seq(w, True)) for w in _XSS_TRIGGERS]
+    else:
+        group = []
+
+    # Soundness fix-ups for destructive transforms (see module docstring).
+    t = set(rule.transforms)
+    if t & _PATH_TRANSFORMS and group:
+        group = _split_at(group, _PATH_SEP_BYTES)
+    if t & _WS_COLLAPSE and group:
+        group = _squash_group(group)
+
+    # Discard degenerate groups: an empty alternative fires everywhere, and
+    # a group whose weakest alternative carries <2 bits of information
+    # (e.g. a single near-full byte class) fires on ~all traffic — worse
+    # than honestly marking the rule always-confirm.
+    group = [s for s in group if len(s) > 0]
+    if group and min(F.seq_bits(s) for s in group) < 2.0:
+        group = []
+    return group, confirm
+
+
+def compile_ruleset(
+    rules: Sequence[Rule],
+    base_path: Optional[str | Path] = None,
+    include_chains: bool = True,
+) -> CompiledRuleset:
+    """Compile rules → CompiledRuleset.
+
+    Chained rules contribute the FIRST scannable link's factors (a chain hit
+    requires every link; prefiltering on one link is sound); the confirm
+    descriptor carries all links for exact AND evaluation.
+
+    ``base_path`` is accepted for compatibility but unused: @pmFromFile is
+    resolved at SecLang parse time (seclang.parse_seclang).
+    """
+    scannable = [r for r in rules if r.operator in _SCAN_OPERATORS]
+
+    metas: List[RuleMeta] = []
+    groups: List[F.Group] = []
+    sv_mask = np.zeros((len(scannable), N_SV), dtype=bool)
+    rule_class = np.zeros((len(scannable),), dtype=np.int32)
+    rule_score = np.zeros((len(scannable),), dtype=np.int32)
+    rule_action = np.zeros((len(scannable),), dtype=np.int32)
+    rule_paranoia = np.ones((len(scannable),), dtype=np.int32)
+    rule_ids = np.zeros((len(scannable),), dtype=np.int64)
+
+    for i, rule in enumerate(scannable):
+        group, confirm = _factor_group_for(rule)
+        if include_chains and rule.chain is not None:
+            links = []
+            link: Optional[Rule] = rule.chain
+            while link is not None:
+                _, link_confirm = _factor_group_for(link)
+                link_confirm["targets"] = link.targets
+                links.append(link_confirm)
+                link = link.chain
+            confirm["chain"] = links
+        confirm["targets"] = rule.targets
+        variant = confirm["variant"]
+
+        groups.append(group)
+        metas.append(RuleMeta(rule=rule, index=i, variant=variant,
+                              has_prefilter=bool(group), confirm=confirm))
+        for stream in rule.targets:
+            sv = STREAM_INDEX[stream] * len(VARIANTS) + variant
+            sv_mask[i, sv] = True
+        rule_class[i] = CLASS_INDEX[rule.attack_class]
+        rule_score[i] = SEVERITY_SCORE.get(rule.severity.upper(), 3)
+        rule_action[i] = {"pass": 0, "block": 1, "deny": 2}[rule.action]
+        rule_paranoia[i] = rule.paranoia
+        rule_ids[i] = rule.rule_id
+
+    tables = pack_factors(groups, n_rules=len(scannable))
+    cr = CompiledRuleset(
+        tables=tables, rules=metas, rule_sv_mask=sv_mask,
+        rule_class=rule_class, rule_score=rule_score,
+        rule_action=rule_action, rule_paranoia=rule_paranoia,
+        rule_ids=rule_ids,
+    )
+    cr.version = cr.fingerprint()
+    return cr

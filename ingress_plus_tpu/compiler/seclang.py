@@ -1,0 +1,309 @@
+"""SecLang (ModSecurity rule language) parser.
+
+The reference data plane consumes two rule formats: OWASP CRS v3 SecLang
+rules via libmodsecurity, and Wallarm's proprietary proton.db signature packs
+(closed source; SURVEY.md §2.2).  This module parses the SecLang subset CRS
+uses — `SecRule VARIABLES "OPERATOR" "ACTIONS"` with chains, transformations
+and the common operators — into neutral ``Rule`` objects that
+ruleset.py compiles for the TPU engine.  Signature packs are handled by
+sigpack.py with the same Rule output type.
+"""
+
+from __future__ import annotations
+
+import re
+import shlex
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+# CRS-style rule-id range → attack class (verdict head).
+CLASS_RANGES = [
+    (913000, 913999, "scanner"),
+    (920000, 920999, "protocol"),
+    (921000, 921999, "protocol"),
+    (930000, 930999, "lfi"),
+    (931000, 931999, "rfi"),
+    (932000, 932999, "rce"),
+    (933000, 933999, "php"),
+    (934000, 934999, "nodejs"),
+    (941000, 941999, "xss"),
+    (942000, 942999, "sqli"),
+    (943000, 943999, "session"),
+    (944000, 944999, "java"),
+]
+
+CLASSES = [
+    "protocol", "scanner", "lfi", "rfi", "rce", "php", "nodejs",
+    "xss", "sqli", "session", "java", "generic",
+]
+CLASS_INDEX = {c: i for i, c in enumerate(CLASSES)}
+
+# Request targets we know how to feed to the scanner.  Each maps to one of
+# the normalized streams the serve loop extracts from a request
+# (serve/request.py).
+KNOWN_TARGETS = {
+    "REQUEST_URI": "uri",
+    "REQUEST_URI_RAW": "uri",
+    "REQUEST_BASENAME": "uri",
+    "REQUEST_FILENAME": "uri",
+    "QUERY_STRING": "args",
+    "ARGS": "args",
+    "ARGS_GET": "args",
+    "ARGS_POST": "body",
+    "ARGS_NAMES": "args",
+    "ARGS_GET_NAMES": "args",
+    "ARGS_POST_NAMES": "body",
+    "REQUEST_BODY": "body",
+    "XML": "body",
+    "JSON": "body",
+    "FILES": "body",
+    "FILES_NAMES": "body",
+    "REQUEST_HEADERS": "headers",
+    "REQUEST_HEADERS_NAMES": "headers",
+    "REQUEST_COOKIES": "headers",
+    "REQUEST_COOKIES_NAMES": "headers",
+    "REQUEST_LINE": "uri",
+    "REQUEST_METHOD": "uri",
+    "REQUEST_PROTOCOL": "uri",
+}
+
+STREAMS = ("uri", "args", "headers", "body")
+STREAM_INDEX = {s: i for i, s in enumerate(STREAMS)}
+
+
+@dataclass
+class Rule:
+    """One detection rule, format-neutral."""
+
+    rule_id: int
+    operator: str                     # rx | pm | contains | streq | beginsWith |
+                                      # endsWith | within | detectSQLi | detectXSS
+    argument: str                     # regex text / word list / literal
+    targets: List[str] = field(default_factory=lambda: ["args"])  # stream names
+    transforms: List[str] = field(default_factory=list)
+    action: str = "block"             # block | deny | pass (monitoring)
+    severity: str = "WARNING"
+    msg: str = ""
+    tags: List[str] = field(default_factory=list)
+    chain: Optional["Rule"] = None    # AND-linked next rule
+    paranoia: int = 1
+    phase: int = 2
+
+    @property
+    def attack_class(self) -> str:
+        for lo, hi, name in CLASS_RANGES:
+            if lo <= self.rule_id <= hi:
+                return name
+        for t in self.tags:
+            m = re.search(r"attack-(\w+)", t)
+            if m and m.group(1) in CLASS_INDEX:
+                return m.group(1)
+        return "generic"
+
+
+class SecLangError(Exception):
+    pass
+
+
+def _logical_lines(text: str) -> List[str]:
+    """Join backslash-continued lines; strip comments/blank lines."""
+    out: List[str] = []
+    cur = ""
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        if not cur and (not line.strip() or line.lstrip().startswith("#")):
+            continue
+        if line.endswith("\\"):
+            cur += line[:-1] + " "
+            continue
+        cur += line
+        out.append(cur.strip())
+        cur = ""
+    if cur.strip():
+        out.append(cur.strip())
+    return out
+
+
+def _split_directive(line: str) -> List[str]:
+    """Split a SecLang line into directive tokens, honoring quotes."""
+    lex = shlex.shlex(line, posix=True)
+    lex.whitespace_split = True
+    lex.commenters = ""
+    return list(lex)
+
+
+def _parse_actions(text: str) -> Dict[str, List[str]]:
+    """Parse the comma-separated action list (quoted values allowed)."""
+    out: Dict[str, List[str]] = {}
+    buf, depth, quote = [], 0, None
+    items: List[str] = []
+    for ch in text:
+        if quote:
+            if ch == quote:
+                quote = None
+            else:
+                buf.append(ch)
+            continue
+        if ch in "'\"":
+            quote = ch
+            continue
+        if ch == "," and depth == 0:
+            items.append("".join(buf).strip())
+            buf = []
+            continue
+        if ch in "({[":
+            depth += 1
+        elif ch in ")}]":
+            depth -= 1
+        buf.append(ch)
+    if buf:
+        items.append("".join(buf).strip())
+    for item in items:
+        if not item:
+            continue
+        if ":" in item:
+            k, v = item.split(":", 1)
+        else:
+            k, v = item, ""
+        out.setdefault(k.strip(), []).append(v.strip())
+    return out
+
+
+def _parse_targets(text: str) -> List[str]:
+    streams: List[str] = []
+    for t in text.split("|"):
+        t = t.strip()
+        if not t or t.startswith("!"):
+            continue  # exclusions narrow the target set; superset is sound
+        if t.startswith("&"):
+            continue  # counting form (&ARGS) — control rule, not scannable
+        base = t.split(":", 1)[0].upper()
+        stream = KNOWN_TARGETS.get(base)
+        if stream and stream not in streams:
+            streams.append(stream)
+    return streams or ["args"]
+
+
+def parse_seclang(
+    text: str,
+    source: str = "<string>",
+    base_dir: Optional[Path] = None,
+) -> List[Rule]:
+    """Parse SecLang text → list of top-level Rules (chains attached).
+
+    ``@pmFromFile`` is resolved HERE, against ``base_dir`` (the directory of
+    the .conf file): the operator is rewritten to ``pm`` with the file's
+    phrases joined by newlines.  A missing file or missing base_dir is a
+    hard SecLangError — a silently-empty word list would compile to a dead
+    rule whose misses the F1 gate would blame on the kernel."""
+    rules: List[Rule] = []
+    pending_chain: Optional[Rule] = None
+
+    for line in _logical_lines(text):
+        try:
+            tokens = _split_directive(line)
+        except ValueError as e:
+            raise SecLangError("%s: tokenize error: %s in %r" % (source, e, line))
+        if not tokens:
+            continue
+        directive = tokens[0]
+        if directive in ("SecMarker", "SecAction", "SecComponentSignature",
+                         "SecRuleEngine", "SecRequestBodyAccess",
+                         "SecDefaultAction", "SecCollectionTimeout"):
+            continue  # engine-control directives: no scan content
+        if directive != "SecRule":
+            continue  # unknown directives are ignored (forward compat)
+        if len(tokens) < 3:
+            raise SecLangError("%s: short SecRule: %r" % (source, line))
+        targets_txt, op_txt = tokens[1], tokens[2]
+        actions_txt = tokens[3] if len(tokens) > 3 else ""
+
+        if op_txt.startswith("@"):
+            parts = op_txt.split(None, 1)
+            operator = parts[0][1:]
+            argument = parts[1] if len(parts) > 1 else ""
+        elif op_txt.startswith("!@"):
+            continue  # negated operators are control rules; skip
+        else:
+            operator, argument = "rx", op_txt
+
+        if operator in ("pmFromFile", "pmf"):
+            if base_dir is None:
+                raise SecLangError(
+                    "%s: @pmFromFile %r needs base_dir" % (source, argument))
+            fp = (base_dir / argument).resolve()
+            if not fp.exists():
+                raise SecLangError(
+                    "%s: @pmFromFile %r not found (resolved %s)"
+                    % (source, argument, fp))
+            phrases = [w.strip() for w in fp.read_text().splitlines()
+                       if w.strip() and not w.startswith("#")]
+            if not phrases:
+                raise SecLangError("%s: @pmFromFile %r is empty" % (source, argument))
+            operator, argument = "pm", "\n".join(phrases)
+
+        actions = _parse_actions(actions_txt)
+        try:
+            rid = int(actions.get("id", ["0"])[0] or 0)
+        except ValueError:
+            raise SecLangError("%s: non-numeric rule id in %r" % (source, line))
+        transforms = [v for v in actions.get("t", []) if v and v != "none"]
+        if "deny" in actions:
+            action = "deny"
+        elif "block" in actions:
+            action = "block"
+        elif "pass" in actions:
+            action = "pass"
+        else:
+            action = "block"
+        severity = (actions.get("severity", ["WARNING"])[0] or "WARNING").strip("'\"")
+        msg = (actions.get("msg", [""])[0]).strip("'\"")
+        tags = [v.strip("'\"") for v in actions.get("tag", [])]
+        paranoia = 1
+        for t in tags:
+            m = re.search(r"paranoia-level/(\d)", t)
+            if m:
+                paranoia = int(m.group(1))
+        phase = int(actions.get("phase", ["2"])[0] or 2)
+
+        rule = Rule(
+            rule_id=rid,
+            operator=operator,
+            argument=argument,
+            targets=_parse_targets(targets_txt),
+            transforms=transforms,
+            action=action,
+            severity=severity,
+            msg=msg,
+            tags=tags,
+            paranoia=paranoia,
+            phase=phase,
+        )
+
+        if pending_chain is not None:
+            # attach to deepest chain link
+            tail = pending_chain
+            while tail.chain is not None:
+                tail = tail.chain
+            tail.chain = rule
+            if "chain" not in actions:
+                rules.append(pending_chain)
+                pending_chain = None
+        elif "chain" in actions:
+            pending_chain = rule
+        else:
+            rules.append(rule)
+
+    if pending_chain is not None:
+        rules.append(pending_chain)  # tolerate dangling chain
+    return rules
+
+
+def load_seclang_dir(path: str | Path) -> List[Rule]:
+    """Parse every ``*.conf`` under ``path`` (sorted, CRS-style file order)."""
+    rules: List[Rule] = []
+    for conf in sorted(Path(path).glob("*.conf")):
+        rules.extend(parse_seclang(conf.read_text(), source=str(conf),
+                                   base_dir=conf.parent))
+    return rules

@@ -1,0 +1,14 @@
+"""Test bootstrap: force CPU with 8 virtual devices BEFORE jax import.
+
+This is the kind-cluster analog from SURVEY.md §4: multi-chip sharding logic
+is exercised on a virtual 8-device CPU mesh so CI needs no TPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

