@@ -33,7 +33,7 @@ _PACK_TEMPLATES = [
         r"(?i)\b{w}\b\s*(?:--|#|/\*)",
     ]),
     ("rce", 932500, "ERROR", ["args", "body"], [
-        r"(?i)(?:;|\||&|`|\$\()\s*{w}\b",
+        r"(?i)(?:;|\||&|`|\$\()\s*{w}(?:\s|$|[;,&|)'\"`\x1f])",
         r"(?i)\b{w}\s+-[a-z]",
         r"(?i)\b{w}\s+/(?:etc|tmp|var|dev|proc)\b",
     ]),

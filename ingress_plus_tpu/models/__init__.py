@@ -1,0 +1,10 @@
+"""Detection models.
+
+- ``engine.py``   — DetectionEngine: scan + factor→rule→class verdict heads
+  as one jit program (the libproton signature-matching analog).
+- ``confirm.py``  — exact CPU confirm stage (full PCRE semantics, transform
+  chains, chained rules) run only on prefilter hits.
+- ``libdetect.py``— strict-grammar SQLi/XSS detectors (libdetection analog).
+- ``pipeline.py`` — DetectionPipeline: requests → rows → engine → confirm →
+  verdicts; the complete behavioral unit measured by the F1 gate.
+"""

@@ -1,0 +1,223 @@
+"""Exact CPU confirm stage.
+
+Prefilter hits from the TPU engine are re-checked here with full rule
+semantics: the rule's exact transform chain applied to the raw stream, the
+original PCRE evaluated by Python ``re`` (which supports lookaround,
+backreferences and possessive quantifiers — everything our NFA subset
+cannot express), chains AND-ed across links.  This is the hybrid design of
+SURVEY.md §7 (hard part #1): the TPU answers "could this rule match?", the
+confirm answers "does it?" — so detection F1 equals the confirm stage's
+semantics by construction.
+
+Transform implementations mirror ModSecurity behavior for the subset the
+corpus uses; deviations are approximations documented inline.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import re
+from typing import Callable, Dict, List, Optional
+
+from ingress_plus_tpu.serve.normalize import (
+    html_entity_decode,
+    url_decode_uni,
+)
+
+_WS = b" \t\n\r\f\v"
+
+
+def t_lowercase(d: bytes) -> bytes:
+    return d.lower()
+
+
+def t_urldecode(d: bytes) -> bytes:
+    return url_decode_uni(d)
+
+
+def t_htmlentitydecode(d: bytes) -> bytes:
+    return html_entity_decode(d)
+
+
+def t_removenulls(d: bytes) -> bytes:
+    return d.replace(b"\x00", b"")
+
+
+def t_replacenulls(d: bytes) -> bytes:
+    return d.replace(b"\x00", b" ")
+
+
+def t_compresswhitespace(d: bytes) -> bytes:
+    return re.sub(rb"[\s\x0b]+", b" ", d)
+
+
+def t_removewhitespace(d: bytes) -> bytes:
+    return re.sub(rb"[\s\x0b]+", b"", d)
+
+
+def t_trim(d: bytes) -> bytes:
+    return d.strip(_WS)
+
+
+def t_normalizepath(d: bytes) -> bytes:
+    """Collapse //, remove /./, resolve seg/../ (keeps leading slash)."""
+    prev = None
+    while prev != d:
+        prev = d
+        d = d.replace(b"//", b"/")
+    d = d.replace(b"/./", b"/")
+    out: List[bytes] = []
+    for seg in d.split(b"/"):
+        if seg == b"..":
+            if out and out[-1] not in (b"", b".."):
+                out.pop()
+            else:
+                out.append(seg)
+        else:
+            out.append(seg)
+    return b"/".join(out)
+
+
+def t_cmdline(d: bytes) -> bytes:
+    """ModSecurity cmdLine (approximation): delete \\ ' " ^ ; lowercase;
+    collapse whitespace; drop spaces around / and (."""
+    d = re.sub(rb"[\\'\"^]", b"", d).lower()
+    d = re.sub(rb"[\s\x0b]+", b" ", d)
+    d = re.sub(rb"\s*([/(])\s*", rb"\1", d)
+    return d.strip(_WS)
+
+
+def t_base64decode(d: bytes) -> bytes:
+    try:
+        return base64.b64decode(d + b"=" * (-len(d) % 4), validate=False)
+    except (binascii.Error, ValueError):
+        return d
+
+
+def t_hexdecode(d: bytes) -> bytes:
+    try:
+        return binascii.unhexlify(d)
+    except (binascii.Error, ValueError):
+        return d
+
+
+def t_jsdecode(d: bytes) -> bytes:
+    """\\xHH, \\uHHHH, \\n etc. (approximation)."""
+    def repl(m: "re.Match[bytes]") -> bytes:
+        g = m.group(0)
+        try:
+            if g[1:2] in (b"x", b"u"):
+                return bytes([int(g[2:], 16) & 0xFF])
+            return {b"n": b"\n", b"r": b"\r", b"t": b"\t", b"0": b"\x00"}.get(
+                g[1:2], g[1:2])
+        except ValueError:
+            return g
+    return re.sub(rb"\\(?:x[0-9a-fA-F]{2}|u[0-9a-fA-F]{4}|.)", repl, d)
+
+
+def t_cssdecode(d: bytes) -> bytes:
+    def repl(m: "re.Match[bytes]") -> bytes:
+        try:
+            return bytes([int(m.group(1), 16) & 0xFF])
+        except ValueError:
+            return m.group(0)
+    return re.sub(rb"\\([0-9a-fA-F]{1,6})\s?", repl, d)
+
+
+TRANSFORMS: Dict[str, Callable[[bytes], bytes]] = {
+    "lowercase": t_lowercase,
+    "urlDecode": t_urldecode,
+    "urlDecodeUni": t_urldecode,
+    "htmlEntityDecode": t_htmlentitydecode,
+    "removeNulls": t_removenulls,
+    "replaceNulls": t_replacenulls,
+    "compressWhitespace": t_compresswhitespace,
+    "removeWhitespace": t_removewhitespace,
+    "normalizePath": t_normalizepath,
+    "normalisePath": t_normalizepath,
+    "normalizePathWin": t_normalizepath,
+    "cmdLine": t_cmdline,
+    "base64Decode": t_base64decode,
+    "hexDecode": t_hexdecode,
+    "jsDecode": t_jsdecode,
+    "cssDecode": t_cssdecode,
+    "trim": t_trim,
+    "utf8toUnicode": lambda d: d,  # no-op approximation
+    "none": lambda d: d,
+}
+
+
+def apply_transforms(data: bytes, transforms: List[str]) -> bytes:
+    for name in transforms:
+        fn = TRANSFORMS.get(name)
+        if fn is not None:
+            data = fn(data)
+    return data
+
+
+class ConfirmRule:
+    """Compiled exact-evaluation closure for one rule (+ chain links)."""
+
+    def __init__(self, confirm: Dict):
+        self.desc = confirm
+        self.op: str = confirm["op"]
+        self.transforms: List[str] = confirm.get("transforms", [])
+        self.targets: List[str] = confirm.get("targets", ["args"])
+        self.fold: bool = confirm.get("fold", False)
+        self.rx: Optional["re.Pattern[bytes]"] = None
+        self.words: List[bytes] = [
+            w.encode() for w in confirm.get("words", [])]
+        self.arg: bytes = confirm.get("arg", "").encode(
+            "utf-8", "surrogateescape")
+        self.compile_error: Optional[str] = None
+        if self.op == "rx":
+            flags = re.IGNORECASE if self.fold else 0
+            try:
+                self.rx = re.compile(self.arg, flags)
+            except re.error as e:
+                self.compile_error = str(e)
+        self.chain = [ConfirmRule(c) for c in confirm.get("chain", [])]
+
+    def _op_match(self, text: bytes) -> bool:
+        if self.op == "rx":
+            if self.rx is None:
+                return False  # unmatchable pattern: never confirm
+            return self.rx.search(text) is not None
+        if self.op == "pm":
+            low = text.lower()
+            return any(w.lower() in low for w in self.words)
+        arg = self.arg.lower() if self.fold else self.arg
+        t = text.lower() if self.fold else text
+        if self.op in ("contains", "containsWord"):
+            return arg in t
+        if self.op == "streq":
+            return t == arg
+        if self.op == "beginsWith":
+            return t.startswith(arg)
+        if self.op == "endsWith":
+            return t.endswith(arg)
+        if self.op == "within":
+            return t in arg
+        if self.op == "detectSQLi":
+            from ingress_plus_tpu.models.libdetect import detect_sqli
+            return detect_sqli(text)
+        if self.op == "detectXSS":
+            from ingress_plus_tpu.models.libdetect import detect_xss
+            return detect_xss(text)
+        return False
+
+    def matches_streams(self, streams: Dict[str, bytes]) -> bool:
+        """Evaluate against raw streams (applies own transforms)."""
+        hit = False
+        for target in self.targets:
+            raw = streams.get(target, b"")
+            if not raw:
+                continue
+            if self._op_match(apply_transforms(raw, self.transforms)):
+                hit = True
+                break
+        if not hit:
+            return False
+        # chain: every link must also match (on its own targets/transforms)
+        return all(link.matches_streams(streams) for link in self.chain)

@@ -1,0 +1,163 @@
+"""DetectionEngine — the flagship model: batched scan + verdict heads.
+
+One jit-compiled program takes a padded batch of normalized scan rows and
+produces per-request rule prefilter hits, per-class verdicts and anomaly
+scores.  This is the TPU re-design of the reference's per-request hot loop
+(libproton signature match, SURVEY.md §3.3 hot loop #2): the per-byte
+automaton runs as the bitap recurrence on the VPU, and the factor→rule→class
+mapping runs as small MXU matmuls.
+
+Shapes (per length-bucket, all static under jit):
+    tokens   (B, L)     uint8/int32  — normalized row bytes
+    lengths  (B,)       int32
+    row_req  (B,)       int32        — owning request index in [0, Q)
+    row_sv   (B, N_SV)  int8         — multi-hot stream-variant ids of row
+    tenants  (Q,)       int32        — per-request tenant (EP routing)
+Returns:
+    rule_hits  (Q, R) bool — prefilter hits per request (pre-confirm)
+    class_hits (Q, C) bool — any hit rule of that attack class
+    scores     (Q,)  int32 — anomaly score (sum of hit rules' severities)
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, N_SV
+from ingress_plus_tpu.compiler.seclang import CLASSES
+from ingress_plus_tpu.ops.scan import ScanTables, scan_bytes
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class EngineTables:
+    """All device arrays (a pytree → hot-swappable without recompilation)."""
+
+    scan: ScanTables
+    factor_word: jax.Array     # (F,) int32
+    factor_bit: jax.Array      # (F,) uint32
+    factor_rule: jax.Array     # (F, R) float32 dense factor→rule map
+    rule_sv: jax.Array         # (R, N_SV) float32
+    rule_score: jax.Array      # (R,) int32
+    rule_class: jax.Array      # (R, C) float32 one-hot
+    rule_no_prefilter: jax.Array  # (R,) bool — rules that always confirm
+
+    def tree_flatten(self):
+        return (
+            (self.scan, self.factor_word, self.factor_bit, self.factor_rule,
+             self.rule_sv, self.rule_score, self.rule_class,
+             self.rule_no_prefilter),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @classmethod
+    def from_ruleset(cls, cr: CompiledRuleset) -> "EngineTables":
+        t = cr.tables
+        F, R = t.n_factors, cr.n_rules
+        fr = np.zeros((max(F, 1), max(R, 1)), dtype=np.float32)
+        for f in range(F):
+            lo, hi = t.factor_rule_indptr[f], t.factor_rule_indptr[f + 1]
+            fr[f, t.factor_rule_ids[lo:hi]] = 1.0
+        onehot = np.zeros((max(R, 1), len(CLASSES)), dtype=np.float32)
+        if R:
+            onehot[np.arange(R), cr.rule_class] = 1.0
+        return cls(
+            scan=ScanTables.from_bitap(t),
+            factor_word=jnp.asarray(t.factor_word, jnp.int32),
+            factor_bit=jnp.asarray(t.factor_bit.astype(np.uint32)),
+            factor_rule=jnp.asarray(fr),
+            rule_sv=jnp.asarray(cr.rule_sv_mask.astype(np.float32)),
+            rule_score=jnp.asarray(cr.rule_score, jnp.int32),
+            rule_class=jnp.asarray(onehot),
+            rule_no_prefilter=jnp.asarray(t.rule_nfactors == 0),
+        )
+
+
+def detect_rows(
+    tables: EngineTables,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    row_req: jax.Array,
+    row_sv: jax.Array,
+    num_requests: int,
+    state: Optional[jax.Array] = None,
+    match: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The full detection step (jit this with static num_requests)."""
+    match_words, state = scan_bytes(tables.scan, tokens, lengths, state, match)
+
+    # factor hits: gather each factor's word, test its bit     (B, F)
+    mw = jnp.take(match_words, tables.factor_word, axis=1)
+    fh = ((mw >> tables.factor_bit) & jnp.uint32(1)).astype(jnp.float32)
+
+    # factor → rule prefilter hits                              (B, R)
+    row_rule = jnp.dot(fh, tables.factor_rule,
+                       preferred_element_type=jnp.float32) > 0
+
+    # a rule counts for a row only if the row carries one of the rule's
+    # stream-variant ids                                        (B, R)
+    applies = jnp.dot(row_sv.astype(jnp.float32), tables.rule_sv.T,
+                      preferred_element_type=jnp.float32) > 0
+    row_rule = jnp.logical_and(row_rule, applies)
+
+    # rows → requests (segment OR)                              (Q, R)
+    rule_hits = jax.ops.segment_max(
+        row_rule.astype(jnp.int32), row_req, num_segments=num_requests,
+    ) > 0
+
+    # rules with no prefilter must always reach the confirm stage for any
+    # request that has at least one applicable row
+    req_has_rows = jax.ops.segment_max(
+        applies.astype(jnp.int32), row_req, num_segments=num_requests) > 0
+    rule_hits = jnp.logical_or(
+        rule_hits, jnp.logical_and(req_has_rows, tables.rule_no_prefilter[None, :]))
+
+    hits_f = rule_hits.astype(jnp.float32)
+    class_hits = jnp.dot(hits_f, tables.rule_class,
+                         preferred_element_type=jnp.float32) > 0
+    scores = jnp.dot(hits_f, tables.rule_score.astype(jnp.float32),
+                     preferred_element_type=jnp.float32).astype(jnp.int32)
+    return rule_hits, class_hits, scores, match_words, state
+
+
+detect_rows_jit = jax.jit(detect_rows, static_argnames=("num_requests",))
+
+
+class DetectionEngine:
+    """Host-facing wrapper: upload tables once, detect per batch.
+
+    Hot-swap (the proton.db sync-node analog, SURVEY.md §3.4): call
+    ``swap_ruleset`` with a new CompiledRuleset — same pytree structure, so
+    the jit cache is reused; the old tables are dropped after the next
+    dispatch completes (double-buffered by XLA's async dispatch)."""
+
+    def __init__(self, cr: CompiledRuleset):
+        self.ruleset = cr
+        self.tables = EngineTables.from_ruleset(cr)
+
+    def swap_ruleset(self, cr: CompiledRuleset) -> None:
+        new = EngineTables.from_ruleset(cr)
+        ok = (new.factor_rule.shape == self.tables.factor_rule.shape)
+        self.ruleset = cr
+        self.tables = new
+        if not ok:
+            # different table geometry → jit will recompile on next call;
+            # callers keep serving the old executable until then.
+            detect_rows_jit.clear_cache() if hasattr(detect_rows_jit, "clear_cache") else None
+
+    def detect(self, tokens, lengths, row_req, row_sv, num_requests: int):
+        rule_hits, class_hits, scores, match, _ = detect_rows_jit(
+            self.tables, jnp.asarray(tokens), jnp.asarray(lengths),
+            jnp.asarray(row_req), jnp.asarray(row_sv), num_requests)
+        return (np.asarray(rule_hits), np.asarray(class_hits),
+                np.asarray(scores))

@@ -1,0 +1,187 @@
+"""Strict-syntax SQLi / XSS detectors — the libdetection analog.
+
+The reference optionally confirms libproton hits with wallarm/libdetection
+(open-source C, separate repo): a tokenizer + per-language strict grammar
+that kills false positives by requiring the payload to be *syntactically
+meaningful* in the injection language (SURVEY.md §2.2).  This module is a
+behavioral re-implementation in the same spirit (tokenize, then accept only
+token patterns that continue/compose a SQL expression or active HTML), not
+a port: the grammars are written fresh, sized to the corpus the F1 gate
+uses.  A C++ twin lives in native/confirm/ for the sidecar fast path.
+
+``detect_sqli`` evaluates the input in three contexts (bare, breaking out
+of a single-quoted string, double-quoted) like libdetection's context
+automaton, and accepts on:
+  - UNION/SELECT/stacked-query statement shapes
+  - boolean tautology probes (value = value with OR/AND glue)
+  - comment truncation after a quote-break
+  - time/exfil function calls (sleep/benchmark/load_file/…)
+
+``detect_xss`` tokenizes HTML-ish input and accepts on script-capable
+constructs: script/active tags, event-handler attributes, javascript: URIs.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+# ------------------------------------------------------------------ SQLi
+
+_SQL_KEYWORDS = {
+    "select", "union", "insert", "update", "delete", "drop", "create",
+    "alter", "truncate", "replace", "merge", "exec", "execute", "declare",
+    "from", "where", "having", "group", "order", "limit", "offset", "into",
+    "values", "table", "database", "and", "or", "not", "like", "between",
+    "in", "is", "null", "case", "when", "then", "else", "end", "cast",
+    "convert", "waitfor", "delay",
+}
+_SQL_FUNCTIONS = {
+    "sleep", "benchmark", "pg_sleep", "load_file", "version", "user",
+    "current_user", "session_user", "system_user", "database", "schema",
+    "concat", "group_concat", "char", "chr", "ascii", "substring", "substr",
+    "mid", "hex", "unhex", "extractvalue", "updatexml", "xp_cmdshell",
+    "randomblob", "sqlite_version", "utl_inaddr", "dbms_pipe",
+}
+
+_TOKEN_RX = re.compile(
+    rb"""
+      (?P<ws>\s+)
+    | (?P<comment>--[^\n]*|\#[^\n]*|/\*.*?(?:\*/|$))
+    | (?P<str>'(?:[^'\\]|\\.|'')*'?|"(?:[^"\\]|\\.|"")*"?|`[^`]*`?)
+    | (?P<hex>0x[0-9a-fA-F]+)
+    | (?P<num>\d+(?:\.\d+)?)
+    | (?P<word>[A-Za-z_][A-Za-z0-9_$]*)
+    | (?P<op>\|\||&&|<=|>=|<>|!=|=|<|>|\+|-|\*|/|%|\(|\)|,|;|@@?|!|~|\^|&|\|)
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+def _tokenize_sql(data: bytes) -> List[Tuple[str, bytes]]:
+    tokens: List[Tuple[str, bytes]] = []
+    i = 0
+    while i < len(data) and len(tokens) < 512:
+        m = _TOKEN_RX.match(data, i)
+        if not m:
+            i += 1  # unknown byte: skip (strict grammar tolerates noise gaps)
+            continue
+        i = m.end()
+        kind = m.lastgroup or "ws"
+        if kind == "ws":
+            continue
+        text = m.group(0)
+        if kind == "word":
+            w = text.lower().decode()
+            if w in _SQL_KEYWORDS:
+                kind = "kw:" + w
+            elif w in _SQL_FUNCTIONS:
+                kind = "fn"
+        tokens.append((kind, text))
+    return tokens
+
+
+_VALUE_KINDS = {"str", "num", "hex", "word", "fn"}
+_CMP_OPS = {b"=", b"<", b">", b"<=", b">=", b"<>", b"!=", b"like"}
+
+
+def _is_value(tok: Tuple[str, bytes]) -> bool:
+    return tok[0] in _VALUE_KINDS
+
+
+def _sqli_token_patterns(tokens: List[Tuple[str, bytes]]) -> bool:
+    kinds = [k for k, _ in tokens]
+
+    # UNION ... SELECT (any gap)
+    if any(k == "kw:union" for k in kinds) and any(k == "kw:select" for k in kinds):
+        return True
+    # SELECT ... FROM
+    if any(k == "kw:select" for k in kinds) and any(k == "kw:from" for k in kinds):
+        return True
+    # stacked query: ';' followed by a statement keyword
+    for i, k in enumerate(kinds):
+        if k == "op" and tokens[i][1] == b";":
+            rest = kinds[i + 1 :]
+            if any(r.startswith("kw:") and r[3:] in (
+                    "select", "insert", "update", "delete", "drop", "create",
+                    "alter", "exec", "execute", "declare", "truncate")
+                   for r in rest[:3]):
+                return True
+    # boolean glue + comparison: (OR|AND) value cmp value
+    for i, k in enumerate(kinds):
+        if k in ("kw:or", "kw:and") and i + 3 <= len(tokens):
+            rest = tokens[i + 1 :]
+            if len(rest) >= 3 and _is_value(rest[0]) and \
+               rest[1][1].lower() in _CMP_OPS and _is_value(rest[2]):
+                return True
+            # OR 'a' / OR 1 — bare truthy value then end/comment
+            if len(rest) >= 1 and _is_value(rest[0]) and (
+                    len(rest) == 1 or rest[1][0] == "comment"):
+                return True
+    # time/exfil function call: fn '('
+    for i, (k, _) in enumerate(tokens[:-1]):
+        if k == "fn" and tokens[i + 1][1] == b"(":
+            return True
+    # tautology without glue at start: literal cmp literal (e.g. 1=1,
+    # 'a'='a').  Bare words are excluded — "q=o" is a query param, not SQL.
+    lits = {"str", "num", "hex"}
+    if len(tokens) >= 3 and tokens[0][0] in lits and \
+       tokens[1][1] in (b"=", b"<>", b"!=") and tokens[2][0] in lits:
+        return True
+    return False
+
+
+def detect_sqli(data: bytes, max_len: int = 4096) -> bool:
+    """Strict-grammar SQLi check in three quote contexts."""
+    data = data[:max_len]
+    if not data:
+        return False
+    for prefix in (b"", b"'", b'"'):
+        payload = prefix + data if prefix and prefix in data else data
+        tokens = _tokenize_sql(payload)
+        if not tokens:
+            continue
+        # comment truncation straight after a quote-break: '--, '#, '/*
+        if prefix and len(tokens) >= 2 and tokens[0][0] == "str" and \
+           tokens[-1][0] == "comment":
+            return True
+        if _sqli_token_patterns(tokens):
+            return True
+    return False
+
+
+# ------------------------------------------------------------------- XSS
+
+_ACTIVE_TAGS = {
+    b"script", b"iframe", b"embed", b"object", b"applet", b"svg", b"math",
+    b"base", b"meta", b"form", b"video", b"audio", b"img", b"input",
+    b"body", b"style", b"link", b"marquee", b"details", b"template",
+}
+_TAG_RX = re.compile(rb"<\s*(/?)\s*([a-zA-Z][a-zA-Z0-9-]*)", re.DOTALL)
+_EVENT_ATTR_RX = re.compile(
+    rb"\bon[a-zA-Z]{3,30}\s*=\s*[\"'`]?[^\s\"'`>]", re.DOTALL)
+_JS_URI_RX = re.compile(rb"(?:javascript|vbscript)\s*:", re.IGNORECASE)
+_DATA_URI_RX = re.compile(rb"data\s*:[^,]{0,60};\s*base64", re.IGNORECASE)
+
+
+def detect_xss(data: bytes, max_len: int = 4096) -> bool:
+    """Strict-ish XSS check: script-capable HTML constructs only."""
+    data = data[:max_len]
+    if not data:
+        return False
+    low = data.lower()
+    for m in _TAG_RX.finditer(low):
+        name = m.group(2)
+        if name in _ACTIVE_TAGS:
+            return True
+    if _EVENT_ATTR_RX.search(low):
+        # must look attribute-ish: inside a tag or with a quote near it
+        return True
+    if _JS_URI_RX.search(low):
+        return True
+    if _DATA_URI_RX.search(low):
+        return True
+    # entity-obfuscated script: &#x3c;script
+    if b"&#" in low and b"script" in low:
+        return True
+    return False

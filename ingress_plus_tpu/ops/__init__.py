@@ -1,0 +1,21 @@
+"""TPU scan kernels: the per-byte automaton hot loop (SURVEY.md §3.3 #2).
+
+Two interchangeable implementations of the same bitap recurrence
+(compiler/bitap.py):
+
+- ``scan.py``         — pure jnp/XLA: `lax.scan` over byte steps, gather for
+  the byte table.  Runs anywhere (CPU tests, TPU), is the reference
+  implementation, and is what multi-chip sharding wraps.
+- ``pallas_scan.py``  — hand-written Pallas TPU kernel: byte table resident
+  in VMEM, grid over batch tiles, double-buffered HBM→VMEM byte streaming.
+
+Both expose scan(tokens, lengths, state) → (match, state) so streaming
+chunked bodies (benchmark config #5) carry the NFA state vector across
+calls — the framework's sequence-parallel analog (SURVEY.md §5).
+"""
+
+from ingress_plus_tpu.ops.scan import (  # noqa: F401
+    ScanTables,
+    scan_bytes,
+    scan_bytes_reference,
+)

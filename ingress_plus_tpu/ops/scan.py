@@ -1,0 +1,136 @@
+"""Batched bitap scan — jnp/XLA implementation.
+
+The recurrence per byte (uint32, element-wise over words — see
+compiler/bitap.py for why no cross-word carries exist):
+
+    S' = ((S << 1) | INIT) & B[byte]
+    M' = M | (S' & FINAL)
+
+Shapes: tokens (B, L) int32 in [0, 255] (padded with any value), lengths
+(B,) int32, state/match (B, W) uint32.  Padded steps are identity on both S
+and M (masked select), so a row's final state is exactly the state after its
+``length`` real bytes — the property the streaming chunk chain relies on.
+
+Design notes (TPU-first):
+- `lax.scan` over the time axis with the batch×words update vectorized on
+  the VPU; `unroll` amortizes loop overhead.
+- The 256×W byte table is gathered per step with `jnp.take` — on TPU this
+  compiles to a dynamic-gather from VMEM (the table is ~256×258×4B ≈ 264KB).
+- Everything is static-shaped; jit caches one executable per (B, L, W).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ingress_plus_tpu.compiler.bitap import BitapTables
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class ScanTables:
+    """Device-resident scan tables (a pytree, so it jits as an argument —
+    ruleset hot-swap is just passing new arrays, no recompilation)."""
+
+    byte_table: jax.Array  # (256, W) uint32
+    init_mask: jax.Array   # (W,) uint32
+    final_mask: jax.Array  # (W,) uint32
+
+    @classmethod
+    def from_bitap(cls, t: BitapTables) -> "ScanTables":
+        return cls(
+            byte_table=jnp.asarray(t.byte_table, dtype=jnp.uint32),
+            init_mask=jnp.asarray(t.init_mask, dtype=jnp.uint32),
+            final_mask=jnp.asarray(t.final_mask, dtype=jnp.uint32),
+        )
+
+    @property
+    def n_words(self) -> int:
+        return self.byte_table.shape[1]
+
+    def tree_flatten(self):
+        return (self.byte_table, self.init_mask, self.final_mask), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def scan_bytes(
+    tables: ScanTables,
+    tokens: jax.Array,   # (B, L) int32/uint8
+    lengths: jax.Array,  # (B,) int32
+    state: Optional[jax.Array] = None,  # (B, W) uint32 — streaming carry
+    match: Optional[jax.Array] = None,  # (B, W) uint32 — sticky accumulator
+    unroll: int = 8,
+) -> Tuple[jax.Array, jax.Array]:
+    """Scan a batch of byte rows; returns (match, state) after each row's
+    ``length`` bytes.  Pass the returned ``state``/``match`` back in for the
+    next chunk of the same streams (benchmark config #5)."""
+    B, L = tokens.shape
+    W = tables.n_words
+    if state is None:
+        state = jnp.zeros((B, W), dtype=jnp.uint32)
+    if match is None:
+        match = jnp.zeros((B, W), dtype=jnp.uint32)
+
+    tokens_t = jnp.transpose(tokens.astype(jnp.int32))  # (L, B): scan axis first
+    steps = jnp.arange(L, dtype=jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+
+    init = tables.init_mask[None, :]
+    final = tables.final_mask[None, :]
+
+    def step(carry, xs):
+        S, M = carry
+        bytes_t, t = xs
+        reach = jnp.take(tables.byte_table, bytes_t, axis=0)  # (B, W)
+        S_new = ((S << jnp.uint32(1)) | init) & reach
+        valid = (t < lengths)[:, None]  # (B, 1)
+        S = jnp.where(valid, S_new, S)
+        M = jnp.where(valid, M | (S_new & final), M)
+        return (S, M), None
+
+    (state, match), _ = jax.lax.scan(
+        step, (state, match), (tokens_t, steps), unroll=unroll
+    )
+    return match, state
+
+
+@functools.partial(jax.jit, static_argnames=("unroll",))
+def scan_bytes_jit(tables, tokens, lengths, state=None, match=None, unroll: int = 8):
+    return scan_bytes(tables, tokens, lengths, state, match, unroll)
+
+
+def scan_bytes_reference(tables: ScanTables, data: bytes) -> np.ndarray:
+    """Single-row convenience wrapper (numpy in/out) for tests/debugging."""
+    if len(data) == 0:
+        return np.zeros((tables.n_words,), dtype=np.uint32)
+    tokens = jnp.asarray(np.frombuffer(data, dtype=np.uint8)[None, :])
+    lengths = jnp.asarray([len(data)], dtype=jnp.int32)
+    match, _ = scan_bytes(tables, tokens, lengths)
+    return np.asarray(match[0])
+
+
+def pad_rows(rows: list, max_len: Optional[int] = None, round_to: int = 128
+             ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side helper: pack variable-length byte strings into a padded
+    (B, L) uint8 matrix + lengths.  L is rounded up to ``round_to`` so jit
+    sees few distinct shapes (length-bucketing happens in serve/batcher)."""
+    if not rows:
+        return np.zeros((0, round_to), np.uint8), np.zeros((0,), np.int32)
+    L = max_len or max(1, max(len(r) for r in rows))
+    L = ((L + round_to - 1) // round_to) * round_to
+    out = np.zeros((len(rows), L), dtype=np.uint8)
+    lengths = np.zeros((len(rows),), dtype=np.int32)
+    for i, r in enumerate(rows):
+        r = r[:L]
+        out[i, : len(r)] = np.frombuffer(r, dtype=np.uint8)
+        lengths[i] = len(r)
+    return out, lengths

@@ -1,0 +1,8 @@
+"""Serve-side components: request model, normalization, batching, dispatch.
+
+This package is the boundary the reference implements as the closed-source
+nginx module + sidecar plumbing (SURVEY.md §3.3): requests come in (from the
+C++ sidecar over UDS, or directly via the Python API), are decomposed into
+normalized scan rows, batched with a deadline, dispatched to the TPU engine,
+and verdicts fan back.
+"""

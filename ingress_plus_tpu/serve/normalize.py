@@ -1,0 +1,234 @@
+"""Stream extraction + normalization variants.
+
+The reference's wallarm module parses/decodes requests in-process (URL,
+JSON, XML, base64, gzip unpack — SURVEY.md §3.3 step "parse request →
+decode/unpack").  Here the equivalent: an HTTP request becomes up to
+4 streams × 5 variants of byte rows for the scanner; variant semantics
+match compiler/ruleset.py's soundness contract exactly:
+
+    0 raw         — as received
+    1 urldec      — urlDecodeUni + removeNulls
+    2 urldec_html — urldec + htmlEntityDecode
+    3 squash_raw  — raw minus SQUASH_BYTES
+    4 squash_dec  — urldec_html minus SQUASH_BYTES
+
+Variant rows that equal their parent variant (no %xx present, no entities,
+no squashable bytes) are deduplicated — benign traffic mostly scans 1 row
+per stream.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ingress_plus_tpu.compiler.ruleset import SQUASH_BYTES, VARIANTS
+from ingress_plus_tpu.compiler.seclang import STREAMS, STREAM_INDEX
+
+_HEX = {ord(c): i for i, c in enumerate("0123456789abcdef")}
+for i, c in enumerate("ABCDEF"):
+    _HEX[ord(c)] = 10 + i
+
+_NAMED_ENTITIES = {
+    b"lt": b"<", b"gt": b">", b"amp": b"&", b"quot": b'"', b"apos": b"'",
+    b"nbsp": b" ", b"sol": b"/", b"bsol": b"\\", b"colon": b":",
+    b"semi": b";", b"equals": b"=", b"lpar": b"(", b"rpar": b")",
+}
+
+_SQUASH = frozenset(SQUASH_BYTES)
+
+
+def url_decode_uni(data: bytes) -> bytes:
+    """%XX and %uXXXX decoding (one pass, invalid sequences left intact),
+    plus '+' → space.  Mirrors ModSecurity urlDecodeUni closely enough for
+    the scan variant; the confirm stage uses this same function."""
+    out = bytearray()
+    i, n = 0, len(data)
+    while i < n:
+        b = data[i]
+        if b == 0x2B:  # +
+            out.append(0x20)
+            i += 1
+        elif b == 0x25 and i + 1 < n:  # %
+            nxt = data[i + 1]
+            if nxt in (0x75, 0x55) and i + 5 < n:  # %uXXXX
+                hx = [_HEX.get(data[i + 2 + k]) for k in range(4)]
+                if all(h is not None for h in hx):
+                    code = (hx[0] << 12) | (hx[1] << 8) | (hx[2] << 4) | hx[3]
+                    out.append(code & 0xFF if code > 0xFF else code)
+                    i += 6
+                    continue
+                out.append(b)
+                i += 1
+            elif i + 2 < n or (i + 2 == n):
+                h1 = _HEX.get(data[i + 1]) if i + 1 < n else None
+                h2 = _HEX.get(data[i + 2]) if i + 2 < n else None
+                if h1 is not None and h2 is not None:
+                    out.append((h1 << 4) | h2)
+                    i += 3
+                else:
+                    out.append(b)
+                    i += 1
+            else:
+                out.append(b)
+                i += 1
+        else:
+            out.append(b)
+            i += 1
+    return bytes(out)
+
+
+def html_entity_decode(data: bytes) -> bytes:
+    """&#NN; / &#xHH; / common named entities (one pass)."""
+    out = bytearray()
+    i, n = 0, len(data)
+    while i < n:
+        b = data[i]
+        if b != 0x26:  # &
+            out.append(b)
+            i += 1
+            continue
+        j = data.find(b";", i + 1, i + 10)
+        if j < 0:
+            out.append(b)
+            i += 1
+            continue
+        body = data[i + 1 : j]
+        if body[:1] == b"#":
+            num = body[1:]
+            try:
+                code = int(num[1:], 16) if num[:1] in (b"x", b"X") else int(num)
+                out.append(code & 0xFF)
+                i = j + 1
+                continue
+            except ValueError:
+                pass
+        elif body.lower() in _NAMED_ENTITIES:
+            out += _NAMED_ENTITIES[body.lower()]
+            i = j + 1
+            continue
+        out.append(b)
+        i += 1
+    return bytes(out)
+
+
+def remove_nulls(data: bytes) -> bytes:
+    return data.replace(b"\x00", b"")
+
+
+def squash(data: bytes) -> bytes:
+    """Delete SQUASH_BYTES (whitespace, backslash, quotes, caret)."""
+    return bytes(b for b in data if b not in _SQUASH)
+
+
+def variant_chain(data: bytes, variant: int) -> bytes:
+    """Apply the canonical normalization for a scan variant id."""
+    if variant == 0:
+        return data
+    dec = remove_nulls(url_decode_uni(data))
+    if variant == 1:
+        return dec
+    dec_html = html_entity_decode(dec)
+    if variant == 2:
+        return dec_html
+    if variant == 3:
+        return squash(data)
+    if variant == 4:
+        return squash(dec_html)
+    raise ValueError("unknown variant %d" % variant)
+
+
+@dataclass
+class Request:
+    """Neutral HTTP-request model (what the sidecar ships over UDS)."""
+
+    method: str = "GET"
+    uri: str = "/"
+    headers: Dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+    tenant: int = 0          # EP routing: Ingress/namespace index
+    request_id: str = ""
+
+    def streams(self) -> Dict[str, bytes]:
+        """stream name → raw bytes (the 4 scan streams)."""
+        uri = self.uri.encode("utf-8", "surrogateescape")
+        q = uri.find(b"?")
+        args = uri[q + 1 :] if q >= 0 else b""
+        # Header values are separate match units in ModSecurity; we join
+        # them with \x1f (unit separator): survives every transform chain,
+        # is matched by no rule, and prevents cross-header false adjacency
+        # (\n would trip the CRLF-injection rules on every request).
+        hdr = b"\x1f".join(
+            ("%s: %s" % (k, v)).encode("utf-8", "surrogateescape")
+            for k, v in self.headers.items()
+        )
+        return {"uri": uri, "args": args, "headers": hdr, "body": self.body}
+
+
+@dataclass
+class ScanRow:
+    """One normalized row for the scanner."""
+
+    request_index: int
+    sv: int          # stream_index * len(VARIANTS) + variant
+    data: bytes
+
+
+def rows_for_requests(
+    requests: List[Request],
+    needed_sv: Optional[Iterable[int]] = None,
+    max_row_bytes: int = 1 << 20,
+) -> List[ScanRow]:
+    """Expand requests into deduplicated scan rows.
+
+    ``needed_sv``: stream-variant ids any rule actually uses (from
+    CompiledRuleset.rule_sv_mask) — unused variants are never computed.
+    A variant row identical to an already-emitted lower variant of the same
+    stream is dropped, and the emitted row COVERS the higher sv id too via
+    the engine-side sv mapping... (kept simple here: we emit the variant row
+    only if its bytes differ from the base variant; rules for identical
+    variants are satisfied because identical bytes produce identical match
+    masks, and the pipeline maps rows to sv ids by actual content class).
+    """
+    needed = set(needed_sv) if needed_sv is not None else None
+    rows: List[ScanRow] = []
+    for qi, req in enumerate(requests):
+        for sname, raw in req.streams().items():
+            if not raw:
+                continue
+            raw = raw[:max_row_bytes]
+            si = STREAM_INDEX[sname]
+            cache: Dict[int, bytes] = {}
+            for v in range(len(VARIANTS)):
+                sv = si * len(VARIANTS) + v
+                if needed is not None and sv not in needed:
+                    continue
+                data = variant_chain(raw, v)
+                if not data:
+                    continue
+                cache[v] = data
+                # dedup: identical to the raw (or any earlier) variant →
+                # the earlier row's matches are identical; but sv-masking
+                # differs per rule, so we must still emit a row marker.
+                # We dedup by pointing at identical bytes (cheap: same
+                # object), and the batcher merges identical (req, bytes)
+                # rows while OR-ing their sv bits. Here: emit all, merge
+                # happens in merge_rows().
+                rows.append(ScanRow(request_index=qi, sv=sv, data=data))
+    return rows
+
+
+def merge_rows(rows: List[ScanRow]) -> Tuple[List[bytes], List[int], List[List[int]]]:
+    """Merge rows with identical (request, bytes): scan once, credit all
+    their sv ids.  Returns (data_list, request_index_list, sv_ids_list)."""
+    merged: Dict[Tuple[int, bytes], List[int]] = {}
+    for r in rows:
+        merged.setdefault((r.request_index, r.data), []).append(r.sv)
+    data_list: List[bytes] = []
+    req_list: List[int] = []
+    sv_list: List[List[int]] = []
+    for (qi, data), svs in merged.items():
+        data_list.append(data)
+        req_list.append(qi)
+        sv_list.append(sorted(set(svs)))
+    return data_list, req_list, sv_list

@@ -1,0 +1,1 @@
+"""Utilities: corpus generation, metrics, timing."""

@@ -6,7 +6,9 @@ is exercised on a virtual 8-device CPU mesh so CI needs no TPU.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even if the shell exports JAX_PLATFORMS=axon (the real chip):
+# unit tests must be hermetic; TPU benches live in bench.py, not tests/.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,120 @@
+"""Differential tests: jnp scan engine vs the numpy bitap oracle.
+
+The fake-backend analog from SURVEY.md §4: identical recurrence on CPU
+(JAX_PLATFORMS=cpu via conftest) so CI needs no TPU.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from ingress_plus_tpu.compiler.bitap import reference_scan
+from ingress_plus_tpu.compiler.factors import best_factor_group
+from ingress_plus_tpu.compiler.regex_ast import parse_regex
+from ingress_plus_tpu.compiler.bitap import pack_factors
+from ingress_plus_tpu.ops.scan import ScanTables, pad_rows, scan_bytes
+
+
+PATTERNS = [
+    r"union\s+select",
+    r"(?i)<script[^>]*>",
+    r"\.\./(?:\.\./)*etc/passwd",
+    r"eval\s*\(",
+    r"onerror\s*=",
+    r"/etc/(?:passwd|shadow|group)",
+    r"(?i)x(?:p_cmdshell|p_dirtree)",
+    r"document\.(?:cookie|location)",
+]
+
+
+@pytest.fixture(scope="module")
+def tables():
+    groups = [best_factor_group(parse_regex(p)) for p in PATTERNS]
+    return pack_factors(groups)
+
+
+def corpus(rng, n=60):
+    snippets = [
+        b"1 union select 2", b"<SCRIPT src=x>", b"../../etc/passwd",
+        b"eval (x)", b"<img onerror =a>", b"/etc/shadow", b"XP_CMDSHELL",
+        b"document.cookie",
+    ]
+    out = list(snippets)
+    for _ in range(n):
+        base = bytes(rng.randrange(32, 127) for _ in range(rng.randrange(0, 90)))
+        if rng.random() < 0.5:
+            s = rng.choice(snippets)
+            k = rng.randrange(0, len(base) + 1)
+            base = base[:k] + s + base[k:]
+        out.append(base)
+    return out
+
+
+def test_batch_matches_oracle(tables):
+    st = ScanTables.from_bitap(tables)
+    rng = random.Random(3)
+    rows = corpus(rng)
+    tokens, lengths = pad_rows(rows)
+    match, state = scan_bytes(st, tokens, lengths)
+    match = np.asarray(match)
+    for i, row in enumerate(rows):
+        want = reference_scan(tables, row)
+        assert (match[i] == want).all(), "row %d %r" % (i, row)
+
+
+def test_empty_and_full_padding(tables):
+    st = ScanTables.from_bitap(tables)
+    tokens, lengths = pad_rows([b"", b"/etc/passwd"])
+    match, _ = scan_bytes(st, tokens, lengths)
+    match = np.asarray(match)
+    assert (match[0] == 0).all()
+    assert (match[1] == reference_scan(tables, b"/etc/passwd")).all()
+
+
+def test_streaming_chunks_equal_contiguous(tables):
+    """Chunked scan with state carry == one contiguous scan (config #5)."""
+    st = ScanTables.from_bitap(tables)
+    rng = random.Random(9)
+    rows = corpus(rng, n=20)
+    # contiguous
+    tokens, lengths = pad_rows(rows)
+    want, _ = scan_bytes(st, tokens, lengths)
+    want = np.asarray(want)
+    # chunked: split each row at arbitrary points, carry (state, match)
+    state = match = None
+    n_chunks = 4
+    maxlen = max(len(r) for r in rows)
+    chunk = (maxlen + n_chunks - 1) // n_chunks
+    for c in range(n_chunks):
+        part = [r[c * chunk : (c + 1) * chunk] for r in rows]
+        tokens_c, lengths_c = pad_rows(part, max_len=chunk)
+        got_m, state = scan_bytes(st, tokens_c, lengths_c, state=state, match=match)
+        match = got_m
+    got = np.asarray(match)
+    assert (got == want).all(), "streaming mismatch"
+
+
+def test_match_spanning_chunk_boundary(tables):
+    """An attack split across a chunk boundary must still match."""
+    st = ScanTables.from_bitap(tables)
+    a, b = b"GET /etc/pas", b"swd HTTP/1.1"
+    t1, l1 = pad_rows([a])
+    m, s = scan_bytes(st, t1, l1)
+    t2, l2 = pad_rows([b])
+    m, s = scan_bytes(st, t2, l2, state=s, match=m)
+    want = reference_scan(tables, a + b)
+    assert (np.asarray(m)[0] == want).all()
+    assert np.asarray(m)[0].any(), "boundary-spanning match lost"
+
+
+def test_jit_cache_stable_shapes(tables):
+    import jax
+
+    st = ScanTables.from_bitap(tables)
+    f = jax.jit(scan_bytes)
+    tokens, lengths = pad_rows([b"abc", b"defg"])
+    m1, _ = f(st, tokens, lengths)
+    tokens2, lengths2 = pad_rows([b"/etc/passwd", b"zz"])
+    m2, _ = f(st, tokens2, lengths2)  # same shapes → cached executable
+    assert np.asarray(m2)[0].any()
