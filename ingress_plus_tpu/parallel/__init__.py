@@ -1,0 +1,38 @@
+"""Device-mesh parallelism (SURVEY.md §2.4 — first-class components).
+
+Mapping from the reference's process-level concurrency to mesh axes:
+
+| Strategy | Reference (nginx/Wallarm)            | Here                       |
+|----------|--------------------------------------|----------------------------|
+| DP       | N worker processes, SO_REUSEPORT     | batch rows sharded on the
+|          |                                      | ``data`` mesh axis         |
+| TP       | —                                    | bitap words (ruleset dim)
+|          |                                      | sharded on ``model``; the
+|          |                                      | scan is word-local, only
+|          |                                      | the factor→rule vote needs
+|          |                                      | a psum over ICI            |
+| EP       | per-Ingress rule subsets             | tenant→rule masks applied
+|          |                                      | to the shared superset NFA
+|          |                                      | (no recompile per tenant)  |
+| SP       | streamed body chunks per connection  | sequence-sharded bodies
+|          |                                      | with a 31-byte halo
+|          |                                      | ppermute ring (factors are
+|          |                                      | ≤32 bytes, so the halo is
+|          |                                      | exact — the ring-attention
+|          |                                      | boundary exchange with
+|          |                                      | O(1) state)                |
+| PP       | nginx phase pipeline                 | host pipeline: normalize →
+|          |                                      | scan (TPU) → confirm, with
+|          |                                      | double-buffered dispatch
+|          |                                      | (serve/batcher.py)         |
+
+Comm backend: ICI via XLA collectives inside shard_map (psum, ppermute);
+DCN via jax.distributed for multi-host; host↔TPU via the serve loop's UDS
+protocol (native/sidecar).
+"""
+
+from ingress_plus_tpu.parallel.mesh import make_mesh  # noqa: F401
+from ingress_plus_tpu.parallel.shard import (  # noqa: F401
+    ShardedEngine,
+    shard_ruleset_tables,
+)

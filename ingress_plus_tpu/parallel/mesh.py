@@ -1,0 +1,49 @@
+"""Mesh construction helpers.
+
+One physical mesh, two logical axes:
+  ``data``  — request-batch sharding (DP)
+  ``model`` — bitap-word / ruleset sharding (TP; also carries the EP
+              tenant-shard placement and the SP sequence split when a
+              giant body is scanned cooperatively)
+
+On a single host this maps onto ICI (v5e-8: 2×4); multi-host meshes get the
+DCN dimension outermost, exactly the hybrid the scaling playbook
+prescribes (data-parallel over DCN, model-parallel over ICI).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_model: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ("data", "model") mesh over the available devices.
+
+    Defaults: all devices on the model axis if the ruleset is large
+    (scan cost scales with words), i.e. n_data=1; pass explicit split for
+    throughput-oriented DP layouts.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n_data is None and n_model is None:
+        n_data, n_model = 1, n
+    elif n_data is None:
+        n_data = n // n_model
+    elif n_model is None:
+        n_model = n // n_data
+    if n_data * n_model != n:
+        raise ValueError("mesh %dx%d != %d devices" % (n_data, n_model, n))
+    arr = np.asarray(devices).reshape(n_data, n_model)
+    return Mesh(arr, axis_names=("data", "model"))
+
+
+def mesh_shape(mesh: Mesh) -> Tuple[int, int]:
+    return mesh.shape["data"], mesh.shape["model"]

@@ -1,0 +1,223 @@
+"""Tensor-parallel (ruleset-sharded) detection over a device mesh.
+
+The bitap scan is *word-local*: no cross-word carries exist (bitap.py), so
+sharding the word axis across the ``model`` mesh axis costs zero
+communication in the hot loop.  Each shard scans the same bytes against its
+slice of the byte table, extracts its own factors' hits, and votes partial
+rule hits; one ``psum`` over ICI merges the votes — the verdict OR-reduce
+named in SURVEY.md §2.4.  Batch rows ride the ``data`` axis (DP); tenant
+(EP) masks apply to the merged votes.
+
+Offline, ``shard_ruleset_tables`` re-packs a CompiledRuleset into
+shard-major arrays (padded to uniform per-shard factor counts so shapes are
+static under shard_map).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, N_SV
+from ingress_plus_tpu.compiler.seclang import CLASSES
+from ingress_plus_tpu.ops.scan import scan_bytes
+
+
+@dataclass
+class ShardedTables:
+    """Numpy arrays laid out shard-major for an n_model-way TP split."""
+
+    n_model: int
+    w_shard: int              # words per shard (padded)
+    byte_table: np.ndarray    # (256, n_model * w_shard) uint32
+    init_mask: np.ndarray     # (n_model * w_shard,) uint32
+    final_mask: np.ndarray    # (n_model * w_shard,) uint32
+    factor_word: np.ndarray   # (n_model, f_max) int32 — shard-relative
+    factor_bit: np.ndarray    # (n_model, f_max) uint32
+    factor_rule: np.ndarray   # (n_model, f_max, R) float32 (0-padded)
+    rule_sv: np.ndarray       # (R, N_SV) float32 (replicated)
+    rule_score: np.ndarray    # (R,) float32
+    rule_class: np.ndarray    # (R, C) float32
+    rule_no_prefilter: np.ndarray  # (R,) bool
+
+
+def shard_ruleset_tables(cr: CompiledRuleset, n_model: int,
+                         lane_multiple: int = 8) -> ShardedTables:
+    t = cr.tables
+    W, F, R = t.n_words, t.n_factors, cr.n_rules
+    w_shard = -(-W // n_model)
+    w_shard = -(-w_shard // lane_multiple) * lane_multiple
+    W_pad = w_shard * n_model
+
+    bt = np.zeros((256, W_pad), np.uint32)
+    bt[:, :W] = t.byte_table
+    init = np.zeros((W_pad,), np.uint32)
+    init[:W] = t.init_mask
+    final = np.zeros((W_pad,), np.uint32)
+    final[:W] = t.final_mask
+
+    # factor → owning shard
+    shard_of = t.factor_word // w_shard
+    f_max = max(1, int(np.bincount(shard_of, minlength=n_model).max()))
+    factor_word = np.zeros((n_model, f_max), np.int32)
+    factor_bit = np.zeros((n_model, f_max), np.uint32)
+    factor_rule = np.zeros((n_model, f_max, max(R, 1)), np.float32)
+    fill = np.zeros((n_model,), np.int64)
+    for f in range(F):
+        s = int(shard_of[f])
+        j = int(fill[s])
+        factor_word[s, j] = t.factor_word[f] - s * w_shard
+        factor_bit[s, j] = t.factor_bit[f]
+        lo, hi = t.factor_rule_indptr[f], t.factor_rule_indptr[f + 1]
+        factor_rule[s, j, t.factor_rule_ids[lo:hi]] = 1.0
+        fill[s] += 1
+    # padded factor slots keep word 0 / bit 0 but an all-zero rule map, so
+    # whatever bit they read contributes nothing to the vote.
+
+    onehot = np.zeros((max(R, 1), len(CLASSES)), np.float32)
+    if R:
+        onehot[np.arange(R), cr.rule_class] = 1.0
+    return ShardedTables(
+        n_model=n_model, w_shard=w_shard, byte_table=bt, init_mask=init,
+        final_mask=final, factor_word=factor_word, factor_bit=factor_bit,
+        factor_rule=factor_rule,
+        rule_sv=cr.rule_sv_mask.astype(np.float32),
+        rule_score=cr.rule_score.astype(np.float32),
+        rule_class=onehot,
+        rule_no_prefilter=(t.rule_nfactors == 0),
+    )
+
+
+class ShardedEngine:
+    """DP×TP detection step over a Mesh (the multi-chip flagship program).
+
+    EP: ``tenant_rule_mask`` (T, R) bool — per-tenant rule subsets over the
+    shared superset NFA (benchmark config #4: 256 Ingress tenants).
+    """
+
+    def __init__(self, cr: CompiledRuleset, mesh: Mesh,
+                 tenant_rule_mask: np.ndarray | None = None):
+        self.mesh = mesh
+        n_model = mesh.shape["model"]
+        st = shard_ruleset_tables(cr, n_model)
+        self.st = st
+        if tenant_rule_mask is None:
+            tenant_rule_mask = np.ones((1, max(cr.n_rules, 1)), bool)
+        self.tenant_mask = tenant_rule_mask.astype(np.float32)
+
+        def put(arr, spec):
+            return jax.device_put(arr, NamedSharding(mesh, spec))
+
+        W_pad = st.w_shard * n_model
+        self.d_byte = put(st.byte_table, P(None, "model"))
+        self.d_init = put(st.init_mask, P("model"))
+        self.d_final = put(st.final_mask, P("model"))
+        self.d_fw = put(st.factor_word, P("model", None))
+        self.d_fb = put(st.factor_bit, P("model", None))
+        self.d_fr = put(st.factor_rule, P("model", None, None))
+        self.d_rule_sv = put(st.rule_sv, P(None, None))
+        self.d_score = put(st.rule_score, P(None))
+        self.d_class = put(st.rule_class, P(None, None))
+        self.d_nopf = put(st.rule_no_prefilter, P(None))
+        self.d_tenant = put(self.tenant_mask, P(None, None))
+        self._step = self._build_step()
+
+    def _build_step(self):
+        mesh = self.mesh
+
+        def block(byte_table, init, final, fw, fb, fr, rule_sv, score,
+                  cls_map, nopf, tenant_mask, tokens, lengths, row_req,
+                  row_sv, tenants, num_requests):
+            # shapes inside the block are per-device slices:
+            # byte_table (256, w_shard); fw/fb (1, f_max); fr (1, f_max, R)
+            fw, fb, fr = fw[0], fb[0], fr[0]
+
+            # word-local scan — ZERO communication
+            class _T:  # minimal ScanTables duck-type for scan_bytes
+                n_words = byte_table.shape[1]
+            t = _T()
+            t.byte_table, t.init_mask, t.final_mask = byte_table, init, final
+            t.byte_planes = None
+            match, _ = scan_bytes(t, tokens, lengths, gather="take")
+
+            # local factor hits → partial rule votes
+            mw = jnp.take(match, fw, axis=1)
+            fh = ((mw >> fb) & jnp.uint32(1)).astype(jnp.float32)
+            vote = jnp.dot(fh, fr, preferred_element_type=jnp.float32)
+
+            # ICI: merge votes across ruleset shards (the one collective)
+            vote = jax.lax.psum(vote, axis_name="model")
+            row_rule = vote > 0
+
+            applies = jnp.dot(row_sv.astype(jnp.float32), rule_sv.T,
+                              preferred_element_type=jnp.float32) > 0
+            row_rule = jnp.logical_and(row_rule, applies)
+
+            rule_hits = jax.ops.segment_max(
+                row_rule.astype(jnp.int32), row_req,
+                num_segments=num_requests) > 0
+            req_has_rows = jax.ops.segment_max(
+                applies.astype(jnp.int32), row_req,
+                num_segments=num_requests) > 0
+            rule_hits = jnp.logical_or(
+                rule_hits, jnp.logical_and(req_has_rows, nopf[None, :]))
+
+            # EP: tenant rule-subset masking
+            tmask = jnp.take(tenant_mask, tenants % tenant_mask.shape[0],
+                             axis=0) > 0
+            rule_hits = jnp.logical_and(rule_hits, tmask)
+
+            hits_f = rule_hits.astype(jnp.float32)
+            class_hits = jnp.dot(hits_f, cls_map,
+                                 preferred_element_type=jnp.float32) > 0
+            scores = jnp.dot(hits_f, score,
+                             preferred_element_type=jnp.float32)
+            return rule_hits, class_hits, scores.astype(jnp.int32)
+
+        @functools.partial(jax.jit, static_argnames=("num_requests",))
+        def step(tokens, lengths, row_req, row_sv, tenants, num_requests):
+            fn = shard_map(
+                functools.partial(block, num_requests=num_requests
+                                  // mesh.shape["data"]),
+                mesh=mesh,
+                in_specs=(
+                    P(None, "model"), P("model"), P("model"),      # tables
+                    P("model", None), P("model", None),
+                    P("model", None, None),
+                    P(None, None), P(None), P(None, None), P(None),
+                    P(None, None),                                  # tenant
+                    P("data", None), P("data"), P("data"),
+                    P("data", None), P("data"),
+                ),
+                out_specs=(P("data", None), P("data", None), P("data")),
+                check_vma=False,
+            )
+            return fn(self.d_byte, self.d_init, self.d_final, self.d_fw,
+                      self.d_fb, self.d_fr, self.d_rule_sv, self.d_score,
+                      self.d_class, self.d_nopf, self.d_tenant,
+                      tokens, lengths, row_req, row_sv, tenants)
+
+        return step
+
+    def detect(self, tokens, lengths, row_req, row_sv, tenants,
+               num_requests: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """row_req must hold SHARD-LOCAL request indices (each data shard
+        owns Q/n_data consecutive requests; the serve batcher lays batches
+        out this way).  num_requests is the global request count."""
+        n_data = self.mesh.shape["data"]
+        if num_requests % n_data != 0:
+            raise ValueError(
+                "num_requests=%d not divisible by data-axis size %d — pad "
+                "the batch with empty requests" % (num_requests, n_data))
+        rh, ch, sc = self._step(
+            jnp.asarray(tokens), jnp.asarray(lengths),
+            jnp.asarray(row_req), jnp.asarray(row_sv), jnp.asarray(tenants),
+            num_requests)
+        return np.asarray(rh), np.asarray(ch), np.asarray(sc)

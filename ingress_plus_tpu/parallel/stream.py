@@ -1,0 +1,93 @@
+"""Sequence-parallel streaming scan — the ring-attention analog.
+
+Benchmark config #5: chunked 1MB POST bodies.  Two cooperating modes:
+
+1. **Chunk chaining (single device)** — ops/scan.py already carries
+   (state, match) across chunk calls; serve/streaming.py drives it.  The
+   carried state is O(words) bits, the moral equivalent of ring
+   attention's KV-block handoff but constant-size (SURVEY.md §5).
+
+2. **Sequence sharding (this module)** — a giant body is split along the
+   byte axis across the ``model`` mesh axis; every device scans its slice
+   *plus a halo of the last H-1 bytes of the previous slice*, where
+   H = max factor length ≤ 32.  Because bitap state only ever depends on
+   the last (factor_len - 1) bytes, the halo makes each local scan exact:
+   matches ending in slice s are found by shard s.  Matches ending inside
+   the halo are double-found by the previous shard — harmless, the match
+   mask is a sticky OR.  The halo travels over ICI with one ``ppermute``
+   (the ring); match masks merge with an all_gather + OR (both tiny).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ingress_plus_tpu.ops.scan import ScanTables, scan_bytes
+
+HALO = 32  # ≥ max factor length (bitap.WORD_BITS); exactness bound
+
+
+def ring_scan(tables: ScanTables, mesh: Mesh, tokens,
+              axis: str = "model"):
+    """Scan (B, L_total) byte rows sequence-sharded along ``axis``.
+
+    tokens must be (B, L_total) with L_total divisible by the axis size,
+    and every row is scanned at FULL width — callers pad rows with benign
+    filler themselves or batch equal-length giants only (per-row lengths
+    are deliberately not supported: honoring them across shards would need
+    per-shard masking that this kernel doesn't do).
+    Returns the merged sticky match mask (B, W), replicated.
+    """
+    n = mesh.shape[axis]
+    B, L_total = tokens.shape
+    assert L_total % n == 0, (L_total, n)
+
+    def block(byte_table, init, final, tok):
+        # tok: (B, L_local) slice of the body
+        idx = jax.lax.axis_index(axis)
+        # ring: receive the last HALO bytes of the previous shard
+        halo_src = tok[:, -HALO:]
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        halo = jax.lax.ppermute(halo_src, axis, perm)
+
+        L_local = tok.shape[1]
+        # shard 0 has no predecessor; zero bytes would FALSELY match rules
+        # with \x00 in their classes, so instead shard 0 scans its chunk
+        # left-aligned with masked suffix padding (same static shape).
+        ext_mid = jnp.concatenate([halo, tok], axis=1)
+        ext_zero = jnp.concatenate([tok, jnp.zeros_like(halo)], axis=1)
+        ext = jnp.where(idx == 0, ext_zero, ext_mid)
+        lens = jnp.where(
+            idx == 0,
+            jnp.full((B,), L_local, jnp.int32),
+            jnp.full((B,), L_local + HALO, jnp.int32),
+        )
+
+        class _T:
+            n_words = byte_table.shape[1]
+        t = _T()
+        t.byte_table, t.init_mask, t.final_mask = byte_table, init, final
+        t.byte_planes = None
+        match, _ = scan_bytes(t, ext, lens, gather="take")
+
+        # merge sticky masks: all_gather along the ring + OR-reduce
+        all_m = jax.lax.all_gather(match, axis)          # (n, B, W)
+        merged = all_m[0]
+        for i in range(1, n):
+            merged = merged | all_m[i]
+        return merged
+
+    fn = shard_map(
+        block, mesh=mesh,
+        in_specs=(P(None, None), P(None), P(None), P(None, axis)),
+        out_specs=P(None, None),
+        check_vma=False,
+    )
+    return fn(tables.byte_table, tables.init_mask, tables.final_mask,
+              jnp.asarray(tokens, jnp.int32))

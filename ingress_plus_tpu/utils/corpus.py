@@ -151,6 +151,10 @@ def _attack(rng: random.Random, i: int) -> LabeledRequest:
     cls, payloads = _ATTACKS[rng.randrange(len(_ATTACKS))]
     payload = rng.choice(payloads)
     slot = rng.random()
+    if cls == "rfi" and slot >= 0.9:
+        # a bare URL in a header is not an RFI vector (nothing include()s a
+        # header); keep RFI payloads in parameters/body/path where they attack
+        slot = rng.random() * 0.9
     headers = {"host": "shop.example.com",
                "user-agent": rng.choice(_BENIGN_AGENTS)}
     method, uri, body = "GET", "/", b""

@@ -1,16 +1,23 @@
-"""Test bootstrap: force CPU with 8 virtual devices BEFORE jax import.
+"""Test bootstrap: force CPU with 8 virtual devices.
 
-This is the kind-cluster analog from SURVEY.md §4: multi-chip sharding logic
-is exercised on a virtual 8-device CPU mesh so CI needs no TPU.
+This is the kind-cluster analog from SURVEY.md §4: multi-chip sharding
+logic is exercised on a virtual 8-device CPU mesh so CI needs no TPU.
+
+NOTE: env vars alone are NOT enough here.  The machine's
+/root/.axon_site/sitecustomize.py imports jax at interpreter startup
+(registering the remote-TPU 'axon' plugin), so JAX_PLATFORMS is read long
+before pytest loads this file.  Backends initialize lazily though, so
+updating jax.config before the first computation still wins.
 """
 
 import os
 
-# Force CPU even if the shell exports JAX_PLATFORMS=axon (the real chip):
-# unit tests must be hermetic; TPU benches live in bench.py, not tests/.
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

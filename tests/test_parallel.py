@@ -1,0 +1,135 @@
+"""Multi-chip sharding on the virtual 8-device CPU mesh (the kind-cluster
+analog, SURVEY.md §4): TP ruleset sharding must be bit-identical to the
+single-device engine; SP ring scan must equal a contiguous scan."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+from ingress_plus_tpu.compiler.bitap import reference_scan
+from ingress_plus_tpu.models.engine import DetectionEngine
+from ingress_plus_tpu.ops.scan import ScanTables, pad_rows
+from ingress_plus_tpu.parallel import ShardedEngine, make_mesh
+from ingress_plus_tpu.parallel.stream import ring_scan
+
+
+@pytest.fixture(scope="module")
+def ruleset():
+    return compile_ruleset(load_bundled_rules())
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8, jax.devices()
+
+
+def _mk_batch(ruleset, n_req=8, rows_per_req=2):
+    """Rows laid out data-shard-major: request q's rows are contiguous."""
+    rng = np.random.default_rng(5)
+    payloads = [
+        b"GET /search?q=1' UNION SELECT password FROM users--",
+        b"<script>alert(1)</script>",
+        b"; cat /etc/passwd",
+        b"plain benign text about shoes and prices",
+    ]
+    rows, row_req = [], []
+    for q in range(n_req):
+        for r in range(rows_per_req):
+            rows.append(payloads[(q + r) % len(payloads)])
+            row_req.append(q)
+    tokens, lengths = pad_rows(rows, round_to=64)
+    n_sv = 25  # 5 streams... 4 streams × 5 variants + headroom
+    sv = np.zeros((len(rows), n_sv), np.int8)
+    sv[:, 5:10] = 1  # args stream, every variant (payloads are plain text)
+    return tokens, lengths, np.asarray(row_req, np.int32), sv[:, :20]
+
+
+def test_tp_sharded_equals_single_device(ruleset):
+    mesh = make_mesh(n_data=1, n_model=8)
+    eng = ShardedEngine(ruleset, mesh)
+    tokens, lengths, row_req, row_sv = _mk_batch(ruleset)
+    tenants = np.zeros((8,), np.int32)
+    rh, ch, sc = eng.detect(tokens, lengths, row_req, row_sv, tenants, 8)
+
+    single = DetectionEngine(ruleset)
+    rh1, ch1, sc1 = single.detect(tokens, lengths, row_req, row_sv, 8)
+    assert (rh == rh1).all(), "TP sharded rule hits differ"
+    assert (ch == ch1).all()
+    assert (sc == sc1).all()
+
+
+def test_dp_tp_mesh(ruleset):
+    mesh = make_mesh(n_data=2, n_model=4)
+    eng = ShardedEngine(ruleset, mesh)
+    tokens, lengths, row_req, row_sv = _mk_batch(ruleset)
+    # shard-local request ids: each data shard owns 4 consecutive requests
+    local_req = row_req % 4
+    tenants = np.zeros((8,), np.int32)
+    rh, ch, sc = eng.detect(tokens, lengths, local_req, row_sv, tenants, 8)
+
+    single = DetectionEngine(ruleset)
+    rh1, ch1, sc1 = single.detect(tokens, lengths, row_req, row_sv, 8)
+    assert (rh == rh1).all()
+    assert (sc == sc1).all()
+
+
+def test_ep_tenant_masking(ruleset):
+    """Tenant 0 sees only sqli rules; tenant 1 sees everything."""
+    R = ruleset.n_rules
+    sqli_only = np.zeros((2, R), bool)
+    sqli_only[0] = np.asarray(
+        [m.rule.attack_class == "sqli" for m in ruleset.rules])
+    sqli_only[1] = True
+    mesh = make_mesh(n_data=1, n_model=8)
+    eng = ShardedEngine(ruleset, mesh, tenant_rule_mask=sqli_only)
+    tokens, lengths, row_req, row_sv = _mk_batch(ruleset)
+
+    t0 = np.zeros((8,), np.int32)      # all requests tenant 0
+    rh0, _, _ = eng.detect(tokens, lengths, row_req, row_sv, t0, 8)
+    t1 = np.ones((8,), np.int32)
+    rh1, _, _ = eng.detect(tokens, lengths, row_req, row_sv, t1, 8)
+
+    non_sqli_hits0 = rh0[:, ~sqli_only[0]].sum()
+    assert non_sqli_hits0 == 0, "tenant mask leaked non-sqli rules"
+    assert rh1.sum() >= rh0.sum()
+    # xss request must still hit for tenant 1 but not tenant 0
+    xss_rules = np.asarray(
+        [m.rule.attack_class == "xss" for m in ruleset.rules])
+    assert rh1[:, xss_rules].any()
+    assert not rh0[:, xss_rules].any()
+
+
+def test_sp_ring_scan_equals_contiguous(ruleset):
+    mesh = make_mesh(n_data=1, n_model=8)
+    tables = ScanTables.from_bitap(ruleset.tables)
+    rng = np.random.default_rng(11)
+    B, L = 4, 1024  # 8 shards × 128 bytes
+    tokens = rng.integers(32, 127, size=(B, L), dtype=np.int32)
+    # plant an attack SPANNING the shard boundary at L/8 (byte 128)
+    atk = b"1' UNION SELECT password FROM users--"
+    tokens[0, 120:120 + len(atk)] = np.frombuffer(atk, np.uint8)
+    tokens[1, 1024 - len(atk):] = np.frombuffer(atk, np.uint8)
+
+    merged = np.asarray(ring_scan(tables, mesh, tokens))
+    for i in range(B):
+        want = reference_scan(
+            ruleset.tables, tokens[i].astype(np.uint8).tobytes())
+        got = merged[i][: want.shape[0]]
+        assert (got == want).all(), "ring scan row %d differs" % i
+
+
+def test_sp_boundary_attack_detected(ruleset):
+    """The boundary-spanning attack must appear in the merged mask."""
+    mesh = make_mesh(n_data=1, n_model=8)
+    tables = ScanTables.from_bitap(ruleset.tables)
+    B, L = 1, 256  # 8 shards × 32 bytes — aggressive splitting
+    tokens = np.full((B, L), ord("x"), np.int32)
+    atk = b"/etc/passwd"
+    tokens[0, 30:30 + len(atk)] = np.frombuffer(atk, np.uint8)  # spans 32
+    merged = np.asarray(ring_scan(tables, mesh, tokens))
+    want = reference_scan(ruleset.tables, tokens[0].astype(np.uint8).tobytes())
+    assert want.any()
+    assert (merged[0][: want.shape[0]] == want).all()
