@@ -63,10 +63,11 @@ def main() -> None:
     # padded (B, 512) batch would be ~85% padding.  The serve batcher does
     # the same bucketing online.
     n_sv = cr.rule_sv_mask.shape[1]
+    edges = DetectionPipeline.L_BUCKETS  # identical tiers to production
     buckets = {}
     for i, d in enumerate(data_list):
-        for edge in (64, 128, 256, 512, 1024):
-            if len(d) <= edge or edge == 1024:
+        for edge in edges:
+            if len(d) <= edge or edge == edges[-1]:
                 buckets.setdefault(edge, []).append(i)
                 break
     tables = EngineTables.from_ruleset(cr)
@@ -91,24 +92,28 @@ def main() -> None:
     def detect_k(k: int):
         W = cr.tables.n_words
 
-        def body(i, carries):
+        # The returned value must depend on EVERY bucket's work, or XLA's
+        # while-loop DCE deletes the untouched loop-carry chains and the
+        # benchmark times a fraction of the workload (caught in review).
+        def body(i, carry):
+            acc, states = carry
             out = []
-            acc = jnp.zeros((), jnp.uint32)
             for (tok, lens, rreq, rsv), (state, match) in zip(
-                    device_buckets, carries):
+                    device_buckets, states):
                 rule_hits, class_hits, scores, match, state = detect_rows(
                     tables, tok, lens, rreq, rsv,
                     num_requests=n_req, state=state, match=match)
                 out.append((state, match))
-                acc = acc + match[0, 0]
-            return tuple(out)
+                acc = acc + match.sum() + rule_hits.sum().astype(jnp.uint32)
+            return (acc, tuple(out))
 
-        carries = tuple(
+        states = tuple(
             (jnp.zeros((b[0].shape[0], W), jnp.uint32),
              jnp.zeros((b[0].shape[0], W), jnp.uint32))
             for b in device_buckets)
-        carries = jax.lax.fori_loop(0, k, body, carries)
-        return carries[0][1][0, 0]
+        acc, _ = jax.lax.fori_loop(
+            0, k, body, (jnp.zeros((), jnp.uint32), states))
+        return acc
 
     def timed(k: int) -> float:
         jax.block_until_ready(detect_k(k))

@@ -53,6 +53,7 @@ class PipelineStats:
     row_bytes: int = 0
     prefilter_rule_hits: int = 0
     confirmed_rule_hits: int = 0
+    truncated_rows: int = 0
     fail_open: int = 0
     batches: int = 0
     engine_us: int = 0
@@ -60,6 +61,20 @@ class PipelineStats:
 
 
 class DetectionPipeline:
+    # Fixed length tiers; rows longer than the last tier are TRUNCATED at
+    # 16KB in this batched path (stats.truncated_rows counts them).  The
+    # chunked streaming scan (ops/scan state carry + serve/streaming) is
+    # the intended route for giant bodies; until the serve loop routes
+    # them there automatically, the cap is an explicit detection bound.
+    L_BUCKETS = (64, 128, 256, 512, 2048, 16384)
+
+    @staticmethod
+    def _pad_q(n: int, floor: int = 4) -> int:
+        p = floor
+        while p < n:
+            p *= 2
+        return p
+
     def __init__(
         self,
         ruleset: CompiledRuleset,
@@ -121,22 +136,41 @@ class DetectionPipeline:
         stats.requests += Q
         stats.batches += 1
 
+        R = self.ruleset.n_rules
+        rule_hits = np.zeros((self._pad_q(Q), R), dtype=bool)
         if data_list:
-            tokens, lengths = pad_rows(data_list)
-            row_req = np.asarray(req_list, dtype=np.int32)
             n_sv = len(STREAMS) * len(VARIANTS)
-            row_sv = np.zeros((len(data_list), n_sv), dtype=np.int8)
-            for i, svs in enumerate(sv_list):
-                row_sv[i, svs] = 1
             te0 = time.perf_counter()
-            rule_hits, class_hits, scores = self.engine.detect(
-                tokens, lengths, row_req, row_sv, Q)
+            # Shape stability: jit caches one executable per (B, L, Q)
+            # triple, so rows bucket into fixed L tiers, row counts pad to
+            # powers of two, and Q pads likewise.  Without this every
+            # distinct batch size recompiles — unserveable.
+            by_bucket: Dict[int, List[int]] = {}
+            for i, d in enumerate(data_list):
+                for L in self.L_BUCKETS:
+                    if len(d) <= L or L == self.L_BUCKETS[-1]:
+                        by_bucket.setdefault(L, []).append(i)
+                        break
+            for L, idxs in sorted(by_bucket.items()):
+                B_pad = self._pad_q(len(idxs), floor=8)
+                stats.truncated_rows += sum(
+                    1 for i in idxs if len(data_list[i]) > L)
+                rows_b = [data_list[i][:L] for i in idxs]
+                rows_b += [b""] * (B_pad - len(idxs))
+                tokens, lengths = pad_rows(rows_b, max_len=L, round_to=L)
+                row_req = np.zeros((B_pad,), np.int32)
+                row_req[: len(idxs)] = [req_list[i] for i in idxs]
+                row_req[len(idxs):] = self._pad_q(Q) - 1
+                row_sv = np.zeros((B_pad, n_sv), dtype=np.int8)
+                for j, i in enumerate(idxs):
+                    row_sv[j, sv_list[i]] = 1
+                rh, _, _ = self.engine.detect(
+                    tokens, lengths, row_req, row_sv, self._pad_q(Q))
+                rule_hits |= rh
+                stats.rows += len(idxs)
+                stats.row_bytes += sum(len(r) for r in rows_b)
             stats.engine_us += int((time.perf_counter() - te0) * 1e6)
-            stats.rows += len(data_list)
-            stats.row_bytes += int(lengths.sum())
-        else:
-            R = self.ruleset.n_rules
-            rule_hits = np.zeros((Q, R), dtype=bool)
+        rule_hits = rule_hits[:Q]
 
         # tenant (EP) masking: a tenant only runs its own rule subset
         if self.tenant_rule_mask is not None:

@@ -47,6 +47,10 @@ def ring_scan(tables: ScanTables, mesh: Mesh, tokens,
     n = mesh.shape[axis]
     B, L_total = tokens.shape
     assert L_total % n == 0, (L_total, n)
+    assert L_total // n >= HALO, (
+        "per-shard slice %d < HALO %d: the halo would be short and "
+        "boundary-spanning matches silently lost — use fewer shards or a "
+        "longer body" % (L_total // n, HALO))
 
     def block(byte_table, init, final, tok):
         # tok: (B, L_local) slice of the body

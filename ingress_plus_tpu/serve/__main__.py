@@ -1,0 +1,3 @@
+from ingress_plus_tpu.serve.server import main
+
+main()

@@ -1,0 +1,149 @@
+"""Deadline batcher — where the latency SLO is won or lost (SURVEY.md §7
+hard part #2).
+
+Requests arriving on the serve loop are queued; a dispatch thread drains
+the queue into a batch when either (a) max_batch requests are waiting or
+(b) the oldest request has waited max_delay.  Batches go through the
+DetectionPipeline (TPU scan + CPU confirm) and verdict futures resolve.
+
+Double-buffered dispatch (the PP stage pipeline): while batch N executes
+on device, batch N+1 accumulates — the queue IS the buffer; the dispatch
+thread never sleeps while work is pending.
+
+Fail-open (wallarm-fallback): pipeline errors or a dispatch deadline
+overrun produce pass-and-flag verdicts, never dropped requests.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ingress_plus_tpu.models.pipeline import DetectionPipeline, Verdict
+from ingress_plus_tpu.serve.normalize import Request
+
+
+@dataclass
+class BatcherStats:
+    submitted: int = 0
+    completed: int = 0
+    batches: int = 0
+    max_batch_seen: int = 0
+    queue_delay_us_sum: int = 0
+    batch_us_sum: int = 0
+    # batches that exceeded hard_deadline_s: verdicts were still delivered
+    # (late); the CLIENT side (nginx shim) enforces its own fail-open
+    # budget — this counter is the server-side visibility of overruns.
+    deadline_overruns: int = 0
+
+    def snapshot(self) -> dict:
+        d = self.__dict__.copy()
+        if self.batches:
+            d["avg_batch"] = self.completed / self.batches
+            d["avg_batch_us"] = self.batch_us_sum / self.batches
+        if self.completed:
+            d["avg_queue_delay_us"] = self.queue_delay_us_sum / self.completed
+        return d
+
+
+class Batcher:
+    def __init__(
+        self,
+        pipeline: DetectionPipeline,
+        max_batch: int = 256,
+        max_delay_s: float = 0.0005,
+        hard_deadline_s: float = 0.25,
+    ):
+        self.pipeline = pipeline
+        self.max_batch = max_batch
+        self.max_delay_s = max_delay_s
+        self.hard_deadline_s = hard_deadline_s
+        self.stats = BatcherStats()
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._swap_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ipt-batcher")
+        self._thread.start()
+
+    # ------------------------------------------------------------- API
+
+    def submit(self, request: Request) -> "Future[Verdict]":
+        fut: "Future[Verdict]" = Future()
+        self.stats.submitted += 1
+        self._q.put((time.perf_counter(), request, fut))
+        return fut
+
+    def swap_ruleset(self, ruleset, paranoia_level: int = 2) -> None:
+        """Atomic from the traffic's perspective: the lock covers only the
+        swap itself; in-flight batches finish on the old tables."""
+        with self._swap_lock:
+            self.pipeline.swap_ruleset(ruleset, paranoia_level)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------ loop
+
+    def _drain(self) -> List:
+        """Block for the first item, then collect until max_batch or the
+        first item's deadline."""
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        deadline = first[0] + self.max_delay_s
+        while len(batch) < self.max_batch:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                # deadline hit — but if more are already queued, greedily
+                # take them (they're free: no extra waiting)
+                try:
+                    while len(batch) < self.max_batch:
+                        batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    pass
+                break
+            try:
+                batch.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            batch = self._drain()
+            if not batch:
+                continue
+            t0 = time.perf_counter()
+            sizes = len(batch)
+            self.stats.batches += 1
+            self.stats.max_batch_seen = max(self.stats.max_batch_seen, sizes)
+            for ts, _, _ in batch:
+                self.stats.queue_delay_us_sum += int((t0 - ts) * 1e6)
+            requests = [r for _, r, _ in batch]
+            try:
+                with self._swap_lock:
+                    pass  # barrier: never race a mid-swap pipeline
+                verdicts = self.pipeline.detect(requests)
+            except Exception:
+                verdicts = [
+                    Verdict(request_id=r.request_id, blocked=False,
+                            attack=False, classes=[], rule_ids=[], score=0,
+                            fail_open=True)
+                    for r in requests
+                ]
+            took = time.perf_counter() - t0
+            self.stats.batch_us_sum += int(took * 1e6)
+            if took > self.hard_deadline_s:
+                self.stats.deadline_overruns += len(batch)
+            for (_, _, fut), v in zip(batch, verdicts):
+                if not fut.done():
+                    fut.set_result(v)
+            self.stats.completed += len(batch)

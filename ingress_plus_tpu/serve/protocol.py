@@ -1,0 +1,157 @@
+"""Wire protocol between the native sidecar and the Python serve loop.
+
+The reference ships requests from the nginx module to its engines
+in-process; our split (SURVEY.md §3.3 TPU variant) crosses a process
+boundary: nginx-side C++ shim / sidecar ⇄ UDS ⇄ this serve loop.  gRPC is
+deliberately NOT used — no C++ gRPC toolchain in the build image — so the
+frames are a fixed little-endian layout trivially encoded from C++
+(native/sidecar/protocol.hpp mirrors this file byte-for-byte).
+
+Request frame (client → server):
+    magic   u32  'QTPI' (0x49505451 LE reads "QTPI"... bytes b"QTPI")
+    length  u32  — payload length after this field
+    req_id  u64
+    tenant  u32
+    mode    u8   — 0 off, 1 monitoring, 2 block
+    m_len   u8   — method length
+    uri_len u32
+    hdr_len u32  — headers blob: "key: value\\x1f..." pairs
+    body_len u32
+    bytes: method, uri, headers, body
+
+Response frame (server → client):
+    magic   u32  'RTPI' (b"RTPI")
+    length  u32
+    req_id  u64
+    flags   u8   — bit0 attack, bit1 blocked, bit2 fail_open
+    score   u32
+    n_cls   u8
+    n_rules u16
+    cls ids u8 × n_cls
+    rule ids u64 × n_rules
+
+Responses may arrive out of order; req_id correlates.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ingress_plus_tpu.compiler.seclang import CLASSES
+from ingress_plus_tpu.serve.normalize import Request
+
+REQ_MAGIC = b"QTPI"
+RESP_MAGIC = b"RTPI"
+
+_REQ_HEAD = struct.Struct("<QIBB III")   # req_id tenant mode m_len | uri hdr body
+_RESP_HEAD = struct.Struct("<QBIBH")     # req_id flags score n_cls n_rules
+
+FLAG_ATTACK = 1
+FLAG_BLOCKED = 2
+FLAG_FAIL_OPEN = 4
+
+MAX_FRAME = 8 << 20  # 8MB: bounded memory per connection
+
+
+class ProtocolError(Exception):
+    pass
+
+
+def encode_request(req: Request, req_id: int, mode: int = 2) -> bytes:
+    method = req.method.encode()
+    uri = req.uri.encode("utf-8", "surrogateescape")
+    hdr = b"\x1f".join(
+        ("%s: %s" % (k, v)).encode("utf-8", "surrogateescape")
+        for k, v in req.headers.items())
+    payload = _REQ_HEAD.pack(req_id, req.tenant, mode, len(method),
+                             len(uri), len(hdr), len(req.body))
+    payload += method + uri + hdr + req.body
+    return REQ_MAGIC + struct.pack("<I", len(payload)) + payload
+
+
+def decode_request(payload: bytes) -> Tuple[int, int, Request]:
+    """payload = frame body after magic+length.  Returns (req_id, mode, Request)."""
+    if len(payload) < _REQ_HEAD.size:
+        raise ProtocolError("short request frame")
+    req_id, tenant, mode, m_len, uri_len, hdr_len, body_len = \
+        _REQ_HEAD.unpack_from(payload)
+    off = _REQ_HEAD.size
+    need = off + m_len + uri_len + hdr_len + body_len
+    if len(payload) != need:
+        raise ProtocolError("frame length mismatch: %d != %d"
+                            % (len(payload), need))
+    method = payload[off:off + m_len].decode("ascii", "replace")
+    off += m_len
+    uri = payload[off:off + uri_len].decode("utf-8", "surrogateescape")
+    off += uri_len
+    headers = {}
+    hdr = payload[off:off + hdr_len]
+    off += hdr_len
+    if hdr:
+        for pair in hdr.split(b"\x1f"):
+            k, _, v = pair.partition(b": ")
+            if k:
+                headers[k.decode("utf-8", "surrogateescape")] = \
+                    v.decode("utf-8", "surrogateescape")
+    body = payload[off:off + body_len]
+    return req_id, mode, Request(method=method, uri=uri, headers=headers,
+                                 body=body, tenant=tenant,
+                                 request_id=str(req_id))
+
+
+def encode_response(req_id: int, attack: bool, blocked: bool,
+                    fail_open: bool, score: int, class_ids: List[int],
+                    rule_ids: List[int]) -> bytes:
+    flags = ((FLAG_ATTACK if attack else 0)
+             | (FLAG_BLOCKED if blocked else 0)
+             | (FLAG_FAIL_OPEN if fail_open else 0))
+    payload = _RESP_HEAD.pack(req_id, flags, score & 0xFFFFFFFF,
+                              len(class_ids), len(rule_ids))
+    payload += bytes(class_ids)
+    payload += b"".join(struct.pack("<Q", r) for r in rule_ids)
+    return RESP_MAGIC + struct.pack("<I", len(payload)) + payload
+
+
+def decode_response(payload: bytes):
+    req_id, flags, score, n_cls, n_rules = _RESP_HEAD.unpack_from(payload)
+    off = _RESP_HEAD.size
+    cls = list(payload[off:off + n_cls])
+    off += n_cls
+    rules = [struct.unpack_from("<Q", payload, off + 8 * i)[0]
+             for i in range(n_rules)]
+    return {
+        "req_id": req_id,
+        "attack": bool(flags & FLAG_ATTACK),
+        "blocked": bool(flags & FLAG_BLOCKED),
+        "fail_open": bool(flags & FLAG_FAIL_OPEN),
+        "score": score,
+        "classes": [CLASSES[c] for c in cls if c < len(CLASSES)],
+        "rule_ids": rules,
+    }
+
+
+class FrameReader:
+    """Incremental frame splitter for a byte stream."""
+
+    def __init__(self, magic: bytes):
+        self.magic = magic
+        self.buf = bytearray()
+
+    def feed(self, data: bytes) -> List[bytes]:
+        self.buf += data
+        out = []
+        while True:
+            if len(self.buf) < 8:
+                break
+            if bytes(self.buf[:4]) != self.magic:
+                raise ProtocolError("bad magic %r" % bytes(self.buf[:4]))
+            (length,) = struct.unpack_from("<I", self.buf, 4)
+            if length > MAX_FRAME:
+                raise ProtocolError("frame too large: %d" % length)
+            if len(self.buf) < 8 + length:
+                break
+            out.append(bytes(self.buf[8:8 + length]))
+            del self.buf[:8 + length]
+        return out

@@ -1,0 +1,253 @@
+"""UDS serve loop + observability endpoints.
+
+The dispatcher process of SURVEY.md §7: accepts framed requests from the
+native sidecar over a unix socket, batches them (batcher.py), and fans
+verdicts back (out-of-order, correlated by req_id).  A small HTTP listener
+exposes ``/metrics`` (Prometheus text format — the SocketCollector /
+collectd analog) and ``/healthz`` (the k8s probe / fail-open watchdog
+analog, SURVEY.md §5).
+
+Run:  python -m ingress_plus_tpu.serve --socket /tmp/ipt.sock \
+          [--http-port 9901] [--mode block] [--rules-dir ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+import sys
+import time
+from pathlib import Path
+from typing import Optional
+
+from ingress_plus_tpu.serve.batcher import Batcher
+from ingress_plus_tpu.serve.protocol import (
+    REQ_MAGIC,
+    FrameReader,
+    ProtocolError,
+    decode_request,
+    encode_response,
+)
+
+
+class ServeLoop:
+    def __init__(self, batcher: Batcher, socket_path: str,
+                 http_port: int = 0):
+        self.batcher = batcher
+        self.socket_path = socket_path
+        self.http_port = http_port
+        self.started = time.time()
+        self.connections = 0
+        self._servers = []
+
+    # ------------------------------------------------------- UDS plane
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        self.connections += 1
+        frames = FrameReader(REQ_MAGIC)
+        loop = asyncio.get_running_loop()
+        write_lock = asyncio.Lock()
+        classes_index = {c: i for i, c in enumerate(
+            self.batcher.pipeline.ruleset.classes)}
+
+        async def respond(req_id: int, verdict) -> None:
+            data = encode_response(
+                req_id, verdict.attack, verdict.blocked, verdict.fail_open,
+                verdict.score,
+                [classes_index[c] for c in verdict.classes],
+                verdict.rule_ids)
+            async with write_lock:
+                writer.write(data)
+                await writer.drain()
+
+        pending = set()
+        try:
+            while True:
+                data = await reader.read(1 << 16)
+                if not data:
+                    break
+                try:
+                    payloads = frames.feed(data)
+                except ProtocolError:
+                    break  # corrupt stream: drop the connection
+                for payload in payloads:
+                    try:
+                        req_id, mode, request = decode_request(payload)
+                    except ProtocolError:
+                        continue
+                    fut = self.batcher.submit(request)
+                    afut = asyncio.wrap_future(fut, loop=loop)
+                    task = asyncio.ensure_future(afut)
+                    pending.add(task)
+
+                    def _done(t, req_id=req_id):
+                        pending.discard(t)
+                        if not t.cancelled() and t.exception() is None:
+                            asyncio.ensure_future(respond(req_id, t.result()))
+                    task.add_done_callback(_done)
+        finally:
+            for t in pending:
+                t.cancel()
+            writer.close()
+            self.connections -= 1
+
+    # ------------------------------------------------------ HTTP plane
+
+    def _metrics_text(self) -> str:
+        s = self.batcher.stats
+        p = self.batcher.pipeline.stats
+        lines = [
+            "# TYPE ipt_requests_total counter",
+            "ipt_requests_total %d" % s.completed,
+            "# TYPE ipt_batches_total counter",
+            "ipt_batches_total %d" % s.batches,
+            "# TYPE ipt_queue_delay_us_sum counter",
+            "ipt_queue_delay_us_sum %d" % s.queue_delay_us_sum,
+            "# TYPE ipt_batch_us_sum counter",
+            "ipt_batch_us_sum %d" % s.batch_us_sum,
+            "# TYPE ipt_max_batch gauge",
+            "ipt_max_batch %d" % s.max_batch_seen,
+            "# TYPE ipt_fail_open_total counter",
+            "ipt_fail_open_total %d" % p.fail_open,
+            "# TYPE ipt_deadline_overruns_total counter",
+            "ipt_deadline_overruns_total %d" % s.deadline_overruns,
+            "# TYPE ipt_scan_rows_total counter",
+            "ipt_scan_rows_total %d" % p.rows,
+            "# TYPE ipt_scan_bytes_total counter",
+            "ipt_scan_bytes_total %d" % p.row_bytes,
+            "# TYPE ipt_prefilter_hits_total counter",
+            "ipt_prefilter_hits_total %d" % p.prefilter_rule_hits,
+            "# TYPE ipt_confirmed_hits_total counter",
+            "ipt_confirmed_hits_total %d" % p.confirmed_rule_hits,
+            "# TYPE ipt_ruleset_info gauge",
+            'ipt_ruleset_info{version="%s",rules="%d"} 1'
+            % (self.batcher.pipeline.ruleset.version,
+               self.batcher.pipeline.ruleset.n_rules),
+        ]
+        return "\n".join(lines) + "\n"
+
+    async def _handle_http(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            line = await asyncio.wait_for(reader.readline(), timeout=5)
+            path = line.split()[1].decode() if len(line.split()) > 1 else "/"
+            while (await reader.readline()).strip():
+                pass
+            if path.startswith("/healthz"):
+                body = json.dumps({
+                    "status": "ok",
+                    "uptime_s": round(time.time() - self.started, 1),
+                    "ruleset": self.batcher.pipeline.ruleset.version,
+                }).encode()
+                ctype = "application/json"
+            elif path.startswith("/metrics"):
+                body = self._metrics_text().encode()
+                ctype = "text/plain; version=0.0.4"
+            else:
+                writer.write(b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n")
+                await writer.drain()
+                return
+            writer.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: " + ctype.encode()
+                + b"\r\nContent-Length: " + str(len(body)).encode()
+                + b"\r\nConnection: close\r\n\r\n" + body)
+            await writer.drain()
+        except (asyncio.TimeoutError, IndexError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    # ------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        Path(self.socket_path).unlink(missing_ok=True)
+        self._servers.append(await asyncio.start_unix_server(
+            self._handle_conn, path=self.socket_path))
+        if self.http_port:
+            self._servers.append(await asyncio.start_server(
+                self._handle_http, host="127.0.0.1", port=self.http_port))
+
+    async def run_forever(self) -> None:
+        await self.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        print("serving on %s (http %s), ruleset %s"
+              % (self.socket_path, self.http_port or "off",
+                 self.batcher.pipeline.ruleset.version), file=sys.stderr)
+        await stop.wait()
+        for s in self._servers:
+            s.close()
+        self.batcher.close()
+
+
+def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
+                          max_batch: int = 256,
+                          max_delay_s: float = 0.0005,
+                          warmup: bool = True) -> Batcher:
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import load_seclang_dir
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+
+    rules = (load_seclang_dir(rules_dir) if rules_dir
+             else load_bundled_rules())
+    pipeline = DetectionPipeline(compile_ruleset(rules), mode=mode)
+    if warmup:
+        warmup_pipeline(pipeline, max_batch)
+    return Batcher(pipeline, max_batch=max_batch, max_delay_s=max_delay_s)
+
+
+def warmup_pipeline(pipeline, max_batch: int) -> None:
+    """Pre-compile the (B, L, Q) shapes live traffic will hit, so the
+    first real requests don't pay multi-second jit compiles (the analog of
+    nginx testing its config before swapping workers in)."""
+    import time as _t
+
+    from ingress_plus_tpu.utils.corpus import generate_corpus
+
+    t0 = _t.time()
+    reqs = [lr.request for lr in generate_corpus(n=max_batch, seed=1)]
+    for size in {1, 4, min(32, max_batch), max_batch}:
+        pipeline.detect(reqs[:size])
+    print("warmup: compiled serve shapes in %.1fs" % (_t.time() - t0),
+          file=sys.stderr)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="ingress_plus_tpu.serve")
+    ap.add_argument("--socket", default="/tmp/ingress_plus_tpu.sock")
+    ap.add_argument("--http-port", type=int, default=9901)
+    ap.add_argument("--mode", default="block",
+                    choices=["off", "monitoring", "block"])
+    ap.add_argument("--rules-dir", default=None)
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--max-delay-us", type=int, default=500)
+    ap.add_argument("--platform", default=None,
+                    help="jax platform override (e.g. cpu) — this dev "
+                         "box's TPU sits behind a ~70ms tunnel, so "
+                         "latency-sensitive serving may prefer cpu")
+    ap.add_argument("--no-warmup", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    batcher = build_default_batcher(
+        mode=args.mode, rules_dir=args.rules_dir, max_batch=args.max_batch,
+        max_delay_s=args.max_delay_us / 1e6, warmup=not args.no_warmup)
+    loop = ServeLoop(batcher, args.socket, args.http_port)
+    asyncio.run(loop.run_forever())
+
+
+if __name__ == "__main__":
+    main()

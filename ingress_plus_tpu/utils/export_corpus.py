@@ -1,0 +1,34 @@
+"""Export the labeled corpus as pre-encoded request frames for loadgen.
+
+Usage:
+    python -m ingress_plus_tpu.utils.export_corpus out.bin [n] [seed]
+
+The native load generator (native/sidecar/loadgen.cc) replays these frames
+over the serve-loop UDS — the wrk2-corpus-replay analog of BASELINE
+config #1.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ingress_plus_tpu.serve.protocol import encode_request
+from ingress_plus_tpu.utils.corpus import generate_corpus
+
+
+def export(path: str, n: int = 10_000, seed: int = 20260729,
+           attack_fraction: float = 0.2, tenants: int = 1) -> int:
+    corpus = generate_corpus(n=n, attack_fraction=attack_fraction,
+                             seed=seed, tenants=tenants)
+    with open(path, "wb") as f:
+        for i, lr in enumerate(corpus):
+            f.write(encode_request(lr.request, req_id=i + 1))
+    return len(corpus)
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "corpus.bin"
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 20260729
+    count = export(out, n=n, seed=seed)
+    print("wrote %d request frames to %s" % (count, out))

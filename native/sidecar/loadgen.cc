@@ -1,0 +1,203 @@
+// loadgen — wrk2-style replayer for the ingress_plus_tpu serve loop.
+//
+// The reference measures its data plane with wrk2 replaying a labeled
+// request corpus through nginx (SURVEY.md §4, BASELINE config #1).  This
+// is that harness for our split architecture: it plays pre-encoded
+// request frames (utils/export_corpus.py) over N unix-socket connections
+// with a bounded in-flight window per connection, and reports throughput
+// + latency percentiles + verdict counts as one JSON line.
+//
+// Single-threaded epoll (the build host has 1 core; the serve loop is the
+// thing under test).  Build: make -C native/sidecar
+//
+// Usage: loadgen --socket /tmp/ipt.sock --corpus corpus.bin
+//                [--connections 8] [--inflight 32] [--requests 10000]
+
+#include <errno.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "protocol.hpp"
+
+namespace {
+
+uint64_t NowNs() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+}
+
+struct Conn {
+  int fd = -1;
+  ipt::FrameReader reader;
+  std::string outbuf;
+  size_t out_off = 0;
+  int inflight = 0;
+};
+
+struct Options {
+  std::string socket_path = "/tmp/ingress_plus_tpu.sock";
+  std::string corpus_path;
+  int connections = 8;
+  int inflight = 32;
+  long total_requests = 10000;
+};
+
+std::vector<std::string> LoadCorpusFrames(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) { perror("corpus open"); exit(2); }
+  std::string all;
+  char buf[1 << 16];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof buf, f)) > 0) all.append(buf, n);
+  fclose(f);
+  std::vector<std::string> frames;
+  size_t off = 0;
+  while (all.size() - off >= 8) {
+    if (memcmp(all.data() + off, ipt::kReqMagic, 4) != 0) {
+      fprintf(stderr, "corpus corrupt at %zu\n", off);
+      exit(2);
+    }
+    uint32_t len;
+    memcpy(&len, all.data() + off + 4, 4);
+    if (all.size() - off < 8ull + len) break;
+    frames.emplace_back(all.substr(off, 8ull + len));
+    off += 8ull + len;
+  }
+  if (frames.empty()) { fprintf(stderr, "empty corpus\n"); exit(2); }
+  return frames;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options opt;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* {
+      if (i + 1 >= argc) { fprintf(stderr, "missing value for %s\n", a.c_str()); exit(2); }
+      return argv[++i];
+    };
+    if (a == "--socket") opt.socket_path = next();
+    else if (a == "--corpus") opt.corpus_path = next();
+    else if (a == "--connections") opt.connections = atoi(next());
+    else if (a == "--inflight") opt.inflight = atoi(next());
+    else if (a == "--requests") opt.total_requests = atol(next());
+    else { fprintf(stderr, "unknown arg %s\n", a.c_str()); return 2; }
+  }
+  if (opt.corpus_path.empty()) { fprintf(stderr, "--corpus required\n"); return 2; }
+
+  std::vector<std::string> corpus = LoadCorpusFrames(opt.corpus_path);
+
+  int ep = epoll_create1(0);
+  std::vector<Conn> conns(opt.connections);
+  for (int c = 0; c < opt.connections; ++c) {
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, opt.socket_path.c_str(), sizeof(addr.sun_path) - 1);
+    if (connect(fd, (sockaddr*)&addr, sizeof addr) != 0) {
+      perror("connect"); return 3;
+    }
+    fcntl(fd, F_SETFL, O_NONBLOCK);
+    conns[c].fd = fd;
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT;
+    ev.data.u32 = c;
+    epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev);
+  }
+
+  std::unordered_map<uint64_t, uint64_t> sent_ns;
+  sent_ns.reserve(opt.connections * opt.inflight * 2);
+  std::vector<uint64_t> latencies_ns;
+  latencies_ns.reserve(opt.total_requests);
+  long sent = 0, received = 0;
+  long attacks = 0, blocked = 0, fail_open = 0;
+  uint64_t next_id = 1;
+  uint64_t t_start = NowNs();
+
+  auto pump_one = [&](Conn& c) {
+    // enqueue new requests while under the in-flight window
+    while (c.inflight < opt.inflight && sent < opt.total_requests) {
+      std::string frame = corpus[sent % corpus.size()];
+      uint64_t id = next_id++;
+      memcpy(&frame[8], &id, 8);  // re-id: payload starts at offset 8
+      sent_ns[id] = NowNs();
+      c.outbuf += frame;
+      ++c.inflight;
+      ++sent;
+    }
+    // flush pending writes
+    while (c.out_off < c.outbuf.size()) {
+      ssize_t n = write(c.fd, c.outbuf.data() + c.out_off,
+                        c.outbuf.size() - c.out_off);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        perror("write"); exit(4);
+      }
+      c.out_off += size_t(n);
+    }
+    if (c.out_off == c.outbuf.size()) { c.outbuf.clear(); c.out_off = 0; }
+  };
+
+  epoll_event events[64];
+  while (received < opt.total_requests) {
+    int nev = epoll_wait(ep, events, 64, 1000);
+    if (nev < 0) { if (errno == EINTR) continue; perror("epoll"); return 4; }
+    if (nev == 0 && sent == received) continue;
+    for (int i = 0; i < nev; ++i) {
+      Conn& c = conns[events[i].data.u32];
+      if (events[i].events & EPOLLIN) {
+        uint8_t buf[1 << 16];
+        ssize_t n;
+        while ((n = read(c.fd, buf, sizeof buf)) > 0) {
+          c.reader.Feed(buf, size_t(n), [&](const uint8_t* p, size_t len) {
+            ipt::Response r = ipt::DecodeResponse(p, len);
+            auto it = sent_ns.find(r.req_id);
+            if (it != sent_ns.end()) {
+              latencies_ns.push_back(NowNs() - it->second);
+              sent_ns.erase(it);
+            }
+            if (r.attack()) ++attacks;
+            if (r.blocked()) ++blocked;
+            if (r.fail_open()) ++fail_open;
+            ++received;
+            --c.inflight;
+          });
+        }
+        if (n == 0) { fprintf(stderr, "server closed connection\n"); return 5; }
+      }
+      pump_one(c);
+    }
+  }
+  uint64_t t_end = NowNs();
+
+  std::sort(latencies_ns.begin(), latencies_ns.end());
+  auto pct = [&](double p) -> double {
+    if (latencies_ns.empty()) return 0;
+    size_t idx = size_t(p * (latencies_ns.size() - 1));
+    return latencies_ns[idx] / 1e3;  // µs
+  };
+  double secs = (t_end - t_start) / 1e9;
+  printf(
+      "{\"requests\": %ld, \"seconds\": %.3f, \"rps\": %.1f, "
+      "\"p50_us\": %.0f, \"p90_us\": %.0f, \"p99_us\": %.0f, "
+      "\"p999_us\": %.0f, \"attacks\": %ld, \"blocked\": %ld, "
+      "\"fail_open\": %ld}\n",
+      received, secs, received / secs, pct(0.50), pct(0.90), pct(0.99),
+      pct(0.999), attacks, blocked, fail_open);
+  return 0;
+}

@@ -1,0 +1,132 @@
+// Wire protocol for the ingress_plus_tpu serve loop — C++ twin of
+// ingress_plus_tpu/serve/protocol.py (byte-for-byte; see that file for the
+// frame layouts and the reasons this is a fixed little-endian format
+// rather than gRPC).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ipt {
+
+constexpr uint32_t kMaxFrame = 8u << 20;
+inline const char kReqMagic[4] = {'Q', 'T', 'P', 'I'};
+inline const char kRespMagic[4] = {'R', 'T', 'P', 'I'};
+
+enum Flags : uint8_t {
+  kAttack = 1,
+  kBlocked = 2,
+  kFailOpen = 4,
+};
+
+struct Request {
+  uint64_t req_id = 0;
+  uint32_t tenant = 0;
+  uint8_t mode = 2;  // 0 off, 1 monitoring, 2 block
+  std::string method = "GET";
+  std::string uri = "/";
+  // headers are shipped pre-joined: "key: value\x1f key: value"
+  std::string headers_blob;
+  std::string body;
+};
+
+struct Response {
+  uint64_t req_id = 0;
+  uint8_t flags = 0;
+  uint32_t score = 0;
+  std::vector<uint8_t> class_ids;
+  std::vector<uint64_t> rule_ids;
+
+  bool attack() const { return flags & kAttack; }
+  bool blocked() const { return flags & kBlocked; }
+  bool fail_open() const { return flags & kFailOpen; }
+};
+
+namespace detail {
+template <typename T>
+inline void put(std::string* out, T v) {
+  char buf[sizeof(T)];
+  std::memcpy(buf, &v, sizeof(T));  // assumes little-endian host
+  out->append(buf, sizeof(T));
+}
+template <typename T>
+inline T get(const uint8_t* p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));
+  return v;
+}
+}  // namespace detail
+
+inline std::string EncodeRequest(const Request& r) {
+  std::string payload;
+  payload.reserve(22 + r.method.size() + r.uri.size() +
+                  r.headers_blob.size() + r.body.size());
+  detail::put<uint64_t>(&payload, r.req_id);
+  detail::put<uint32_t>(&payload, r.tenant);
+  payload.push_back(static_cast<char>(r.mode));
+  payload.push_back(static_cast<char>(r.method.size()));
+  detail::put<uint32_t>(&payload, static_cast<uint32_t>(r.uri.size()));
+  detail::put<uint32_t>(&payload,
+                        static_cast<uint32_t>(r.headers_blob.size()));
+  detail::put<uint32_t>(&payload, static_cast<uint32_t>(r.body.size()));
+  payload += r.method;
+  payload += r.uri;
+  payload += r.headers_blob;
+  payload += r.body;
+
+  std::string frame;
+  frame.reserve(8 + payload.size());
+  frame.append(kReqMagic, 4);
+  detail::put<uint32_t>(&frame, static_cast<uint32_t>(payload.size()));
+  frame += payload;
+  return frame;
+}
+
+inline Response DecodeResponse(const uint8_t* p, size_t n) {
+  if (n < 16) throw std::runtime_error("short response frame");
+  Response r;
+  r.req_id = detail::get<uint64_t>(p);
+  r.flags = p[8];
+  r.score = detail::get<uint32_t>(p + 9);
+  uint8_t n_cls = p[13];
+  uint16_t n_rules = detail::get<uint16_t>(p + 14);
+  size_t off = 16;
+  if (n < off + n_cls + 8ull * n_rules)
+    throw std::runtime_error("truncated response frame");
+  r.class_ids.assign(p + off, p + off + n_cls);
+  off += n_cls;
+  r.rule_ids.resize(n_rules);
+  for (uint16_t i = 0; i < n_rules; ++i)
+    r.rule_ids[i] = detail::get<uint64_t>(p + off + 8ull * i);
+  return r;
+}
+
+// Incremental splitter for the response stream.
+class FrameReader {
+ public:
+  // Appends data; invokes cb(payload, len) per complete frame.
+  template <typename Cb>
+  void Feed(const uint8_t* data, size_t n, Cb cb) {
+    buf_.insert(buf_.end(), data, data + n);
+    size_t off = 0;
+    while (buf_.size() - off >= 8) {
+      if (std::memcmp(buf_.data() + off, kRespMagic, 4) != 0)
+        throw std::runtime_error("bad response magic");
+      uint32_t len = detail::get<uint32_t>(buf_.data() + off + 4);
+      if (len > kMaxFrame) throw std::runtime_error("oversized frame");
+      if (buf_.size() - off < 8ull + len) break;
+      cb(buf_.data() + off + 8, len);
+      off += 8ull + len;
+    }
+    buf_.erase(buf_.begin(), buf_.begin() + off);
+  }
+
+ private:
+  std::vector<uint8_t> buf_;
+};
+
+}  // namespace ipt
