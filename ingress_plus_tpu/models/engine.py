@@ -161,3 +161,13 @@ class DetectionEngine:
             jnp.asarray(row_req), jnp.asarray(row_sv), num_requests)
         return (np.asarray(rule_hits), np.asarray(class_hits),
                 np.asarray(scores))
+
+    def detect_device(self, tokens, lengths, row_req, row_sv,
+                      num_requests: int):
+        """Async variant: returns the (Q, R) rule-hit device array without
+        blocking, so callers can dispatch several buckets back-to-back and
+        materialize afterwards (one sync per batch, not per bucket)."""
+        rule_hits, _, _, _, _ = detect_rows_jit(
+            self.tables, jnp.asarray(tokens), jnp.asarray(lengths),
+            jnp.asarray(row_req), jnp.asarray(row_sv), num_requests)
+        return rule_hits

@@ -151,6 +151,10 @@ class DetectionPipeline:
                     if len(d) <= L or L == self.L_BUCKETS[-1]:
                         by_bucket.setdefault(L, []).append(i)
                         break
+            # Dispatch every bucket before materializing any result: XLA
+            # dispatch is async, so the device pipelines the bucket scans
+            # back-to-back instead of paying one host sync per bucket.
+            dispatched = []
             for L, idxs in sorted(by_bucket.items()):
                 B_pad = self._pad_q(len(idxs), floor=8)
                 stats.truncated_rows += sum(
@@ -164,11 +168,12 @@ class DetectionPipeline:
                 row_sv = np.zeros((B_pad, n_sv), dtype=np.int8)
                 for j, i in enumerate(idxs):
                     row_sv[j, sv_list[i]] = 1
-                rh, _, _ = self.engine.detect(
-                    tokens, lengths, row_req, row_sv, self._pad_q(Q))
-                rule_hits |= rh
+                dispatched.append(self.engine.detect_device(
+                    tokens, lengths, row_req, row_sv, self._pad_q(Q)))
                 stats.rows += len(idxs)
                 stats.row_bytes += sum(len(r) for r in rows_b)
+            for rh_dev in dispatched:
+                rule_hits |= np.asarray(rh_dev)
             stats.engine_us += int((time.perf_counter() - te0) * 1e6)
         rule_hits = rule_hits[:Q]
 
@@ -197,7 +202,11 @@ class DetectionPipeline:
                 {CLASSES[rs.rule_class[r]] for r in confirmed})
             attack = bool(confirmed) and score >= self.anomaly_threshold
             deny = any(rs.rule_action[r] == 2 for r in confirmed)
-            blocked = (self.mode == "block") and (attack or deny)
+            # per-request mode (the wallarm_mode location directive shipped
+            # in the frame) can only weaken the global mode, mirroring
+            # wallarm-mode-allow-override's default policy
+            eff_block = self.mode == "block" and getattr(req, "mode", 2) >= 2
+            blocked = eff_block and (attack or deny)
             verdicts.append(Verdict(
                 request_id=req.request_id,
                 blocked=blocked,

@@ -6,8 +6,15 @@ Two interchangeable implementations of the same bitap recurrence
 - ``scan.py``         — pure jnp/XLA: `lax.scan` over byte steps, gather for
   the byte table.  Runs anywhere (CPU tests, TPU), is the reference
   implementation, and is what multi-chip sharding wraps.
-- ``pallas_scan.py``  — hand-written Pallas TPU kernel: byte table resident
-  in VMEM, grid over batch tiles, double-buffered HBM→VMEM byte streaming.
+- ``pallas_scan.py``  — hand-written Pallas TPU kernel: MXU one-hot reach
+  precompute into VMEM scratch + serial VPU shift-AND chain with state
+  resident in VMEM and early exit on ragged tiles.
+
+Measured on v5e (full 1.4k-rule corpus, W=291, see utils/microbench.py):
+XLA `take` ≈ 200 MB/s, Pallas ≈ 163 MB/s (TB=256, CL=8) — both near
+VPU-bound on the (B, W) recurrence; XLA's gather lowering wins, so
+``scan.py`` is the serving default and the kernel is kept as the
+hand-scheduled alternative (it wins on ragged batches via early exit).
 
 Both expose scan(tokens, lengths, state) → (match, state) so streaming
 chunked bodies (benchmark config #5) carry the NFA state vector across

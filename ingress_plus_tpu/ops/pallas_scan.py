@@ -1,0 +1,225 @@
+"""Pallas TPU kernel for the batched bitap scan.
+
+Same contract as ops/scan.py::scan_bytes — this is the hand-scheduled
+version of the hot loop (the reference's per-byte libproton automaton scan,
+SURVEY.md §3.3 hot loop #2).  What the kernel does that the XLA lax.scan
+lowering can't:
+
+- **Decoupled gather.** The serial dependency (S' depends on S) forces one
+  step per input byte, and XLA re-gathers B[byte] from the (256, W) table
+  inside every step.  Here the reach masks for a whole CL-byte chunk are
+  computed up front on the MXU — one-hot(bytes) @ byte-planes in bf16
+  (values ≤255 are exact) — and the serial chain then runs as pure VPU
+  element-wise ops against VMEM scratch.
+- **Early exit on ragged batches.** The serial loop bound is the *tile's*
+  max row length (read on-chip), so a tile of short rows skips its padded
+  tail entirely; XLA's scan always walks the full padded length.
+- **State residency.** (state, match) live in the output VMEM blocks across
+  the whole length axis (grid dim 1 is sequential), so HBM sees each token
+  byte once and each state word twice.
+
+Mosaic note: in-kernel reshapes like (TB, CL)→(CL·TB, 1) are unsupported
+shape casts, so the position-major column layout is produced *outside* the
+kernel by XLA (cheap fused transpose) and block-indexed directly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ingress_plus_tpu.ops.scan import ScanTables
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _scan_kernel(toks_pm_ref, lens_ref, planes_ref, init_ref, final_ref,
+                 state_in_ref, match_in_ref, match_ref, state_ref,
+                 reach_ref, *, CL: int, TB: int, MR: int, Wp: int):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _():
+        state_ref[:] = state_in_ref[:]
+        match_ref[:] = match_in_ref[:]
+
+    t_max = jnp.max(lens_ref[:])      # tile's longest row
+    t_rem = t_max - k * CL            # bytes of real work left in this chunk
+
+    @pl.when(t_rem > 0)
+    def _():
+        # ---- stage 1: reach masks for every (position, row) via MXU ------
+        # toks_pm rows are position-major: row t*TB + r  ⇒  byte t of row r.
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (MR, 256), 1)
+        for j in range(CL * TB // MR):
+            @pl.when(j * (MR // TB) < t_rem)
+            def _():
+                sub = toks_pm_ref[pl.ds(j * MR, MR), :]       # (MR, 1)
+                onehot = (sub == lanes).astype(jnp.bfloat16)
+                planes = jnp.dot(onehot, planes_ref[:],
+                                 preferred_element_type=jnp.float32)
+                p = planes.astype(jnp.int32)
+                reach = (p[:, 0 * Wp:1 * Wp]
+                         | (p[:, 1 * Wp:2 * Wp] << 8)
+                         | (p[:, 2 * Wp:3 * Wp] << 16)
+                         | (p[:, 3 * Wp:4 * Wp] << 24))
+                reach_ref[pl.ds(j * MR, MR), :] = reach
+
+        # ---- stage 2: serial shift-AND chain on the VPU ------------------
+        init = init_ref[:]                                    # (1, Wp)
+        final = final_ref[:]
+        lens = lens_ref[:]                                    # (TB, 1)
+
+        def step(t, carry):
+            S, M = carry
+            reach = reach_ref[pl.ds(t * TB, TB), :]           # (TB, Wp)
+            S_new = ((S << 1) | init) & reach
+            valid = (k * CL + t) < lens                       # (TB, 1)
+            S = jnp.where(valid, S_new, S)
+            M = jnp.where(valid, M | (S_new & final), M)
+            return (S, M)
+
+        S, M = jax.lax.fori_loop(0, jnp.minimum(CL, t_rem), step,
+                                 (state_ref[:], match_ref[:]))
+        state_ref[:] = S
+        match_ref[:] = M
+
+
+@functools.partial(
+    jax.jit, static_argnames=("TB", "CL", "MR", "interpret"))
+def _pallas_scan(tokens, lengths, planes, init, final, state, match,
+                 TB: int, CL: int, MR: int, interpret: bool):
+    """tokens (B, L) int32 padded to tile multiples; lengths (B, 1) int32;
+    state/match (B, Wp) int32.  Returns (match, state), (B, Wp) int32."""
+    B, L = tokens.shape
+    Wp = init.shape[1]
+    nb, nk = B // TB, L // CL
+
+    # position-major column: row ((i*nk + k)*CL + t)*TB + r = byte t of
+    # batch row i*TB+r in chunk k — one fused XLA transpose, no in-kernel
+    # reshapes (unsupported shape casts in Mosaic).
+    toks_pm = (tokens.reshape(nb, TB, nk, CL)
+               .transpose(0, 2, 3, 1)
+               .reshape(nb * nk * CL * TB, 1))
+
+    kernel = functools.partial(_scan_kernel, CL=CL, TB=TB, MR=MR, Wp=Wp)
+    out_m, out_s = pl.pallas_call(
+        kernel,
+        grid=(nb, nk),
+        in_specs=[
+            pl.BlockSpec((CL * TB, 1), lambda i, k, nk=nk: (i * nk + k, 0),
+                         memory_space=pltpu.VMEM),       # tokens (pos-major)
+            pl.BlockSpec((TB, 1), lambda i, k: (i, 0),
+                         memory_space=pltpu.VMEM),       # lengths
+            pl.BlockSpec((256, 4 * Wp), lambda i, k: (0, 0),
+                         memory_space=pltpu.VMEM),       # byte planes
+            pl.BlockSpec((1, Wp), lambda i, k: (0, 0),
+                         memory_space=pltpu.VMEM),       # init
+            pl.BlockSpec((1, Wp), lambda i, k: (0, 0),
+                         memory_space=pltpu.VMEM),       # final
+            pl.BlockSpec((TB, Wp), lambda i, k: (i, 0),
+                         memory_space=pltpu.VMEM),       # state carry in
+            pl.BlockSpec((TB, Wp), lambda i, k: (i, 0),
+                         memory_space=pltpu.VMEM),       # match carry in
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, Wp), lambda i, k: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, Wp), lambda i, k: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Wp), jnp.int32),    # match
+            jax.ShapeDtypeStruct((B, Wp), jnp.int32),    # state
+        ],
+        scratch_shapes=[pltpu.VMEM((CL * TB, Wp), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(toks_pm, lengths, planes, init, final, state, match)
+    return out_m, out_s
+
+
+class PallasScanner:
+    """Caches the padded/packed device tables for repeated kernel calls
+    (serving + bench reuse one instance; hot-swap = build a new one)."""
+
+    def __init__(self, tables: ScanTables, TB: int = 64, CL: int = 32,
+                 MR: int = 256):
+        W = tables.n_words
+        Wp = _round_up(max(W, 128), 128)
+        self.W, self.Wp, self.TB, self.CL = W, Wp, TB, CL
+        self.MR = min(MR, CL * TB)
+        # stage 1 writes reach rows in MR-row blocks and gates each block
+        # by position — misaligned tilings would leave scratch rows stale
+        # and silently corrupt the NFA state, so reject them loudly
+        if TB % 8 or (CL * TB) % self.MR or self.MR % TB:
+            raise ValueError(
+                "invalid tiling: need TB %% 8 == 0, MR %% TB == 0 and "
+                "(CL*TB) %% MR == 0; got TB=%d CL=%d MR=%d"
+                % (TB, CL, self.MR))
+        bt = np.zeros((256, Wp), np.uint32)
+        bt[:, :W] = np.asarray(tables.byte_table)
+        self.planes = jnp.asarray(np.concatenate(
+            [((bt >> (8 * k)) & 0xFF).astype(np.float32) for k in range(4)],
+            axis=1), jnp.bfloat16)
+        init = np.zeros((1, Wp), np.int32)
+        init[0, :W] = np.asarray(tables.init_mask).view(np.int32)
+        final = np.zeros((1, Wp), np.int32)
+        final[0, :W] = np.asarray(tables.final_mask).view(np.int32)
+        self.init, self.final = jnp.asarray(init), jnp.asarray(final)
+
+    def __call__(self, tokens, lengths, state=None, match=None,
+                 interpret: bool = False):
+        """scan_bytes contract: returns (match, state) as (B, W) uint32."""
+        B, L = tokens.shape
+        TB, CL, W, Wp = self.TB, self.CL, self.W, self.Wp
+        Bp = _round_up(max(B, TB), TB)
+        Lp = _round_up(max(L, CL), CL)
+
+        def as_i32(x):
+            x = jnp.asarray(x)
+            return (jax.lax.bitcast_convert_type(x, jnp.int32)
+                    if x.dtype == jnp.uint32 else x.astype(jnp.int32))
+
+        tok_p = jnp.zeros((Bp, Lp), jnp.int32).at[:B, :L].set(
+            jnp.asarray(tokens).astype(jnp.int32))
+        len_p = jnp.zeros((Bp, 1), jnp.int32).at[:B, 0].set(
+            jnp.asarray(lengths).astype(jnp.int32))
+        sin = jnp.zeros((Bp, Wp), jnp.int32)
+        if state is not None:
+            sin = sin.at[:B, :W].set(as_i32(state))
+        min_ = jnp.zeros((Bp, Wp), jnp.int32)
+        if match is not None:
+            min_ = min_.at[:B, :W].set(as_i32(match))
+
+        out_m, out_s = _pallas_scan(
+            tok_p, len_p, self.planes, self.init, self.final, sin, min_,
+            TB=TB, CL=CL, MR=self.MR, interpret=interpret)
+        to_u32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.uint32)
+        return to_u32(out_m[:B, :W]), to_u32(out_s[:B, :W])
+
+
+def pallas_scan_bytes(
+    tables: ScanTables,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    state: Optional[jax.Array] = None,
+    match: Optional[jax.Array] = None,
+    TB: int = 64,
+    CL: int = 32,
+    MR: int = 256,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-shot convenience wrapper (table packing not cached); equivalence
+    with scan_bytes is asserted bit-for-bit in tests/test_pallas_scan.py."""
+    return PallasScanner(tables, TB=TB, CL=CL, MR=MR)(
+        tokens, lengths, state, match, interpret=interpret)

@@ -79,8 +79,10 @@ class Batcher:
         return fut
 
     def swap_ruleset(self, ruleset, paranoia_level: int = 2) -> None:
-        """Atomic from the traffic's perspective: the lock covers only the
-        swap itself; in-flight batches finish on the old tables."""
+        """Atomic from the traffic's perspective: the dispatch thread holds
+        the same lock across each ``pipeline.detect`` call, so the swap
+        waits for the in-flight batch to finish on the old tables and the
+        next batch sees the new ones — never a torn pipeline."""
         with self._swap_lock:
             self.pipeline.swap_ruleset(ruleset, paranoia_level)
 
@@ -130,8 +132,7 @@ class Batcher:
             requests = [r for _, r, _ in batch]
             try:
                 with self._swap_lock:
-                    pass  # barrier: never race a mid-swap pipeline
-                verdicts = self.pipeline.detect(requests)
+                    verdicts = self.pipeline.detect(requests)
             except Exception:
                 verdicts = [
                     Verdict(request_id=r.request_id, blocked=False,

@@ -148,6 +148,8 @@ class Request:
     body: bytes = b""
     tenant: int = 0          # EP routing: Ingress/namespace index
     request_id: str = ""
+    mode: int = 2            # wallarm_mode: 0 off, 1 monitoring, 2 block
+                             # (can only weaken the server's global mode)
 
     def streams(self) -> Dict[str, bytes]:
         """stream name → raw bytes (the 4 scan streams)."""

@@ -59,9 +59,12 @@ class ServeLoop:
                 verdict.score,
                 [classes_index[c] for c in verdict.classes],
                 verdict.rule_ids)
-            async with write_lock:
-                writer.write(data)
-                await writer.drain()
+            try:
+                async with write_lock:
+                    writer.write(data)
+                    await writer.drain()
+            except (ConnectionError, RuntimeError):
+                pass  # client went away mid-verdict; nothing to deliver to
 
         pending = set()
         try:
@@ -78,6 +81,17 @@ class ServeLoop:
                         req_id, mode, request = decode_request(payload)
                     except ProtocolError:
                         continue
+                    if mode == 0:
+                        # wallarm_mode off: no processing at all (reference
+                        # semantics) — immediate pass, skip the engine
+                        from ingress_plus_tpu.models.pipeline import Verdict
+                        t = asyncio.ensure_future(respond(req_id, Verdict(
+                            request_id=request.request_id, blocked=False,
+                            attack=False, classes=[], rule_ids=[], score=0)))
+                        pending.add(t)
+                        t.add_done_callback(pending.discard)
+                        continue
+                    request.mode = mode
                     fut = self.batcher.submit(request)
                     afut = asyncio.wrap_future(fut, loop=loop)
                     task = asyncio.ensure_future(afut)
@@ -85,8 +99,12 @@ class ServeLoop:
 
                     def _done(t, req_id=req_id):
                         pending.discard(t)
-                        if not t.cancelled() and t.exception() is None:
-                            asyncio.ensure_future(respond(req_id, t.result()))
+                        if (not t.cancelled() and t.exception() is None
+                                and not writer.is_closing()):
+                            rt = asyncio.ensure_future(
+                                respond(req_id, t.result()))
+                            pending.add(rt)
+                            rt.add_done_callback(pending.discard)
                     task.add_done_callback(_done)
         finally:
             for t in pending:
@@ -215,7 +233,15 @@ def warmup_pipeline(pipeline, max_batch: int) -> None:
 
     t0 = _t.time()
     reqs = [lr.request for lr in generate_corpus(n=max_batch, seed=1)]
-    for size in {1, 4, min(32, max_batch), max_batch}:
+    # one size per Q-pad tier (engine executables are keyed on the padded
+    # request count, powers of two with floor 4) so no live batch size
+    # triggers a fresh multi-second compile
+    sizes, q = [], 4
+    while q < max_batch:
+        sizes.append(q)
+        q *= 2
+    sizes.append(max_batch)
+    for size in sizes:
         pipeline.detect(reqs[:size])
     print("warmup: compiled serve shapes in %.1fs" % (_t.time() - t0),
           file=sys.stderr)

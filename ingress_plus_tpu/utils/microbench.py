@@ -66,27 +66,92 @@ def bench_scan(tables: ScanTables, batch: int, length: int, gather: str,
     return batch * length / per_scan / 1e6
 
 
+def bench_pallas(tables: ScanTables, batch: int, length: int,
+                 iters: int = 65, TB: int = 8, CL: int = 128,
+                 MR: int = 256) -> float:
+    """MB/s for the Pallas kernel (ops/pallas_scan.py), K-diff timed the
+    same way as bench_scan.  Table prep (padding, planes) happens once
+    outside the timed region, as in serving."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from ingress_plus_tpu.ops.pallas_scan import _pallas_scan, _round_up
+
+    W = tables.n_words
+    Wp = _round_up(max(W, 128), 128)
+    bt = np.zeros((256, Wp), np.uint32)
+    bt[:, :W] = np.asarray(tables.byte_table)
+    planes = jnp.asarray(np.concatenate(
+        [((bt >> (8 * k)) & 0xFF).astype(np.float32) for k in range(4)],
+        axis=1), jnp.bfloat16)
+    init = np.zeros((1, Wp), np.int32)
+    init[0, :W] = np.asarray(tables.init_mask).view(np.int32)
+    final = np.zeros((1, Wp), np.int32)
+    final[0, :W] = np.asarray(tables.final_mask).view(np.int32)
+    init, final = jnp.asarray(init), jnp.asarray(final)
+    mr = min(MR, CL * TB)
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def scan_k(key, k):
+        tokens = jax.random.randint(key, (batch, length), 32, 127,
+                                    dtype=jnp.int32)
+        lengths = jnp.full((batch, 1), length, dtype=jnp.int32)
+
+        def body(i, carry):
+            s, m = carry
+            m, s = _pallas_scan(tokens, lengths, planes, init, final, s, m,
+                                TB=TB, CL=CL, MR=mr, interpret=False)
+            return (s, m)
+
+        s = jnp.zeros((batch, Wp), jnp.int32)
+        s, m = jax.lax.fori_loop(0, k, body, (s, jnp.zeros_like(s)))
+        return m[0, 0]
+
+    def timed(k: int) -> float:
+        jax.block_until_ready(scan_k(jax.random.PRNGKey(k), k))
+        best = float("inf")
+        for i in range(2):
+            t0 = time.perf_counter()
+            jax.block_until_ready(scan_k(jax.random.PRNGKey(100 + i), k))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    per_scan = (timed(iters) - timed(1)) / (iters - 1)
+    return batch * length / per_scan / 1e6
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--len", dest="length", type=int, default=1024)
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--only", default=None,
+                    choices=[None, "take", "onehot", "pallas"])
+    ap.add_argument("--tb", type=int, default=8)
+    ap.add_argument("--cl", type=int, default=128)
     args = ap.parse_args()
 
     cr = compile_ruleset(load_bundled_rules())
     tables = ScanTables.from_bitap(cr.tables)
     print("backend=%s  W=%d words  rules=%d" % (
         jax.default_backend(), tables.n_words, cr.n_rules))
-    for gather in ("take", "onehot"):
+    for gather in ("take", "onehot", "pallas"):
+        if args.only and gather != args.only:
+            continue
         for batch in (args.batch, args.batch * 4):
             try:
-                mbs = bench_scan(tables, batch, args.length, gather,
-                                 args.iters)
+                if gather == "pallas":
+                    mbs = bench_pallas(tables, batch, args.length,
+                                       args.iters, TB=args.tb, CL=args.cl)
+                else:
+                    mbs = bench_scan(tables, batch, args.length, gather,
+                                     args.iters)
                 print("gather=%-7s batch=%-5d len=%-5d  %8.1f MB/s"
                       % (gather, batch, args.length, mbs))
             except Exception as e:  # keep sweeping on OOM etc.
                 print("gather=%-7s batch=%-5d FAILED: %s"
-                      % (gather, batch, str(e)[:80]))
+                      % (gather, batch, str(e)[:120]))
 
 
 if __name__ == "__main__":

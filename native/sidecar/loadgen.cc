@@ -114,11 +114,25 @@ int main(int argc, char** argv) {
     }
     fcntl(fd, F_SETFL, O_NONBLOCK);
     conns[c].fd = fd;
+    // EPOLLIN only: with a permanently-registered EPOLLOUT the wait loop
+    // busy-spins at 100% CPU whenever the in-flight window is full (the
+    // socket stays writable), starving the single-core serve loop under
+    // test.  EPOLLOUT is toggled on only while outbuf has a backlog.
     epoll_event ev{};
-    ev.events = EPOLLIN | EPOLLOUT;
+    ev.events = EPOLLIN;
     ev.data.u32 = c;
     epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev);
   }
+
+  std::vector<bool> want_out(opt.connections, false);
+  auto set_events = [&](int c, bool out) {
+    if (want_out[c] == out) return;
+    want_out[c] = out;
+    epoll_event ev{};
+    ev.events = out ? (EPOLLIN | EPOLLOUT) : EPOLLIN;
+    ev.data.u32 = uint32_t(c);
+    epoll_ctl(ep, EPOLL_CTL_MOD, conns[c].fd, &ev);
+  };
 
   std::unordered_map<uint64_t, uint64_t> sent_ns;
   sent_ns.reserve(opt.connections * opt.inflight * 2);
@@ -129,7 +143,8 @@ int main(int argc, char** argv) {
   uint64_t next_id = 1;
   uint64_t t_start = NowNs();
 
-  auto pump_one = [&](Conn& c) {
+  auto pump_one = [&](int ci) {
+    Conn& c = conns[ci];
     // enqueue new requests while under the in-flight window
     while (c.inflight < opt.inflight && sent < opt.total_requests) {
       std::string frame = corpus[sent % corpus.size()];
@@ -151,7 +166,9 @@ int main(int argc, char** argv) {
       c.out_off += size_t(n);
     }
     if (c.out_off == c.outbuf.size()) { c.outbuf.clear(); c.out_off = 0; }
+    set_events(ci, !c.outbuf.empty());
   };
+  for (int c = 0; c < opt.connections; ++c) pump_one(c);
 
   epoll_event events[64];
   while (received < opt.total_requests) {
@@ -159,7 +176,8 @@ int main(int argc, char** argv) {
     if (nev < 0) { if (errno == EINTR) continue; perror("epoll"); return 4; }
     if (nev == 0 && sent == received) continue;
     for (int i = 0; i < nev; ++i) {
-      Conn& c = conns[events[i].data.u32];
+      int ci = int(events[i].data.u32);
+      Conn& c = conns[ci];
       if (events[i].events & EPOLLIN) {
         uint8_t buf[1 << 16];
         ssize_t n;
@@ -180,7 +198,7 @@ int main(int argc, char** argv) {
         }
         if (n == 0) { fprintf(stderr, "server closed connection\n"); return 5; }
       }
-      pump_one(c);
+      pump_one(ci);
     }
   }
   uint64_t t_end = NowNs();

@@ -77,7 +77,8 @@ def test_monitoring_mode_never_blocks(ruleset):
 
 def test_fail_open_on_engine_error(ruleset):
     p = DetectionPipeline(ruleset, mode="block", fail_open=True)
-    p.engine.detect = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("tpu gone"))
+    raise_ = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("tpu gone"))
+    p.engine.detect = p.engine.detect_device = raise_
     v = p.detect([ATTACKS[0][1]])[0]
     assert not v.blocked and v.fail_open
     assert p.stats.fail_open == 1
