@@ -209,6 +209,9 @@ class CompiledRuleset:
             "streams": STREAMS,
             "variants": VARIANTS,
             "confirm": [m.confirm for m in self.rules],
+            # tags drive tenant (EP) rule-subset masks — must survive the
+            # checkpoint roundtrip (control/tenants.py)
+            "tags": [list(m.rule.tags) for m in self.rules],
         }
         path.with_suffix(".json").write_text(json.dumps(meta))
 
@@ -227,6 +230,7 @@ class CompiledRuleset:
         )
         rules = []
         action_names = {0: "pass", 1: "block", 2: "deny"}
+        all_tags = meta.get("tags", [[]] * len(meta["confirm"]))
         for i, confirm in enumerate(meta["confirm"]):
             rule = Rule(
                 rule_id=int(z["rule_ids"][i]),
@@ -235,6 +239,7 @@ class CompiledRuleset:
                 targets=list(confirm.get("targets", ["args"])),
                 transforms=confirm.get("transforms", []),
                 action=action_names[int(z["rule_action"][i])],
+                tags=list(all_tags[i]),
             )
             rules.append(RuleMeta(rule=rule, index=i,
                                   variant=confirm.get("variant", 0),

@@ -1,0 +1,31 @@
+"""Control plane — the reference's Go controller re-shaped for the TPU
+detection backend (SURVEY.md §2.1).
+
+The reference stack is: k8s informers → annotation extraction → model
+build (`Configuration{Servers, Locations}`) → template render (nginx.conf)
+→ reload-vs-dynamic decision → data-plane update (SIGHUP or Lua endpoint
+POST).  Files here mirror that pipeline one-to-one, minus the parts that
+are pure kubernetes plumbing (informers/leader election), which need a
+cluster, not a framework:
+
+    objects.py      — minimal Ingress/ConfigMap object model
+                      (pkg/apis/ingress/types.go† analog)
+    annotations.py  — parser framework + wallarm/tpu annotation set
+                      (internal/ingress/annotations/†)
+    config.py       — global config tiers (controller/config/config.go†)
+    model.py        — Ingress objects → Configuration model
+                      (controller/controller.go† getConfiguration)
+    template.py     — model → nginx.conf text incl. detection-backend
+                      routing (controller/template/† + nginx.tmpl†)
+    sync.py         — syncIngress analog: render, diff, reload-vs-dynamic,
+                      push tenant table to the serve loop
+                      (controller/nginx.go† + configuration.lua† channel)
+    admission.py    — dry-run validation webhook (internal/admission/†)
+    dbg.py          — inspection CLI (cmd/dbg/main.go†)
+"""
+
+from ingress_plus_tpu.control.annotations import Extractor  # noqa: F401
+from ingress_plus_tpu.control.config import GlobalConfig  # noqa: F401
+from ingress_plus_tpu.control.model import build_configuration  # noqa: F401
+from ingress_plus_tpu.control.objects import ConfigMap, Ingress  # noqa: F401
+from ingress_plus_tpu.control.template import render  # noqa: F401

@@ -1,0 +1,75 @@
+"""dbg — inspection CLI for the serve loop's dynamic-config plane.
+
+Reference: `cmd/dbg/main.go`† queries the controller's Lua unix-socket
+endpoints (`/configuration/backends`, ...) to show the live dynamic
+state.  Same idea against our HTTP plane:
+
+    python -m ingress_plus_tpu.control.dbg conf     [--server host:port]
+    python -m ingress_plus_tpu.control.dbg health
+    python -m ingress_plus_tpu.control.dbg metrics
+    python -m ingress_plus_tpu.control.dbg tenants --set '{"1": ["attack-sqli"]}'
+    python -m ingress_plus_tpu.control.dbg ruleset --swap /path/artifact \
+        [--paranoia 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _call(server: str, path: str, payload=None) -> str:
+    url = "http://%s%s" % (server, path)
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data, method="POST" if data else "GET",
+        headers={"Content-Type": "application/json"} if data else {})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ingress_plus_tpu.control.dbg")
+    ap.add_argument("cmd",
+                    choices=["conf", "health", "metrics", "tenants",
+                             "ruleset"])
+    ap.add_argument("--server", default="127.0.0.1:9901")
+    ap.add_argument("--set", dest="set_json", default=None,
+                    help="tenants: JSON tenant→tags table to push")
+    ap.add_argument("--swap", default=None,
+                    help="ruleset: checkpoint artifact path to hot-swap")
+    ap.add_argument("--paranoia", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    try:
+        if args.cmd == "conf":
+            out = _call(args.server, "/configuration")
+        elif args.cmd == "health":
+            out = _call(args.server, "/healthz")
+        elif args.cmd == "metrics":
+            out = _call(args.server, "/metrics")
+        elif args.cmd == "tenants":
+            if args.set_json:
+                out = _call(args.server, "/configuration/tenants",
+                            json.loads(args.set_json))
+            else:
+                out = _call(args.server, "/configuration")
+        else:  # ruleset
+            if not args.swap:
+                print("ruleset requires --swap <artifact path>",
+                      file=sys.stderr)
+                return 2
+            out = _call(args.server, "/configuration/ruleset",
+                        {"path": args.swap,
+                         "paranoia_level": args.paranoia})
+    except OSError as e:
+        print("error: %s" % e, file=sys.stderr)
+        return 1
+    print(out.strip())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

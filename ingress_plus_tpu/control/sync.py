@@ -1,0 +1,127 @@
+"""Sync controller: the `syncIngress` loop analog.
+
+Reference flow (`internal/ingress/controller/nginx.go`†, SURVEY.md §3.2):
+
+    informer event → build model → render template →
+      IF only dynamic state changed: POST to the Lua endpoint (no reload)
+      ELSE: nginx -t, diff, SIGHUP reload
+
+Here the same decision, re-targeted:
+
+- **render diff** → "reload" (the nginx shim must re-read directives);
+- **tenant table change only** → "dynamic": POST the EP tenant rule-mask
+  table to the serve loop's /configuration/tenants endpoint (the
+  configuration.lua† unix-socket channel analog) — no reload, no serve
+  gap;
+- no change → "noop".
+
+`tenant_masks` maps the model's tenant→rule-tags table onto the compiled
+ruleset: tenant 0 always runs the full (paranoia-filtered) set; a tenant
+with tags runs exactly the rules carrying ≥1 of its tags (per-tenant
+verdict masks over one shared NFA — SURVEY.md §7 hard part #6: no
+per-tenant recompilation).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ingress_plus_tpu.compiler.ruleset import CompiledRuleset
+from ingress_plus_tpu.control.config import GlobalConfig
+from ingress_plus_tpu.control.model import (
+    Configuration,
+    build_configuration,
+)
+from ingress_plus_tpu.control.objects import ConfigMap, Ingress
+from ingress_plus_tpu.control.template import render
+
+
+MAX_TENANTS = 4096  # bounds the (T, R) mask allocation (config #4 is 256)
+
+
+def tenant_masks(cr: CompiledRuleset,
+                 tenant_tags: Dict[int, Tuple[str, ...]]) -> np.ndarray:
+    """(T, R) bool — row 0 = full ruleset (reserved, cannot be overridden);
+    a tenant id NOT in the table also runs the full ruleset (all-True
+    default): an unlisted tenant must never mean "scan nothing"."""
+    ids = [t for t in tenant_tags if 0 < t < MAX_TENANTS]
+    T = (max(ids) + 1) if ids else 1
+    masks = np.ones((T, cr.n_rules), dtype=bool)
+    rule_tags = [frozenset(m.rule.tags) for m in cr.rules]
+    for t in ids:
+        want = frozenset(tenant_tags[t])
+        masks[t] = np.fromiter(
+            (bool(want & rt) for rt in rule_tags), bool, cr.n_rules)
+    return masks
+
+
+@dataclass
+class SyncResult:
+    action: str                  # "reload" | "dynamic" | "noop"
+    rendered: str
+    configuration: Configuration
+    pushed_tenants: bool = False
+    errors: List[str] = field(default_factory=list)
+
+
+class SyncController:
+    def __init__(self, global_config: Optional[GlobalConfig] = None,
+                 serve_http: Optional[str] = None):
+        self.global_config = global_config or GlobalConfig()
+        self.serve_http = serve_http or self.global_config.sidecar_http
+        self.last_rendered: Optional[str] = None
+        self.last_tenants: Optional[Dict[int, Tuple[str, ...]]] = None
+
+    def _push_tenants(self, tags: Dict[int, Tuple[str, ...]]) -> bool:
+        body = json.dumps({str(t): list(v) for t, v in tags.items()})
+        url = "http://%s/configuration/tenants" % self.serve_http
+        try:
+            req = urllib.request.Request(
+                url, data=body.encode(), method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return 200 <= resp.status < 300
+        except OSError:
+            return False
+
+    def sync(self, ingresses: List[Ingress],
+             configmap: Optional[ConfigMap] = None,
+             push: bool = True) -> SyncResult:
+        if configmap is not None:
+            self.global_config = GlobalConfig.from_configmap(configmap)
+            self.serve_http = self.global_config.sidecar_http
+        cfg = build_configuration(ingresses, self.global_config)
+        text = render(cfg, self.global_config)
+        tags = cfg.tenant_tags()
+
+        if text != self.last_rendered:
+            action = "reload"
+        elif tags != self.last_tenants:
+            action = "dynamic"
+        else:
+            action = "noop"
+
+        pushed = False
+        if push and tags != self.last_tenants:
+            pushed = self._push_tenants(tags)
+            if not pushed:
+                # leave last_tenants stale so the next sync retries the
+                # push (a restarting serve loop must not be skipped as
+                # "noop" forever)
+                self.last_rendered = text
+                return SyncResult(
+                    action=action, rendered=text, configuration=cfg,
+                    pushed_tenants=False,
+                    errors=list(cfg.errors) + list(self.global_config.errors)
+                    + ["tenant push to %s failed" % self.serve_http])
+        self.last_rendered = text
+        self.last_tenants = tags
+        return SyncResult(action=action, rendered=text, configuration=cfg,
+                          pushed_tenants=pushed,
+                          errors=list(cfg.errors)
+                          + list(self.global_config.errors))

@@ -146,14 +146,11 @@ class DetectionEngine:
         self.tables = EngineTables.from_ruleset(cr)
 
     def swap_ruleset(self, cr: CompiledRuleset) -> None:
-        new = EngineTables.from_ruleset(cr)
-        ok = (new.factor_rule.shape == self.tables.factor_rule.shape)
+        # tables are a jit *argument* (pytree), so a geometry change just
+        # keys a fresh executable on next call — never clear the cache
+        # (that would dump pre-warmed shapes for the new tables too)
         self.ruleset = cr
-        self.tables = new
-        if not ok:
-            # different table geometry → jit will recompile on next call;
-            # callers keep serving the old executable until then.
-            detect_rows_jit.clear_cache() if hasattr(detect_rows_jit, "clear_cache") else None
+        self.tables = EngineTables.from_ruleset(cr)
 
     def detect(self, tokens, lengths, row_req, row_sv, num_requests: int):
         rule_hits, class_hits, scores, match, _ = detect_rows_jit(

@@ -90,6 +90,9 @@ class DetectionPipeline:
         self.fail_open = fail_open
         self.stats = PipelineStats()
         self.tenant_rule_mask = tenant_rule_mask
+        # (B, L, Q_pad) engine shapes served so far — a replacement
+        # pipeline warms exactly these before it is swapped in
+        self.seen_shapes: set = set()
         self._install(ruleset, paranoia_level)
 
     # ------------------------------------------------------------- setup
@@ -107,6 +110,14 @@ class DetectionPipeline:
         perspective — in-flight batches finish on the old tables."""
         self.engine.swap_ruleset(ruleset)
         self._install(ruleset, paranoia_level)
+
+    def warm_shape(self, B: int, L: int, Q_pad: int) -> None:
+        """Pre-compile one engine executable (serving swap path)."""
+        n_sv = len(STREAMS) * len(VARIANTS)
+        self.engine.detect(
+            np.zeros((B, L), np.int32), np.zeros((B,), np.int32),
+            np.zeros((B,), np.int32), np.zeros((B, n_sv), np.int8), Q_pad)
+        self.seen_shapes.add((B, L, Q_pad))
 
     # ------------------------------------------------------------ detect
 
@@ -170,6 +181,8 @@ class DetectionPipeline:
                     row_sv[j, sv_list[i]] = 1
                 dispatched.append(self.engine.detect_device(
                     tokens, lengths, row_req, row_sv, self._pad_q(Q)))
+                self.seen_shapes.add(
+                    (tokens.shape[0], tokens.shape[1], self._pad_q(Q)))
                 stats.rows += len(idxs)
                 stats.row_bytes += sum(len(r) for r in rows_b)
             for rh_dev in dispatched:
@@ -177,11 +190,14 @@ class DetectionPipeline:
             stats.engine_us += int((time.perf_counter() - te0) * 1e6)
         rule_hits = rule_hits[:Q]
 
-        # tenant (EP) masking: a tenant only runs its own rule subset
+        # tenant (EP) masking: a tenant only runs its own rule subset; ids
+        # outside the table fall back to row 0 = full ruleset (a wrap onto
+        # another tenant's restricted mask would be a scan bypass)
         if self.tenant_rule_mask is not None:
             tenants = np.asarray([r.tenant for r in requests], dtype=np.int32)
-            rule_hits = rule_hits & self.tenant_rule_mask[
-                tenants % self.tenant_rule_mask.shape[0]]
+            T = self.tenant_rule_mask.shape[0]
+            tenants = np.where((tenants >= 0) & (tenants < T), tenants, 0)
+            rule_hits = rule_hits & self.tenant_rule_mask[tenants]
 
         rule_hits = rule_hits & self.paranoia_mask[None, :]
         stats.prefilter_rule_hits += int(rule_hits.sum())

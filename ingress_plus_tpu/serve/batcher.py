@@ -79,12 +79,42 @@ class Batcher:
         return fut
 
     def swap_ruleset(self, ruleset, paranoia_level: int = 2) -> None:
-        """Atomic from the traffic's perspective: the dispatch thread holds
-        the same lock across each ``pipeline.detect`` call, so the swap
-        waits for the in-flight batch to finish on the old tables and the
-        next batch sees the new ones — never a torn pipeline."""
+        """Hot-swap (sync-node† analog), zero serve gap:
+
+        1. OFF-lock: build a complete new pipeline and pre-compile every
+           (B, L, Q) shape the old pipeline has served, so post-swap
+           traffic never waits on XLA inside the lock (that stall was an
+           attack window right after each ruleset update);
+        2. under the lock (which the dispatch thread holds across each
+           ``detect``): install the new pipeline after the in-flight
+           batch finishes, re-deriving tenant masks against the new rule
+           axis so EP routing survives the swap."""
+        old = self.pipeline
+        new = DetectionPipeline(
+            ruleset, mode=old.mode,
+            anomaly_threshold=old.anomaly_threshold,
+            fail_open=old.fail_open, paranoia_level=paranoia_level)
+        for shape in sorted(getattr(old, "seen_shapes", ())):
+            new.warm_shape(*shape)
+        new.stats = old.stats  # counters span swaps (Prometheus contract)
         with self._swap_lock:
-            self.pipeline.swap_ruleset(ruleset, paranoia_level)
+            self.pipeline = new
+            self._reapply_tenants()
+
+    def set_tenant_tags(self, tags) -> None:
+        """Dynamic EP-routing update (no reload): install the semantic
+        tenant→rule-tags table; the (T, R) masks are derived against the
+        *current* ruleset between batches."""
+        with self._swap_lock:
+            self.tenant_tags = dict(tags)
+            self._reapply_tenants()
+
+    def _reapply_tenants(self) -> None:
+        from ingress_plus_tpu.control.sync import tenant_masks
+
+        tags = getattr(self, "tenant_tags", None)
+        self.pipeline.tenant_rule_mask = (
+            tenant_masks(self.pipeline.ruleset, tags) if tags else None)
 
     def close(self) -> None:
         self._stop.set()

@@ -152,10 +152,16 @@ class Request:
                              # (can only weaken the server's global mode)
 
     def streams(self) -> Dict[str, bytes]:
-        """stream name → raw bytes (the 4 scan streams)."""
+        """stream name → base bytes (the 4 scan streams).
+
+        ARGS is URL-decoded once *before* any rule transform, because
+        ModSecurity's ARGS collection holds parsed query values, not raw
+        query bytes — CRS rules without an explicit t:urlDecodeUni still
+        expect decoded text there (a rule's own urlDecodeUni then catches
+        double-encoding, same as the reference engine)."""
         uri = self.uri.encode("utf-8", "surrogateescape")
         q = uri.find(b"?")
-        args = uri[q + 1 :] if q >= 0 else b""
+        args = url_decode_uni(uri[q + 1 :]) if q >= 0 else b""
         # Header values are separate match units in ModSecurity; we join
         # them with \x1f (unit separator): survives every transform chain,
         # is matched by no rule, and prevents cross-header false adjacency

@@ -151,32 +151,101 @@ class ServeLoop:
                            writer: asyncio.StreamWriter) -> None:
         try:
             line = await asyncio.wait_for(reader.readline(), timeout=5)
-            path = line.split()[1].decode() if len(line.split()) > 1 else "/"
-            while (await reader.readline()).strip():
-                pass
-            if path.startswith("/healthz"):
-                body = json.dumps({
-                    "status": "ok",
-                    "uptime_s": round(time.time() - self.started, 1),
-                    "ruleset": self.batcher.pipeline.ruleset.version,
-                }).encode()
-                ctype = "application/json"
-            elif path.startswith("/metrics"):
-                body = self._metrics_text().encode()
-                ctype = "text/plain; version=0.0.4"
-            else:
-                writer.write(b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n")
-                await writer.drain()
-                return
+            parts = line.split()
+            method = parts[0].decode() if parts else "GET"
+            path = parts[1].decode() if len(parts) > 1 else "/"
+            clen = 0
+            while True:
+                h = (await reader.readline()).strip()
+                if not h:
+                    break
+                if h.lower().startswith(b"content-length:"):
+                    clen = int(h.split(b":", 1)[1])
+            payload = (await reader.readexactly(clen)) if clen else b""
+            status, ctype, body = await self._route_http(method, path,
+                                                         payload)
             writer.write(
-                b"HTTP/1.1 200 OK\r\nContent-Type: " + ctype.encode()
+                b"HTTP/1.1 " + status.encode()
+                + b"\r\nContent-Type: " + ctype.encode()
                 + b"\r\nContent-Length: " + str(len(body)).encode()
                 + b"\r\nConnection: close\r\n\r\n" + body)
             await writer.drain()
-        except (asyncio.TimeoutError, IndexError, ConnectionError):
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                IndexError, ValueError, ConnectionError):
             pass
         finally:
             writer.close()
+
+    async def _route_http(self, method: str, path: str, payload: bytes):
+        """Observability + dynamic-config plane (the configuration.lua†
+        unix-socket endpoint analog — SURVEY.md §3.2 no-reload path).
+
+        Mutating routes run in a worker thread: they contend on the
+        batcher's swap lock (held across each in-flight detect) and do
+        disk/compile work — blocking the event loop here would freeze
+        verdict delivery for every connection."""
+        pipeline = self.batcher.pipeline
+        loop = asyncio.get_running_loop()
+        if path.startswith("/healthz"):
+            return "200 OK", "application/json", json.dumps({
+                "status": "ok",
+                "uptime_s": round(time.time() - self.started, 1),
+                "ruleset": pipeline.ruleset.version,
+            }).encode()
+        if path.startswith("/metrics"):
+            return ("200 OK", "text/plain; version=0.0.4",
+                    self._metrics_text().encode())
+        if path == "/configuration/tenants" and method == "POST":
+            # EP tenant table push: {"<tenant>": ["tag", ...], ...}
+            from ingress_plus_tpu.control.sync import MAX_TENANTS
+            try:
+                raw = json.loads(payload or b"{}")
+                tags = {int(k): tuple(map(str, v))
+                        for k, v in raw.items()}
+                if any(t < 0 or t >= MAX_TENANTS for t in tags):
+                    raise ValueError(
+                        "tenant ids must be in [0, %d)" % MAX_TENANTS)
+            except (ValueError, TypeError, AttributeError,
+                    json.JSONDecodeError) as e:
+                return ("400 Bad Request", "application/json",
+                        json.dumps({"error": str(e)}).encode())
+            await loop.run_in_executor(
+                None, self.batcher.set_tenant_tags, tags)
+            tm = self.batcher.pipeline.tenant_rule_mask
+            return "200 OK", "application/json", json.dumps(
+                {"tenants": 1 if tm is None else int(tm.shape[0])}).encode()
+        if path == "/configuration/ruleset" and method == "POST":
+            # hot-swap from a checkpoint artifact (sync-node† analog)
+            from ingress_plus_tpu.compiler.ruleset import CompiledRuleset
+
+            def _load_and_swap():
+                spec = json.loads(payload or b"{}")
+                cr = CompiledRuleset.load(spec["path"])
+                self.batcher.swap_ruleset(
+                    cr, paranoia_level=int(spec.get("paranoia_level", 2)))
+                return cr
+
+            try:
+                cr = await loop.run_in_executor(None, _load_and_swap)
+            except (KeyError, OSError, ValueError,
+                    json.JSONDecodeError) as e:
+                return ("400 Bad Request", "application/json",
+                        json.dumps({"error": str(e)}).encode())
+            return "200 OK", "application/json", json.dumps(
+                {"ruleset": cr.version, "rules": cr.n_rules}).encode()
+        if path.startswith("/configuration"):
+            # dbg CLI inspection (cmd/dbg† analog)
+            tm = pipeline.tenant_rule_mask
+            return "200 OK", "application/json", json.dumps({
+                "ruleset": pipeline.ruleset.version,
+                "rules": pipeline.ruleset.n_rules,
+                "mode": pipeline.mode,
+                "anomaly_threshold": pipeline.anomaly_threshold,
+                "tenants": 1 if tm is None else int(tm.shape[0]),
+                "batch": {"max": self.batcher.max_batch,
+                          "window_us": int(self.batcher.max_delay_s * 1e6)},
+            }).encode()
+        return "404 Not Found", "text/plain", b""
 
     # ------------------------------------------------------- lifecycle
 

@@ -76,21 +76,13 @@ def bench_pallas(tables: ScanTables, batch: int, length: int,
 
     import jax.numpy as jnp
 
-    from ingress_plus_tpu.ops.pallas_scan import _pallas_scan, _round_up
+    from ingress_plus_tpu.ops.pallas_scan import PallasScanner, _pallas_scan
 
-    W = tables.n_words
-    Wp = _round_up(max(W, 128), 128)
-    bt = np.zeros((256, Wp), np.uint32)
-    bt[:, :W] = np.asarray(tables.byte_table)
-    planes = jnp.asarray(np.concatenate(
-        [((bt >> (8 * k)) & 0xFF).astype(np.float32) for k in range(4)],
-        axis=1), jnp.bfloat16)
-    init = np.zeros((1, Wp), np.int32)
-    init[0, :W] = np.asarray(tables.init_mask).view(np.int32)
-    final = np.zeros((1, Wp), np.int32)
-    final[0, :W] = np.asarray(tables.final_mask).view(np.int32)
-    init, final = jnp.asarray(init), jnp.asarray(final)
-    mr = min(MR, CL * TB)
+    # reuse the serving scanner's packing so the benchmark always measures
+    # the shipped bit layout (prep is outside the timed region either way)
+    sc = PallasScanner(tables, TB=TB, CL=CL, MR=MR)
+    planes, init, final = sc.planes, sc.init, sc.final
+    Wp, mr = sc.Wp, sc.MR
 
     @functools.partial(jax.jit, static_argnames=("k",))
     def scan_k(key, k):

@@ -1,0 +1,262 @@
+"""Control plane: annotations → model → template → admission → sync.
+
+Mirrors the reference's test strategy (SURVEY.md §4): table-driven
+annotation parser tests with synthetic Ingress objects
+(annotations/*/main_test.go†) and golden-file template rendering
+(template_test.go†).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.control.admission import lint_rendered, validate
+from ingress_plus_tpu.control.annotations import (
+    PREFIX,
+    AnnotationError,
+    Extractor,
+)
+from ingress_plus_tpu.control.config import GlobalConfig
+from ingress_plus_tpu.control.model import build_configuration
+from ingress_plus_tpu.control.objects import ConfigMap, Ingress
+from ingress_plus_tpu.control.sync import SyncController, tenant_masks
+from ingress_plus_tpu.control.template import render
+
+RULES = """
+SecRule ARGS "@rx (?i)union\\s+select" "id:1,phase:2,block,severity:CRITICAL,tag:'attack-sqli'"
+SecRule ARGS "@rx (?i)<script" "id:2,phase:2,block,severity:CRITICAL,tag:'attack-xss'"
+SecRule ARGS "@rx /etc/passwd" "id:3,phase:2,block,severity:CRITICAL,tag:'attack-lfi'"
+"""
+
+
+def ing(name="app", ns="default", host="app.example.com", annotations=None,
+        service="app-svc", port=8080, path="/"):
+    return Ingress.from_dict({
+        "metadata": {"name": name, "namespace": ns,
+                     "annotations": {PREFIX + k: v for k, v in
+                                     (annotations or {}).items()}},
+        "spec": {"rules": [{
+            "host": host,
+            "http": {"paths": [{
+                "path": path, "pathType": "Prefix",
+                "backend": {"service": {"name": service,
+                                        "port": {"number": port}}}}]},
+        }]},
+    })
+
+
+# --------------------------------------------------------- annotations
+
+@pytest.mark.parametrize("key,raw,field,want", [
+    ("wallarm-mode", "block", "mode", "block"),
+    ("wallarm-mode", "MONITORING", "mode", "monitoring"),
+    ("wallarm-fallback", "off", "fallback", False),
+    ("detection-backend", "tpu", "detection_backend", "tpu"),
+    ("detection-paranoia-level", "3", "paranoia_level", 3),
+    ("detection-rule-tags", "attack-sqli, attack-xss", "rule_subset",
+     ["attack-sqli", "attack-xss"]),
+    ("wallarm-parser-disable", "xml,json", "parser_disable",
+     ["xml", "json"]),
+])
+def test_annotation_parsing(key, raw, field, want):
+    cfg = Extractor().extract(ing(annotations={key: raw}))
+    assert getattr(cfg, field) == want
+
+
+def test_application_alias_overrides_instance():
+    cfg = Extractor().extract(ing(annotations={
+        "wallarm-instance": "old", "wallarm-application": "new"}))
+    assert cfg.instance == "new"
+
+
+def test_lenient_bad_value_keeps_default_and_records_error():
+    ex = Extractor()
+    cfg = ex.extract(ing(annotations={"wallarm-mode": "nonsense"}))
+    assert cfg.mode == "off" and ex.errors
+
+
+def test_strict_raises_on_bad_value_and_blocklist():
+    with pytest.raises(AnnotationError):
+        Extractor(strict=True).extract(
+            ing(annotations={"wallarm-mode": "nonsense"}))
+    with pytest.raises(AnnotationError):
+        Extractor(strict=True).extract(
+            ing(annotations={"wallarm-block-page": "/x;}{injected"}))
+
+
+# ------------------------------------------------------------- config
+
+def test_globalconfig_from_configmap():
+    g = GlobalConfig.from_configmap(ConfigMap(data={
+        "enable-detection": "true", "default-mode": "block",
+        "detection-backend": "tpu", "batch-window-us": "250",
+        "max-batch": "bogus",  # bad int → default + error
+    }))
+    assert g.enable_detection and g.default_mode == "block"
+    assert g.detection_backend == "tpu" and g.batch_window_us == 250
+    assert g.max_batch == 256 and any("max-batch" in e for e in g.errors)
+
+
+# ----------------------------------------------------- model + tenants
+
+def test_model_tenants_and_global_merge():
+    g = GlobalConfig(enable_detection=True, default_mode="monitoring",
+                     detection_backend="tpu")
+    ings = [
+        ing(name="a", annotations={"wallarm-mode": "block",
+                                   "detection-rule-tags": "attack-sqli"}),
+        ing(name="b", host="b.example.com"),
+    ]
+    cfg = build_configuration(ings, g)
+    locs = {l.ingress_key: l for s in cfg.servers for l in s.locations}
+    assert locs["default/a"].detection.mode == "block"
+    assert locs["default/a"].detection.tenant == 1
+    assert locs["default/b"].detection.mode == "monitoring"  # global default
+    assert locs["default/b"].detection.tenant == 0
+    assert locs["default/b"].detection.detection_backend == "tpu"
+    assert cfg.tenant_tags() == {1: ("attack-sqli",)}
+
+
+def test_strict_override_policy_caps_mode():
+    g = GlobalConfig(enable_detection=True, default_mode="monitoring",
+                     mode_allow_override="strict")
+    cfg = build_configuration(
+        [ing(annotations={"wallarm-mode": "block"})], g)
+    assert cfg.servers[0].locations[0].detection.mode == "monitoring"
+
+
+def test_tenant_masks_from_tags():
+    cr = compile_ruleset(parse_seclang(RULES))
+    masks = tenant_masks(cr, {1: ("attack-sqli",), 2: ("attack-xss",
+                                                       "attack-lfi")})
+    assert masks.shape == (3, cr.n_rules)
+    assert masks[0].all()
+    by_id = {int(cr.rule_ids[i]): i for i in range(cr.n_rules)}
+    assert masks[1, by_id[1]] and not masks[1, by_id[2]]
+    assert masks[2, by_id[2]] and masks[2, by_id[3]] and not masks[2, by_id[1]]
+
+
+# ----------------------------------------------------------- template
+
+GOLDEN = """\
+# generated by ingress_plus_tpu.control — do not edit
+http {
+    server_tokens off;
+    client_body_buffer_size 16k;
+    log_format upstream_info '$remote_addr - $request "$status" $detect_verdict';
+    detect_tpu_metrics 127.0.0.1:9901;
+
+    server {
+        server_name app.example.com;
+        location / {
+            # ingress: default/app
+            detect_tpu on;
+            detect_tpu_socket /run/ipt/detect.sock;
+            detect_tpu_mode block;
+            detect_tpu_timeout_ms 30;
+            detect_tpu_fail_open on;
+            proxy_set_header X-Request-ID $request_id;
+            client_max_body_size 1m;
+            proxy_pass http://upstream_app-svc_8080;
+        }
+    }
+}
+"""
+
+
+def test_template_golden_tpu_backend():
+    g = GlobalConfig()
+    cfg = build_configuration(
+        [ing(annotations={"wallarm-mode": "block",
+                          "detection-backend": "tpu"})], g)
+    assert render(cfg, g) == GOLDEN
+
+
+def test_template_cpu_backend_renders_wallarm_directives():
+    g = GlobalConfig()
+    cfg = build_configuration(
+        [ing(annotations={"wallarm-mode": "monitoring"})], g)
+    text = render(cfg, g)
+    assert "wallarm_mode monitoring;" in text
+    assert "detect_tpu" not in text
+
+
+def test_render_deterministic():
+    g = GlobalConfig()
+    ings = [ing(name=n, host="%s.example.com" % n) for n in "cab"]
+    assert render(build_configuration(ings, g), g) == \
+        render(build_configuration(list(reversed(ings)), g), g)
+
+
+# ---------------------------------------------------------- admission
+
+def test_admission_rejects_bad_annotation_and_accepts_good():
+    bad = ing(annotations={"detection-backend": "gpu"})
+    assert not validate(bad).allowed
+    good = ing(annotations={"wallarm-mode": "block",
+                            "detection-backend": "tpu"})
+    r = validate(good)
+    assert r.allowed, r.messages
+
+
+def test_lint_catches_structural_breakage():
+    assert lint_rendered("http {\n    broken_directive\n}\n")
+    assert lint_rendered("http {\n") and not lint_rendered("http {\n}\n")
+
+
+# --------------------------------------------------------------- sync
+
+def test_sync_reload_dynamic_noop_transitions():
+    sc = SyncController()
+    ings = [ing(annotations={"wallarm-mode": "block",
+                             "detection-backend": "tpu"})]
+    r1 = sc.sync(ings, push=False)
+    assert r1.action == "reload"
+    r2 = sc.sync(ings, push=False)
+    assert r2.action == "noop"
+    # tag-only change → rendered text changes tenant directive → reload;
+    # but a tenant-table change with identical text is "dynamic": simulate
+    # by mutating last_rendered to the new text first
+    ings2 = [ing(annotations={"wallarm-mode": "block",
+                              "detection-backend": "tpu",
+                              "detection-rule-tags": "attack-sqli"})]
+    sc.last_rendered = None
+    r3 = sc.sync(ings2, push=False)
+    assert r3.action == "reload"
+    sc.last_tenants = {}
+    r4 = sc.sync(ings2, push=False)
+    assert r4.action == "dynamic"
+
+
+def test_ruleset_checkpoint_roundtrips_tags(tmp_path):
+    cr = compile_ruleset(parse_seclang(RULES))
+    cr.save(tmp_path / "art")
+    cr2 = CompiledRuleset.load(tmp_path / "art")
+    assert [m.rule.tags for m in cr2.rules] == \
+        [m.rule.tags for m in cr.rules]
+    assert cr2.version == cr.version
+
+
+def test_tenant_masks_unlisted_tenant_runs_full_ruleset():
+    """A gap in the pushed table must never mean 'scan nothing'."""
+    cr = compile_ruleset(parse_seclang(RULES))
+    masks = tenant_masks(cr, {2: ("attack-xss",)})
+    assert masks.shape[0] == 3
+    assert masks[0].all() and masks[1].all()          # unlisted → full set
+    assert not masks[2].all()
+    # reserved row 0 cannot be overridden; out-of-bounds ids are dropped
+    masks = tenant_masks(cr, {0: ("attack-xss",), 10**9: ("attack-xss",)})
+    assert masks.shape[0] == 1 and masks[0].all()
+
+
+def test_explicit_mode_off_is_honored_as_opt_out():
+    g = GlobalConfig(enable_detection=True, default_mode="block")
+    cfg = build_configuration(
+        [ing(name="optout", annotations={"wallarm-mode": "off"}),
+         ing(name="plain", host="p.example.com")], g)
+    locs = {l.ingress_key: l for s in cfg.servers for l in s.locations}
+    assert locs["default/optout"].detection.mode == "off"
+    assert locs["default/plain"].detection.mode == "block"

@@ -134,3 +134,71 @@ def test_python_client_roundtrip(server):
     assert got[7001]["attack"] and got[7001]["blocked"]
     assert 942100 in got[7001]["rule_ids"]
     assert not got[7002]["attack"]
+
+def test_configuration_endpoints_and_dbg(server, tmp_path):
+    """Dynamic-config plane: tenant push, ruleset hot-swap (sync-node
+    analog), inspection — all through the dbg CLI code path."""
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.control import dbg
+
+    conf = json.loads(urllib.request.urlopen(
+        "http://127.0.0.1:19901/configuration", timeout=10).read())
+    assert conf["rules"] == 3 and conf["tenants"] == 1
+
+    # push a tenant table: tenant 1 = sqli only
+    req = urllib.request.Request(
+        "http://127.0.0.1:19901/configuration/tenants",
+        data=json.dumps({"1": ["attack-sqli"]}).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    assert json.loads(urllib.request.urlopen(req, timeout=10).read()) == \
+        {"tenants": 2}
+
+    # tenant 1 must not fire the xss rule, tenant 0 must
+    from ingress_plus_tpu.serve.normalize import Request
+    from ingress_plus_tpu.serve.protocol import (
+        RESP_MAGIC, FrameReader, decode_response, encode_request)
+    s = socket.socket(socket.AF_UNIX)
+    s.connect(server)
+    s.sendall(encode_request(
+        Request(uri="/q?a=<script>x</script>", tenant=1), req_id=8001))
+    s.sendall(encode_request(
+        Request(uri="/q?a=<script>x</script>", tenant=0), req_id=8002))
+    reader, got = FrameReader(RESP_MAGIC), {}
+    s.settimeout(120)
+    while len(got) < 2:
+        for f in reader.feed(s.recv(65536)):
+            r = decode_response(f)
+            got[r["req_id"]] = r
+    s.close()
+    assert not got[8001]["attack"], "tenant mask failed to exclude xss rule"
+    assert got[8002]["attack"]
+
+    # hot-swap to a 1-rule ruleset from a checkpoint artifact
+    art = tmp_path / "swap"
+    cr = compile_ruleset(parse_seclang(
+        'SecRule ARGS "@rx (?i)drop\\s+table" '
+        '"id:955000,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"'))
+    cr.save(art)
+    rc = dbg.main(["ruleset", "--server", "127.0.0.1:19901",
+                   "--swap", str(art)])
+    assert rc == 0
+    conf = json.loads(urllib.request.urlopen(
+        "http://127.0.0.1:19901/configuration", timeout=10).read())
+    assert conf["rules"] == 1 and conf["ruleset"] == cr.version
+    # old rules gone, new rule live
+    s = socket.socket(socket.AF_UNIX)
+    s.connect(server)
+    s.sendall(encode_request(
+        Request(uri="/q?a=1;drop+table+users"), req_id=9001))
+    s.sendall(encode_request(
+        Request(uri="/q?a=1+union+select+2"), req_id=9002))
+    reader, got = FrameReader(RESP_MAGIC), {}
+    s.settimeout(120)
+    while len(got) < 2:
+        for f in reader.feed(s.recv(65536)):
+            r = decode_response(f)
+            got[r["req_id"]] = r
+    s.close()
+    assert got[9001]["attack"] and 955000 in got[9001]["rule_ids"]
+    assert not got[9002]["attack"]
