@@ -64,8 +64,12 @@ def validate(candidate: Ingress,
     cfg = build_configuration(merged, g)
     text = render(cfg, g)
     problems = lint_rendered(text)
-    if cfg.errors:
-        problems.extend(cfg.errors)
+    # Only errors attributable to the CANDIDATE reject it: a pre-existing
+    # Ingress with a bad annotation (created before the webhook, or while
+    # it was down) must not deadlock admission of every other object.
+    # Extractor errors are prefixed with the owning ingress key.
+    problems.extend(e for e in cfg.errors
+                    if e.startswith(candidate.key + ":"))
     if problems:
         return Review(allowed=False, messages=problems)
     return Review(allowed=True)

@@ -20,7 +20,15 @@ from ingress_plus_tpu.control.objects import ConfigMap
 
 
 def _parse_bool(v: str) -> bool:
-    return v.strip().lower() in ("true", "on", "1", "yes")
+    """Strict: unrecognized spellings raise so from_configmap keeps the
+    default and REPORTS (a typo in `fail-open` must not silently flip
+    fail-open→fail-closed)."""
+    s = v.strip().lower()
+    if s in ("true", "on", "1", "yes"):
+        return True
+    if s in ("false", "off", "0", "no"):
+        return False
+    raise ValueError("not a boolean: %r" % v)
 
 
 @dataclass

@@ -64,7 +64,7 @@ def main(argv=None) -> int:
             out = _call(args.server, "/configuration/ruleset",
                         {"path": args.swap,
                          "paranoia_level": args.paranoia})
-    except OSError as e:
+    except (OSError, ValueError) as e:  # ValueError covers bad --set JSON
         print("error: %s" % e, file=sys.stderr)
         return 1
     print(out.strip())

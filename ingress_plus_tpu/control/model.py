@@ -86,14 +86,17 @@ def build_configuration(
     out = Configuration()
     servers: Dict[str, Server] = {}
 
+    # one extract per Ingress (extraction is not idempotent on ex.errors,
+    # and the 256-Ingress config shouldn't pay double parse work)
+    extracted = {ing.key: ex.extract(ing) for ing in ingresses}
+
     # stable tenant ids: sorted ingress keys, 1-based (0 = full ruleset)
     with_subset = sorted(
-        ing.key for ing in ingresses
-        if ex.extract(ing).rule_subset)
+        key for key, det in extracted.items() if det.rule_subset)
     tenant_of = {key: i + 1 for i, key in enumerate(with_subset)}
 
     for ing in sorted(ingresses, key=lambda i: i.key):
-        det = _apply_globals(ex.extract(ing), g)
+        det = _apply_globals(extracted[ing.key], g)
         det.tenant = tenant_of.get(ing.key, 0)
         if det.tenant:
             out.tenants[det.tenant] = (ing.key, tuple(det.rule_subset))

@@ -112,10 +112,14 @@ class DetectionPipeline:
         self._install(ruleset, paranoia_level)
 
     def warm_shape(self, B: int, L: int, Q_pad: int) -> None:
-        """Pre-compile one engine executable (serving swap path)."""
+        """Pre-compile one engine executable (serving swap path).
+
+        dtypes must match the live path exactly (uint8 tokens from
+        pad_rows) — jit keys executables on dtype, so an int32 warm
+        compiles a cache entry real traffic never hits."""
         n_sv = len(STREAMS) * len(VARIANTS)
         self.engine.detect(
-            np.zeros((B, L), np.int32), np.zeros((B,), np.int32),
+            np.zeros((B, L), np.uint8), np.zeros((B,), np.int32),
             np.zeros((B,), np.int32), np.zeros((B, n_sv), np.int8), Q_pad)
         self.seen_shapes.add((B, L, Q_pad))
 

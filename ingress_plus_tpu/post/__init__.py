@@ -1,0 +1,35 @@
+"""Postanalytics subsystem — the reference's L5 layer (SURVEY.md §1, §3.4).
+
+In the reference, the nginx wallarm module asynchronously serializes each
+request's detection result to a Tarantool in-memory queue (iproto TCP);
+cron-driven wruby scripts aggregate hits into attacks and POST them to the
+Wallarm cloud, `brute-detect` scans request rates, collectd scrapes the
+module's `/wallarm-status` counters, and `sync-node` pulls fresh rulesets
+(proton.db) for hot-swap.  All of that is OFF the request hot path: the
+queue being down never blocks traffic.
+
+TPU-native equivalents here, same contracts:
+
+    HitQueue        — bounded in-memory queue (Tarantool analog); lossy
+                      under pressure (drop-oldest + counter), never blocks
+    aggregate_*     — hits → attacks windowed aggregation (export-attacks†)
+    NodeCounters    — /wallarm-status counters (collectd feed analog)
+    BruteDetector   — request-rate detection (brute-detect† analog)
+    Exporter        — periodic drain → spool/POST (cloud-export analog;
+                      this build has zero egress, so the wire sink is a
+                      jsonl spool + optional HTTP hook)
+    RulesetWatcher  — sync-node† analog: watches for new compiled-ruleset
+                      artifacts and triggers the serve loop's hot-swap
+"""
+
+from ingress_plus_tpu.post.queue import Hit, HitQueue
+from ingress_plus_tpu.post.aggregate import Attack, aggregate_attacks
+from ingress_plus_tpu.post.counters import NodeCounters
+from ingress_plus_tpu.post.brute import BruteDetector
+from ingress_plus_tpu.post.export import Exporter, RulesetWatcher
+from ingress_plus_tpu.post.channel import PostChannel
+
+__all__ = [
+    "Hit", "HitQueue", "Attack", "aggregate_attacks", "NodeCounters",
+    "BruteDetector", "Exporter", "RulesetWatcher", "PostChannel",
+]

@@ -1,0 +1,87 @@
+"""Brute-force rate detection — the wruby `brute-detect`† script analog
+(SURVEY.md §2.3).
+
+The reference's cron script scans the postanalytics DB for high-rate
+request streams against auth-ish endpoints and raises "brute" attacks.
+Here the detector runs inside the exporter drain (same cadence position:
+off the hot path, over queued hits) using per-(tenant, client, path-key)
+sliding windows.  It consumes ALL hits (attack or not — brute force is
+mostly *clean* requests at high rate), which is why Hit records are
+enqueued for every request when a PostChannel is active, not only for
+attacks.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Sequence, Tuple
+
+from ingress_plus_tpu.post.queue import Hit
+from ingress_plus_tpu.post.aggregate import Attack
+
+# path substrings that mark an auth-shaped target (the reference keys on
+# configured "protected" endpoints; this default list mirrors its docs)
+AUTH_MARKERS = ("login", "signin", "sign-in", "auth", "password", "passwd",
+                "session", "token", "register", "wp-login")
+
+
+def _path_key(uri: str) -> str:
+    path = uri.split("?", 1)[0].lower()
+    return path[:128]
+
+
+def is_auth_path(uri: str) -> bool:
+    p = _path_key(uri)
+    return any(m in p for m in AUTH_MARKERS)
+
+
+@dataclass
+class BruteConfig:
+    window_s: float = 60.0
+    threshold: int = 25        # requests per window per (tenant,client,path)
+    auth_only: bool = True     # rate-watch only auth-shaped paths
+
+
+class BruteDetector:
+    def __init__(self, config: BruteConfig | None = None):
+        self.config = config or BruteConfig()
+        self._windows: Dict[Tuple[int, str, str], Deque[float]] = {}
+        # keys already reported this window, so one burst → one attack
+        self._reported: Dict[Tuple[int, str, str], float] = {}
+
+    def observe(self, hits: Sequence[Hit]) -> List[Attack]:
+        """Feed a drained batch of hits; returns newly detected brute
+        attacks (class "brute", one per offending key per window)."""
+        cfg = self.config
+        out: List[Attack] = []
+        for hit in hits:
+            if cfg.auth_only and not is_auth_path(hit.uri):
+                continue
+            key = (hit.tenant, hit.client, _path_key(hit.uri))
+            dq = self._windows.setdefault(key, deque())
+            dq.append(hit.ts)
+            while dq and hit.ts - dq[0] > cfg.window_s:
+                dq.popleft()
+            if len(dq) >= cfg.threshold:
+                last = self._reported.get(key, -1e18)
+                if hit.ts - last > cfg.window_s:
+                    self._reported[key] = hit.ts
+                    atk = Attack(tenant=hit.tenant, client=hit.client,
+                                 attack_class="brute", first_ts=dq[0],
+                                 last_ts=hit.ts)
+                    atk.count = len(dq)
+                    atk.sample_uris = [hit.uri[:256]]
+                    atk.sample_request_ids = [hit.request_id]
+                    out.append(atk)
+        self._gc(time.time())
+        return out
+
+    def _gc(self, now: float) -> None:
+        """Bound memory: drop idle windows (no hit for 2 windows)."""
+        dead = [k for k, dq in self._windows.items()
+                if not dq or now - dq[-1] > 2 * self.config.window_s]
+        for k in dead:
+            self._windows.pop(k, None)
+            self._reported.pop(k, None)

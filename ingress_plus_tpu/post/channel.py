@@ -1,0 +1,78 @@
+"""PostChannel — the serve loop's write side of postanalytics.
+
+The reference's wallarm module serializes each request's outcome to
+Tarantool in the nginx log phase, AFTER the response is on the wire
+(SURVEY.md §3.3 "log phase: async serialize ... off hot path").  The
+serve loop calls ``record`` after the verdict future resolves and the
+response frame is queued — an O(1) counter update + deque append; the
+exporter thread does everything heavy later.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ingress_plus_tpu.post.brute import BruteDetector
+from ingress_plus_tpu.post.counters import NodeCounters
+from ingress_plus_tpu.post.export import Exporter
+from ingress_plus_tpu.post.queue import Hit, HitQueue
+from ingress_plus_tpu.serve.normalize import Request
+
+_CLIENT_HEADERS = ("x-real-ip", "x-forwarded-for", "x-client-ip")
+
+
+def client_key(request: Request) -> str:
+    """Client identity for aggregation: proxy-provided real IP when the
+    nginx shim forwards it, else a stable per-connection fallback."""
+    lowered = {k.lower(): v for k, v in request.headers.items()}
+    for h in _CLIENT_HEADERS:
+        v = lowered.get(h)
+        if v:
+            return v.split(",")[0].strip()[:64]
+    return "-"
+
+
+class PostChannel:
+    def __init__(self, spool_dir: Optional[str] = None,
+                 http_url: Optional[str] = None,
+                 interval_s: float = 5.0,
+                 queue_len: int = 65536,
+                 brute: bool = True):
+        self.queue = HitQueue(maxlen=queue_len)
+        self.counters = NodeCounters()
+        self.exporter = Exporter(
+            self.queue, spool_dir=spool_dir, http_url=http_url,
+            interval_s=interval_s,
+            brute=BruteDetector() if brute else None)
+
+    def record(self, request: Request, verdict) -> None:
+        self.counters.record(
+            attack=verdict.attack, blocked=verdict.blocked,
+            fail_open=verdict.fail_open, classes=verdict.classes,
+            tenant=request.tenant, mode=request.mode)
+        # every request is queued (brute-detect needs clean-request rates);
+        # the aggregator ignores non-attacks for attack export
+        self.queue.put(Hit(
+            ts=time.time(), request_id=request.request_id,
+            tenant=request.tenant, client=client_key(request),
+            method=request.method, uri=request.uri[:512],
+            classes=tuple(verdict.classes),
+            rule_ids=tuple(verdict.rule_ids),
+            score=verdict.score, blocked=verdict.blocked,
+            attack=verdict.attack, fail_open=verdict.fail_open,
+            mode=request.mode))
+
+    def start(self) -> None:
+        self.exporter.start()
+
+    def close(self) -> None:
+        self.exporter.close()
+
+    def status(self) -> dict:
+        d = self.counters.snapshot()
+        d["queue"] = {"depth": len(self.queue), "dropped": self.queue.dropped,
+                      "total": self.queue.total}
+        d["export"] = {"attacks": self.exporter.exported_attacks,
+                       "errors": self.exporter.export_errors}
+        return d

@@ -1,0 +1,55 @@
+"""Node counters — the `/wallarm-status`† counters endpoint analog
+(SURVEY.md §3.5): the JSON the reference's collectd sidecar scrapes and
+forwards to the cloud.  Served by the serve loop at ``/wallarm-status``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+
+class NodeCounters:
+    """Monotonic counters, thread-safe, cheap enough for the verdict path
+    (single lock, integer adds)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.started = time.time()
+        self.requests = 0
+        self.attacks = 0
+        self.blocked = 0
+        self.monitored = 0         # attacks seen in monitoring mode
+        self.fail_open = 0
+        self.by_class: Dict[str, int] = {}
+        self.by_tenant: Dict[int, int] = {}   # attacks per tenant
+
+    def record(self, *, attack: bool, blocked: bool, fail_open: bool,
+               classes, tenant: int, mode: int) -> None:
+        with self._lock:
+            self.requests += 1
+            if fail_open:
+                self.fail_open += 1
+            if attack:
+                self.attacks += 1
+                if blocked:
+                    self.blocked += 1
+                elif mode == 1:
+                    self.monitored += 1
+                for c in classes:
+                    self.by_class[c] = self.by_class.get(c, 0) + 1
+                self.by_tenant[tenant] = self.by_tenant.get(tenant, 0) + 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "uptime_s": round(time.time() - self.started, 1),
+                "requests": self.requests,
+                "attacks": self.attacks,
+                "blocked": self.blocked,
+                "monitored": self.monitored,
+                "fail_open": self.fail_open,
+                "by_class": dict(self.by_class),
+                "by_tenant": {str(k): v for k, v in self.by_tenant.items()},
+            }

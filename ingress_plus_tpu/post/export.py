@@ -1,0 +1,211 @@
+"""Export + ruleset-sync loops — the cron sidecar analog (SURVEY.md §3.4).
+
+``Exporter`` is the `export-attacks`/`export-counters`† cadence: a
+background thread that periodically drains the HitQueue, folds hits into
+attacks (aggregate.py), runs brute detection, and delivers them to a sink.
+The reference POSTs to the Wallarm cloud over HTTPS; this build has zero
+egress, so the default sink is an append-only jsonl spool directory, with
+an optional HTTP hook for a reachable collector.  Delivery failure never
+raises into the serve path — failed batches are re-spooled and counted.
+
+``RulesetWatcher`` is the `sync-node`† analog: the reference cron pulls a
+fresh proton.db and hot-swaps the engine's ruleset.  Here: watch a
+directory for compiled-ruleset artifacts (compiler/ruleset.py save()
+format, `<name>.iptr/` with meta.json) newer than the running version and
+POST the serve loop's ``/configuration/ruleset`` endpoint, which performs
+the double-buffered on-device swap with no serve gap.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from pathlib import Path
+from typing import Callable, List, Optional
+
+from ingress_plus_tpu.post.aggregate import aggregate_attacks
+from ingress_plus_tpu.post.brute import BruteDetector
+from ingress_plus_tpu.post.queue import HitQueue
+
+
+class Exporter:
+    def __init__(
+        self,
+        queue: HitQueue,
+        spool_dir: Optional[str] = None,
+        http_url: Optional[str] = None,
+        interval_s: float = 5.0,
+        gap_s: float = 60.0,
+        brute: Optional[BruteDetector] = None,
+        max_drain: int = 100_000,
+    ):
+        self.queue = queue
+        self.spool_dir = Path(spool_dir) if spool_dir else None
+        self.http_url = http_url
+        self.interval_s = interval_s
+        self.gap_s = gap_s
+        self.brute = brute
+        self.max_drain = max_drain
+        self.exported_attacks = 0
+        self.export_errors = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.spool_dir:
+            self.spool_dir.mkdir(parents=True, exist_ok=True)
+
+    # ------------------------------------------------------------ core
+
+    def flush_once(self) -> int:
+        """One export cycle; returns number of attacks delivered."""
+        hits = self.queue.drain(self.max_drain)
+        if not hits:
+            return 0
+        attacks = aggregate_attacks(hits, gap_s=self.gap_s)
+        if self.brute is not None:
+            attacks.extend(self.brute.observe(hits))
+        if not attacks:
+            return 0
+        records = [a.to_dict() for a in attacks]
+        ok = self._deliver(records)
+        if ok:
+            self.exported_attacks += len(records)
+            return len(records)
+        self.export_errors += 1
+        return 0
+
+    def _deliver(self, records: List[dict]) -> bool:
+        delivered = False
+        if self.spool_dir is not None:
+            try:
+                path = self.spool_dir / "attacks.jsonl"
+                with path.open("a") as f:
+                    for r in records:
+                        f.write(json.dumps(r) + "\n")
+                delivered = True
+            except OSError:
+                pass
+        if self.http_url:
+            try:
+                req = urllib.request.Request(
+                    self.http_url, data=json.dumps(records).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=5).read()
+                delivered = True
+            except Exception:
+                # cloud unreachable: spool already has the data (if
+                # configured); otherwise count the loss, never raise
+                pass
+        return delivered
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="ipt-exporter", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.flush_once()
+            except Exception:
+                self.export_errors += 1
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        try:
+            self.flush_once()
+        except Exception:
+            self.export_errors += 1
+
+
+class RulesetWatcher:
+    """Poll ``artifact_dir`` for compiled-ruleset artifacts and hot-swap
+    the serve loop when a version not yet running appears.
+
+    Artifact layout (compiler/ruleset.py save()): ``<dir>/<name>.npz`` +
+    ``<dir>/<name>.json`` whose JSON carries a content-hash ``version``.
+    Newest meta mtime wins.  The swap itself
+    is the serve loop's job (double-buffered device puts); this watcher
+    only triggers it — exactly the reference's cron→module split.
+    """
+
+    def __init__(self, artifact_dir: str, serve_http: str,
+                 interval_s: float = 10.0,
+                 poster: Optional[Callable[[str, dict], dict]] = None):
+        self.artifact_dir = Path(artifact_dir)
+        self.serve_http = serve_http  # host:port
+        self.interval_s = interval_s
+        self.current_version: Optional[str] = None
+        self.swaps = 0
+        self.errors = 0
+        self._poster = poster or self._http_post
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _http_post(self, path: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            "http://%s%s" % (self.serve_http, path),
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read() or b"{}")
+
+    def newest_artifact(self) -> Optional[Path]:
+        """Base path (no suffix) of the newest complete artifact pair."""
+        if not self.artifact_dir.is_dir():
+            return None
+        cands = [p for p in self.artifact_dir.glob("*.json")
+                 if p.with_suffix(".npz").is_file()]
+        if not cands:
+            return None
+        return max(cands, key=lambda p: p.stat().st_mtime).with_suffix("")
+
+    def check_once(self) -> bool:
+        """Returns True if a swap was triggered."""
+        art = self.newest_artifact()
+        if art is None:
+            return False
+        try:
+            version = json.loads(
+                art.with_suffix(".json").read_text()).get("version")
+        except (OSError, json.JSONDecodeError):
+            self.errors += 1
+            return False
+        if version is None or version == self.current_version:
+            return False
+        try:
+            out = self._poster("/configuration/ruleset", {"path": str(art)})
+        except Exception:
+            self.errors += 1
+            return False
+        self.current_version = out.get("ruleset", version)
+        self.swaps += 1
+        return True
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="ipt-ruleset-watch", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.check_once()
+            except Exception:
+                self.errors += 1
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

@@ -34,10 +34,11 @@ from ingress_plus_tpu.serve.protocol import (
 
 class ServeLoop:
     def __init__(self, batcher: Batcher, socket_path: str,
-                 http_port: int = 0):
+                 http_port: int = 0, post=None):
         self.batcher = batcher
         self.socket_path = socket_path
         self.http_port = http_port
+        self.post = post  # PostChannel | None — postanalytics write side
         self.started = time.time()
         self.connections = 0
         self._servers = []
@@ -53,7 +54,14 @@ class ServeLoop:
         classes_index = {c: i for i, c in enumerate(
             self.batcher.pipeline.ruleset.classes)}
 
-        async def respond(req_id: int, verdict) -> None:
+        async def respond(req_id: int, verdict, request=None) -> None:
+            # postanalytics write (log-phase analog): after the verdict is
+            # final, before the frame hits the wire — O(1), lossy, off-path
+            if self.post is not None and request is not None:
+                try:
+                    self.post.record(request, verdict)
+                except Exception:
+                    pass  # postanalytics must never break delivery
             data = encode_response(
                 req_id, verdict.attack, verdict.blocked, verdict.fail_open,
                 verdict.score,
@@ -97,12 +105,12 @@ class ServeLoop:
                     task = asyncio.ensure_future(afut)
                     pending.add(task)
 
-                    def _done(t, req_id=req_id):
+                    def _done(t, req_id=req_id, request=request):
                         pending.discard(t)
                         if (not t.cancelled() and t.exception() is None
                                 and not writer.is_closing()):
                             rt = asyncio.ensure_future(
-                                respond(req_id, t.result()))
+                                respond(req_id, t.result(), request))
                             pending.add(rt)
                             rt.add_done_callback(pending.discard)
                     task.add_done_callback(_done)
@@ -145,6 +153,19 @@ class ServeLoop:
             % (self.batcher.pipeline.ruleset.version,
                self.batcher.pipeline.ruleset.n_rules),
         ]
+        if self.post is not None:
+            lines += [
+                "# TYPE ipt_post_queue_depth gauge",
+                "ipt_post_queue_depth %d" % len(self.post.queue),
+                "# TYPE ipt_post_dropped_total counter",
+                "ipt_post_dropped_total %d" % self.post.queue.dropped,
+                "# TYPE ipt_post_attacks_exported_total counter",
+                "ipt_post_attacks_exported_total %d"
+                % self.post.exporter.exported_attacks,
+                "# TYPE ipt_post_export_errors_total counter",
+                "ipt_post_export_errors_total %d"
+                % self.post.exporter.export_errors,
+            ]
         return "\n".join(lines) + "\n"
 
     async def _handle_http(self, reader: asyncio.StreamReader,
@@ -195,13 +216,26 @@ class ServeLoop:
         if path.startswith("/metrics"):
             return ("200 OK", "text/plain; version=0.0.4",
                     self._metrics_text().encode())
+        if path.startswith("/wallarm-status"):
+            # node counters JSON — the reference module's `/wallarm-status`
+            # endpoint that collectd scrapes (SURVEY.md §3.5)
+            status = (self.post.status() if self.post is not None
+                      else {"postanalytics": "disabled"})
+            return ("200 OK", "application/json",
+                    json.dumps(status).encode())
         if path == "/configuration/tenants" and method == "POST":
             # EP tenant table push: {"<tenant>": ["tag", ...], ...}
             from ingress_plus_tpu.control.sync import MAX_TENANTS
             try:
                 raw = json.loads(payload or b"{}")
-                tags = {int(k): tuple(map(str, v))
-                        for k, v in raw.items()}
+                for v in raw.values():
+                    # a bare string would iterate per-character into tags
+                    # that match no rule → all-False mask → scan bypass
+                    if not isinstance(v, (list, tuple)) or not all(
+                            isinstance(t, str) for t in v):
+                        raise ValueError(
+                            "tag values must be lists of strings")
+                tags = {int(k): tuple(v) for k, v in raw.items()}
                 if any(t < 0 or t >= MAX_TENANTS for t in tags):
                     raise ValueError(
                         "tenant ids must be in [0, %d)" % MAX_TENANTS)
@@ -220,6 +254,8 @@ class ServeLoop:
 
             def _load_and_swap():
                 spec = json.loads(payload or b"{}")
+                if not isinstance(spec, dict):
+                    raise ValueError("payload must be a JSON object")
                 cr = CompiledRuleset.load(spec["path"])
                 self.batcher.swap_ruleset(
                     cr, paranoia_level=int(spec.get("paranoia_level", 2)))
@@ -227,7 +263,7 @@ class ServeLoop:
 
             try:
                 cr = await loop.run_in_executor(None, _load_and_swap)
-            except (KeyError, OSError, ValueError,
+            except (KeyError, OSError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 return ("400 Bad Request", "application/json",
                         json.dumps({"error": str(e)}).encode())
@@ -273,6 +309,8 @@ class ServeLoop:
         for s in self._servers:
             s.close()
         self.batcher.close()
+        if self.post is not None:
+            self.post.close()
 
 
 def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
@@ -330,6 +368,15 @@ def main(argv=None) -> None:
                          "box's TPU sits behind a ~70ms tunnel, so "
                          "latency-sensitive serving may prefer cpu")
     ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--spool-dir", default=None,
+                    help="postanalytics spool dir (attacks.jsonl); "
+                         "enables the exporter loop")
+    ap.add_argument("--export-url", default=None,
+                    help="optional HTTP collector for attack export")
+    ap.add_argument("--export-interval-s", type=float, default=5.0)
+    ap.add_argument("--artifact-dir", default=None,
+                    help="watch this dir for compiled-ruleset artifacts "
+                         "and hot-swap (sync-node analog)")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -340,8 +387,31 @@ def main(argv=None) -> None:
     batcher = build_default_batcher(
         mode=args.mode, rules_dir=args.rules_dir, max_batch=args.max_batch,
         max_delay_s=args.max_delay_us / 1e6, warmup=not args.no_warmup)
-    loop = ServeLoop(batcher, args.socket, args.http_port)
-    asyncio.run(loop.run_forever())
+
+    post = None
+    if args.spool_dir or args.export_url:
+        from ingress_plus_tpu.post import PostChannel
+
+        post = PostChannel(spool_dir=args.spool_dir,
+                           http_url=args.export_url,
+                           interval_s=args.export_interval_s)
+        post.start()
+
+    watcher = None
+    if args.artifact_dir and args.http_port:
+        from ingress_plus_tpu.post import RulesetWatcher
+
+        watcher = RulesetWatcher(args.artifact_dir,
+                                 "127.0.0.1:%d" % args.http_port)
+        watcher.current_version = batcher.pipeline.ruleset.version
+        watcher.start()
+
+    loop = ServeLoop(batcher, args.socket, args.http_port, post=post)
+    try:
+        asyncio.run(loop.run_forever())
+    finally:
+        if watcher is not None:
+            watcher.close()
 
 
 if __name__ == "__main__":

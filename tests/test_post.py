@@ -1,0 +1,219 @@
+"""Postanalytics subsystem tests (SURVEY.md §2.3/§3.4 analog layer):
+queue pressure semantics, hits→attacks aggregation, brute-rate detection,
+counters, exporter spool, ruleset watcher hot-swap trigger."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from ingress_plus_tpu.post import (
+    BruteDetector,
+    Exporter,
+    Hit,
+    HitQueue,
+    NodeCounters,
+    PostChannel,
+    RulesetWatcher,
+    aggregate_attacks,
+)
+from ingress_plus_tpu.post.brute import BruteConfig
+from ingress_plus_tpu.serve.normalize import Request
+
+
+def mk_hit(ts=0.0, client="1.2.3.4", tenant=0, classes=("sqli",),
+           uri="/a?x=1", attack=True, blocked=True, score=5, rid="r1",
+           rule_ids=(942100,)):
+    return Hit(ts=ts, request_id=rid, tenant=tenant, client=client,
+               method="GET", uri=uri, classes=classes, rule_ids=rule_ids,
+               score=score, blocked=blocked, attack=attack)
+
+
+# ------------------------------------------------------------------ queue
+
+def test_queue_bounded_drop_oldest():
+    q = HitQueue(maxlen=3)
+    for i in range(5):
+        q.put(mk_hit(ts=float(i), rid=str(i)))
+    assert len(q) == 3
+    assert q.dropped == 2
+    assert q.total == 5
+    got = q.drain()
+    assert [h.request_id for h in got] == ["2", "3", "4"]
+    assert len(q) == 0
+
+
+def test_queue_drain_partial():
+    q = HitQueue()
+    for i in range(10):
+        q.put(mk_hit(ts=float(i)))
+    assert len(q.drain(4)) == 4
+    assert len(q) == 6
+
+
+# -------------------------------------------------------------- aggregate
+
+def test_aggregate_groups_by_tenant_client_class():
+    hits = [
+        mk_hit(ts=1, client="a", classes=("sqli",)),
+        mk_hit(ts=2, client="a", classes=("sqli",), blocked=False),
+        mk_hit(ts=3, client="b", classes=("sqli",)),
+        mk_hit(ts=4, client="a", classes=("xss",)),
+        mk_hit(ts=5, client="a", classes=(), attack=False),  # clean: skipped
+    ]
+    attacks = aggregate_attacks(hits, gap_s=60)
+    keys = {(a.client, a.attack_class): a for a in attacks}
+    assert set(keys) == {("a", "sqli"), ("b", "sqli"), ("a", "xss")}
+    a = keys[("a", "sqli")]
+    assert a.count == 2 and a.blocked == 1
+    assert a.first_ts == 1 and a.last_ts == 2
+
+
+def test_aggregate_session_window_splits():
+    hits = [mk_hit(ts=t) for t in (0, 10, 200, 210)]
+    attacks = aggregate_attacks(hits, gap_s=60)
+    assert len(attacks) == 2
+    assert sorted(a.count for a in attacks) == [2, 2]
+
+
+def test_aggregate_multiclass_hit_fans_out():
+    attacks = aggregate_attacks([mk_hit(classes=("sqli", "xss"))])
+    assert {a.attack_class for a in attacks} == {"sqli", "xss"}
+
+
+def test_aggregate_samples_bounded():
+    hits = [mk_hit(ts=i, rid=str(i), rule_ids=(i,)) for i in range(50)]
+    (a,) = aggregate_attacks(hits)
+    assert a.count == 50
+    assert len(a.sample_uris) <= a.MAX_SAMPLES
+    assert len(a.sample_rule_ids) <= a.MAX_SAMPLES
+
+
+# ------------------------------------------------------------------ brute
+
+def test_brute_detects_auth_burst_once_per_window():
+    det = BruteDetector(BruteConfig(window_s=60, threshold=5))
+    hits = [mk_hit(ts=float(i), uri="/wp-login.php", attack=False,
+                   blocked=False, classes=()) for i in range(20)]
+    attacks = det.observe(hits)
+    assert len(attacks) == 1
+    assert attacks[0].attack_class == "brute"
+    assert attacks[0].count >= 5
+
+
+def test_brute_ignores_non_auth_and_slow_rates():
+    det = BruteDetector(BruteConfig(window_s=60, threshold=5))
+    slow = [mk_hit(ts=float(i * 100), uri="/login", attack=False,
+                   classes=()) for i in range(20)]
+    other = [mk_hit(ts=float(i), uri="/search?q=x", attack=False,
+                    classes=()) for i in range(20)]
+    assert det.observe(slow) == []
+    assert det.observe(other) == []
+
+
+def test_brute_separate_clients_tracked_separately():
+    det = BruteDetector(BruteConfig(window_s=60, threshold=10))
+    hits = [mk_hit(ts=float(i), uri="/auth", client="c%d" % (i % 4),
+                   attack=False, classes=()) for i in range(36)]
+    assert det.observe(hits) == []  # 9 per client < 10
+
+
+# --------------------------------------------------------------- counters
+
+def test_counters_math():
+    c = NodeCounters()
+    c.record(attack=True, blocked=True, fail_open=False,
+             classes=["sqli"], tenant=1, mode=2)
+    c.record(attack=True, blocked=False, fail_open=False,
+             classes=["xss"], tenant=1, mode=1)
+    c.record(attack=False, blocked=False, fail_open=True,
+             classes=[], tenant=0, mode=2)
+    s = c.snapshot()
+    assert s["requests"] == 3 and s["attacks"] == 2
+    assert s["blocked"] == 1 and s["monitored"] == 1
+    assert s["fail_open"] == 1
+    assert s["by_class"] == {"sqli": 1, "xss": 1}
+    assert s["by_tenant"] == {"1": 2}
+
+
+# --------------------------------------------------------------- exporter
+
+def test_exporter_spools_attacks(tmp_path):
+    q = HitQueue()
+    for i in range(3):
+        q.put(mk_hit(ts=float(i)))
+    q.put(mk_hit(ts=4.0, attack=False, classes=()))
+    ex = Exporter(q, spool_dir=str(tmp_path), brute=None)
+    n = ex.flush_once()
+    assert n == 1  # one (tenant, client, class) attack
+    lines = (tmp_path / "attacks.jsonl").read_text().splitlines()
+    rec = json.loads(lines[0])
+    assert rec["class"] == "sqli" and rec["count"] == 3
+    assert ex.flush_once() == 0  # queue empty now
+
+
+def test_exporter_brute_included(tmp_path):
+    q = HitQueue()
+    for i in range(30):
+        q.put(mk_hit(ts=float(i), uri="/login", attack=False, classes=()))
+    ex = Exporter(q, spool_dir=str(tmp_path),
+                  brute=BruteDetector(BruteConfig(threshold=5)))
+    assert ex.flush_once() == 1
+    rec = json.loads((tmp_path / "attacks.jsonl").read_text().splitlines()[0])
+    assert rec["class"] == "brute"
+
+
+# ---------------------------------------------------------------- channel
+
+def test_post_channel_records_and_status():
+    ch = PostChannel(brute=False)
+
+    class V:
+        attack, blocked, fail_open = True, True, False
+        classes, rule_ids, score = ["sqli"], [942100], 5
+
+    req = Request(uri="/x?a=1", headers={"X-Real-IP": "9.9.9.9, proxy"},
+                  request_id="rq1", tenant=3)
+    ch.record(req, V())
+    st = ch.status()
+    assert st["requests"] == 1 and st["attacks"] == 1
+    assert st["queue"]["depth"] == 1
+    hit = ch.queue.drain()[0]
+    assert hit.client == "9.9.9.9"
+    assert hit.tenant == 3
+
+
+# ---------------------------------------------------------------- watcher
+
+def test_ruleset_watcher_triggers_swap_on_new_artifact(tmp_path):
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+
+    cr = compile_ruleset(parse_seclang(
+        'SecRule ARGS "@rx (?i)union\\s+select" '
+        '"id:942100,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"'))
+    art = tmp_path / "v1"
+    cr.save(art)  # writes v1.npz + v1.json
+
+    posts = []
+
+    def poster(path, payload):
+        posts.append((path, payload))
+        return {"ruleset": cr.version}
+
+    w = RulesetWatcher(str(tmp_path), "127.0.0.1:0", poster=poster)
+    assert w.check_once() is True
+    assert posts[0][0] == "/configuration/ruleset"
+    assert posts[0][1]["path"] == str(art)
+    assert w.current_version == cr.version
+    # same version again: no second swap
+    assert w.check_once() is False
+    assert w.swaps == 1
+
+
+def test_ruleset_watcher_empty_dir(tmp_path):
+    w = RulesetWatcher(str(tmp_path), "127.0.0.1:0",
+                       poster=lambda p, d: {})
+    assert w.check_once() is False
+    assert w.errors == 0

@@ -48,13 +48,15 @@ def server(tmp_path_factory, loadgen_bin):
     rules_dir.mkdir()
     (rules_dir / "tiny.conf").write_text(TINY_RULES)
     sock = str(tmp / "ipt.sock")
+    spool = tmp / "spool"
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     proc = subprocess.Popen(
         [sys.executable, "-m", "ingress_plus_tpu.serve",
          "--socket", sock, "--http-port", "19901",
          "--rules-dir", str(rules_dir), "--platform", "cpu",
-         "--max-delay-us", "1000", "--no-warmup"],
+         "--max-delay-us", "1000", "--no-warmup",
+         "--spool-dir", str(spool), "--export-interval-s", "0.5"],
         cwd=str(REPO), env=env,
         stderr=subprocess.PIPE, text=True)
     # wait for the socket
@@ -73,7 +75,13 @@ def server(tmp_path_factory, loadgen_bin):
     else:
         proc.kill()
         raise RuntimeError("server socket never appeared")
-    yield sock
+
+    class Srv(str):  # str so existing uses (socket path) keep working
+        pass
+
+    srv = Srv(sock)
+    srv.spool = spool
+    yield srv
     proc.terminate()
     proc.wait(timeout=10)
 
@@ -109,6 +117,27 @@ def test_health_and_metrics(server):
         "http://127.0.0.1:19901/metrics", timeout=10).read().decode()
     assert "ipt_requests_total" in metrics
     assert "ipt_ruleset_info" in metrics
+
+
+def test_wallarm_status_and_spool(server):
+    """Postanalytics read side: counters endpoint + exporter spool
+    (the /wallarm-status† + export-attacks† analogs, SURVEY.md §3.4/§3.5).
+    Runs after loadgen so counters are non-zero."""
+    st = json.loads(urllib.request.urlopen(
+        "http://127.0.0.1:19901/wallarm-status", timeout=10).read())
+    assert st["requests"] > 0
+    assert st["attacks"] > 0
+    assert st["blocked"] == st["attacks"]
+    assert "queue" in st and "export" in st
+    # exporter flushes every 0.5s; attacks.jsonl must appear with records
+    spool_file = server.spool / "attacks.jsonl"
+    for _ in range(40):
+        if spool_file.exists() and spool_file.read_text().strip():
+            break
+        time.sleep(0.25)
+    recs = [json.loads(l) for l in spool_file.read_text().splitlines()]
+    assert sum(r["count"] for r in recs) > 0
+    assert all("class" in r and "client" in r for r in recs)
 
 
 def test_python_client_roundtrip(server):
