@@ -1,0 +1,143 @@
+"""Detection-quality eval — the F1 gate (BASELINE.md north star: "zero
+detection-F1 regression", measured per SURVEY.md §4 build plan item (4)).
+
+Benchmark config #1 replays a labeled 10k-request CRS corpus through the
+engine in monitoring mode and scores verdicts against ground truth.  The
+reference's CPU libproton is closed-source and absent, so the ground
+truth is the corpus's own labels (attack payloads planted from per-class
+templates; utils/corpus.py) — the differential-oracle role the survey
+assigns to Python `re` is already inside the pipeline's confirm stage,
+making this an end-to-end verdict-level score, not a regex-level one.
+
+Also measures config #1's throughput leg: requests/s of the full
+in-process detection pipeline on the chosen platform (cpu = the baseline
+an operator would run today; tpu = the north-star path).
+
+CLI:
+    python -m ingress_plus_tpu.utils.evalf1 --n 2048 --platform cpu
+prints one JSON report to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from dataclasses import dataclass
+from typing import Dict, List
+
+
+@dataclass
+class F1Report:
+    n: int
+    tp: int
+    fp: int
+    fn: int
+    tn: int
+    blocked: int
+    precision: float
+    recall: float
+    f1: float
+    per_class_recall: Dict[str, float]
+    false_positives: List[str]   # uris of misfired benign requests (≤20)
+    false_negatives: List[str]   # "class: uri" of missed attacks (≤20)
+    req_s: float
+    platform: str
+    mode: str
+    n_rules: int
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+
+def evaluate(n: int = 2048, mode: str = "monitoring",
+             batch: int = 256, seed: int = 20260729,
+             pipeline=None, attack_fraction: float = 0.3,
+             warm: bool = True) -> F1Report:
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.utils.corpus import generate_corpus
+
+    if pipeline is None:
+        pipeline = DetectionPipeline(
+            compile_ruleset(load_bundled_rules()), mode=mode)
+    corpus = generate_corpus(n=n, seed=seed,
+                             attack_fraction=attack_fraction)
+
+    if warm and corpus:
+        pipeline.detect([lr.request for lr in corpus[:batch]])  # compile
+
+    verdicts = []
+    t0 = time.perf_counter()
+    for i in range(0, len(corpus), batch):
+        verdicts.extend(pipeline.detect(
+            [lr.request for lr in corpus[i : i + batch]]))
+    dt = time.perf_counter() - t0
+
+    tp = fp = fn = tn = 0
+    class_total: Dict[str, int] = {}
+    class_hit: Dict[str, int] = {}
+    fps: List[str] = []
+    fns: List[str] = []
+    for lr, v in zip(corpus, verdicts):
+        if lr.is_attack:
+            cls = lr.attack_class or "?"
+            class_total[cls] = class_total.get(cls, 0) + 1
+            if v.attack:
+                tp += 1
+                class_hit[cls] = class_hit.get(cls, 0) + 1
+            else:
+                fn += 1
+                if len(fns) < 20:
+                    fns.append("%s: %s" % (cls, lr.request.uri[:120]))
+        else:
+            if v.attack:
+                fp += 1
+                if len(fps) < 20:
+                    fps.append(lr.request.uri[:120])
+            else:
+                tn += 1
+
+    from ingress_plus_tpu.utils.corpus import f1_score
+
+    precision = tp / (tp + fp) if tp + fp else 1.0
+    recall = tp / (tp + fn) if tp + fn else 1.0
+    import jax
+
+    return F1Report(
+        n=len(corpus), tp=tp, fp=fp, fn=fn, tn=tn,
+        blocked=sum(v.blocked for v in verdicts),
+        precision=round(precision, 4), recall=round(recall, 4),
+        f1=round(f1_score(tp, fp, fn), 4),
+        per_class_recall={
+            c: round(class_hit.get(c, 0) / t, 4)
+            for c, t in sorted(class_total.items())},
+        false_positives=fps, false_negatives=fns,
+        req_s=round(len(corpus) / dt, 1),
+        platform=jax.default_backend(), mode=pipeline.mode,
+        n_rules=pipeline.ruleset.n_rules)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ingress_plus_tpu.utils.evalf1")
+    ap.add_argument("--n", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--mode", default="monitoring")
+    ap.add_argument("--seed", type=int, default=20260729)
+    ap.add_argument("--attack-fraction", type=float, default=0.3)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    rep = evaluate(n=args.n, mode=args.mode, batch=args.batch,
+                   seed=args.seed, attack_fraction=args.attack_fraction)
+    print(json.dumps(rep.to_dict()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,25 @@
+"""F1 gate (BASELINE.md: zero detection-F1 regression) — the verdict-level
+differential eval of SURVEY.md §4 item (4), small-n so CPU CI stays fast.
+The floor is strict: the corpus's planted payloads are all CRS-covered
+classes, so missing any is a real regression, and benign-traffic FPs are
+the reference-parity killer."""
+
+from ingress_plus_tpu.utils.evalf1 import evaluate
+
+
+def test_f1_on_bundled_ruleset():
+    rep = evaluate(n=384, batch=128, seed=7)
+    assert rep.n == 384
+    assert rep.recall >= 0.99, rep.false_negatives
+    assert rep.precision >= 0.99, rep.false_positives
+    assert rep.f1 >= 0.99
+    # every attack class planted by the corpus must be detected
+    assert all(r >= 0.95 for r in rep.per_class_recall.values()), \
+        rep.per_class_recall
+
+
+def test_f1_monitoring_never_blocks():
+    rep = evaluate(n=128, batch=128, seed=11, mode="monitoring", warm=False)
+    assert rep.req_s > 0
+    assert rep.blocked == 0  # monitoring mode must never block (corpus-wide)
+    assert rep.mode == "monitoring"
