@@ -144,12 +144,19 @@ class DetectionPipeline:
             ]
 
     def _detect_inner(self, requests: List[Request], t0: float) -> List[Verdict]:
+        self.stats.requests += len(requests)
+        self.stats.batches += 1
+        return self.finalize(requests, self.prefilter(requests), t0)
+
+    def prefilter(self, requests: List[Request]) -> np.ndarray:
+        """Scan stage: requests → masked (Q, R) prefilter rule hits.
+        Exposed separately so the streaming body path (serve/stream.py)
+        can scan a body-less request now and OR in chunk-carried body
+        hits at stream end."""
         rows = rows_for_requests(requests, needed_sv=self.needed_sv)
         data_list, req_list, sv_list = merge_rows(rows)
         Q = len(requests)
         stats = self.stats
-        stats.requests += Q
-        stats.batches += 1
 
         R = self.ruleset.n_rules
         rule_hits = np.zeros((self._pad_q(Q), R), dtype=bool)
@@ -192,20 +199,28 @@ class DetectionPipeline:
             for rh_dev in dispatched:
                 rule_hits |= np.asarray(rh_dev)
             stats.engine_us += int((time.perf_counter() - te0) * 1e6)
-        rule_hits = rule_hits[:Q]
+        rule_hits = self.mask_hits(requests, rule_hits[:Q])
+        stats.prefilter_rule_hits += int(rule_hits.sum())
+        return rule_hits
 
-        # tenant (EP) masking: a tenant only runs its own rule subset; ids
-        # outside the table fall back to row 0 = full ruleset (a wrap onto
-        # another tenant's restricted mask would be a scan bypass)
+    def mask_hits(self, requests: List[Request],
+                  rule_hits: np.ndarray) -> np.ndarray:
+        """Tenant (EP) + paranoia masking, idempotent.
+
+        Tenant ids outside the table fall back to row 0 = full ruleset (a
+        wrap onto another tenant's restricted mask would be a scan
+        bypass)."""
         if self.tenant_rule_mask is not None:
             tenants = np.asarray([r.tenant for r in requests], dtype=np.int32)
             T = self.tenant_rule_mask.shape[0]
             tenants = np.where((tenants >= 0) & (tenants < T), tenants, 0)
             rule_hits = rule_hits & self.tenant_rule_mask[tenants]
+        return rule_hits & self.paranoia_mask[None, :]
 
-        rule_hits = rule_hits & self.paranoia_mask[None, :]
-        stats.prefilter_rule_hits += int(rule_hits.sum())
-
+    def finalize(self, requests: List[Request], rule_hits: np.ndarray,
+                 t0: float) -> List[Verdict]:
+        """Confirm + scoring stage on already-masked prefilter hits."""
+        stats = self.stats
         # CPU confirm: exact semantics, only on (request, rule) hits
         tc0 = time.perf_counter()
         verdicts: List[Verdict] = []

@@ -25,6 +25,18 @@ from typing import List, Optional
 
 from ingress_plus_tpu.models.pipeline import DetectionPipeline, Verdict
 from ingress_plus_tpu.serve.normalize import Request
+from ingress_plus_tpu.serve.stream import StreamEngine, StreamState
+
+
+def _safe_set(fut: "Future", value) -> None:
+    """set_result that tolerates a concurrent cancel (client vanished
+    between our done() check and the set): losing that race must never
+    kill the dispatch thread — that would hang every future verdict."""
+    try:
+        if not fut.done():
+            fut.set_result(value)
+    except Exception:
+        pass
 
 
 @dataclass
@@ -39,6 +51,10 @@ class BatcherStats:
     # (late); the CLIENT side (nginx shim) enforces its own fail-open
     # budget — this counter is the server-side visibility of overruns.
     deadline_overruns: int = 0
+    # streaming-body path (config #5)
+    streams: int = 0
+    stream_chunks: int = 0
+    stream_bytes: int = 0
 
     def snapshot(self) -> dict:
         d = self.__dict__.copy()
@@ -59,6 +75,7 @@ class Batcher:
         hard_deadline_s: float = 0.25,
     ):
         self.pipeline = pipeline
+        self.stream_engine = StreamEngine(pipeline)
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
         self.hard_deadline_s = hard_deadline_s
@@ -75,8 +92,35 @@ class Batcher:
     def submit(self, request: Request) -> "Future[Verdict]":
         fut: "Future[Verdict]" = Future()
         self.stats.submitted += 1
-        self._q.put((time.perf_counter(), request, fut))
+        self._q.put(("req", time.perf_counter(), request, fut))
         return fut
+
+    # --------------------------------------------- streaming-body API
+    # (config #5).  Queue FIFO guarantees begin ≤ chunks ≤ finish order;
+    # all state mutation happens on the dispatch thread.
+
+    def begin_stream(self, request: Request) -> StreamState:
+        """Register a streaming request: uri/args/headers scan happens
+        now (prefilter), body arrives via feed_chunk."""
+        handle = self.stream_engine.begin(request)
+        self.stats.streams += 1
+        self._q.put(("begin", time.perf_counter(), handle, None))
+        return handle
+
+    def feed_chunk(self, handle: StreamState, data: bytes) -> None:
+        self.stats.stream_chunks += 1
+        self.stats.stream_bytes += len(data)
+        self._q.put(("chunk", time.perf_counter(), (handle, data), None))
+
+    def finish_stream(self, handle: StreamState) -> "Future[Verdict]":
+        fut: "Future[Verdict]" = Future()
+        self._q.put(("finish", time.perf_counter(), handle, fut))
+        return fut
+
+    def abort_stream(self, handle: StreamState) -> None:
+        """Client went away mid-stream: drop remaining work (bool write
+        is atomic; the dispatch thread skips aborted streams)."""
+        handle.aborted = True
 
     def swap_ruleset(self, ruleset, paranoia_level: int = 2) -> None:
         """Hot-swap (sync-node† analog), zero serve gap:
@@ -99,6 +143,9 @@ class Batcher:
         new.stats = old.stats  # counters span swaps (Prometheus contract)
         with self._swap_lock:
             self.pipeline = new
+            # in-flight streams carry old-table state words; StreamEngine
+            # detects the version change and fails them open at finish
+            self.stream_engine.pipeline = new
             self._reapply_tenants()
 
     def set_tenant_tags(self, tags) -> None:
@@ -130,7 +177,7 @@ class Batcher:
         except queue.Empty:
             return []
         batch = [first]
-        deadline = first[0] + self.max_delay_s
+        deadline = first[1] + self.max_delay_s
         while len(batch) < self.max_batch:
             remaining = deadline - time.perf_counter()
             if remaining <= 0:
@@ -154,27 +201,72 @@ class Batcher:
             if not batch:
                 continue
             t0 = time.perf_counter()
-            sizes = len(batch)
             self.stats.batches += 1
-            self.stats.max_batch_seen = max(self.stats.max_batch_seen, sizes)
-            for ts, _, _ in batch:
+            reqs = [(ts, r, fut) for k, ts, r, fut in batch if k == "req"]
+            begins = [h for k, _, h, _ in batch if k == "begin"]
+            chunks = [p for k, _, p, _ in batch if k == "chunk"]
+            finishes = [(h, fut) for k, _, h, fut in batch if k == "finish"]
+            self.stats.max_batch_seen = max(self.stats.max_batch_seen,
+                                            len(reqs))
+            for ts, _, _ in reqs:
                 self.stats.queue_delay_us_sum += int((t0 - ts) * 1e6)
-            requests = [r for _, r, _ in batch]
-            try:
-                with self._swap_lock:
-                    verdicts = self.pipeline.detect(requests)
-            except Exception:
-                verdicts = [
-                    Verdict(request_id=r.request_id, blocked=False,
-                            attack=False, classes=[], rule_ids=[], score=0,
-                            fail_open=True)
-                    for r in requests
-                ]
+            with self._swap_lock:
+                self._stream_step(begins, chunks, finishes)
+                requests = [r for _, r, _ in reqs]
+                if requests:
+                    try:
+                        verdicts = self.pipeline.detect(requests)
+                    except Exception:
+                        verdicts = [
+                            Verdict(request_id=r.request_id, blocked=False,
+                                    attack=False, classes=[], rule_ids=[],
+                                    score=0, fail_open=True)
+                            for r in requests
+                        ]
+                    for (_, _, fut), v in zip(reqs, verdicts):
+                        _safe_set(fut, v)
             took = time.perf_counter() - t0
             self.stats.batch_us_sum += int(took * 1e6)
             if took > self.hard_deadline_s:
-                self.stats.deadline_overruns += len(batch)
-            for (_, _, fut), v in zip(batch, verdicts):
-                if not fut.done():
-                    fut.set_result(v)
-            self.stats.completed += len(batch)
+                self.stats.deadline_overruns += len(reqs) + len(finishes)
+            self.stats.completed += len(reqs) + len(finishes)
+
+    def _stream_step(self, begins, chunks, finishes) -> None:
+        """Streaming work for one dispatch cycle (called under the swap
+        lock, on the dispatch thread — sole owner of stream state)."""
+        if not (begins or chunks or finishes):
+            return
+        try:
+            live = [h for h in begins if not h.aborted]
+            if live:
+                base = self.pipeline.prefilter([h.request for h in live])
+                for i, h in enumerate(live):
+                    h.base_hits = base[i]
+            items = []
+            for h, data in chunks:
+                if not (h.aborted or h.error):
+                    items.extend(h.feed(data))
+            for h, _ in finishes:
+                if not (h.aborted or h.error):
+                    items.extend(h.flush())
+            if items:
+                self.stream_engine.scan(items)
+        except Exception:
+            # fail-open contract: a scan error poisons only the streams
+            # in this cycle, each resolves pass-and-flag at finish
+            for h in begins:
+                h.error = True
+            for h, _ in chunks:
+                h.error = True
+            for h, _ in finishes:
+                h.error = True
+        for h, fut in finishes:
+            try:
+                v = self.stream_engine.finish(h)
+            except Exception:
+                self.pipeline.stats.fail_open += 1
+                v = Verdict(
+                    request_id=h.request.request_id, blocked=False,
+                    attack=False, classes=[], rule_ids=[], score=0,
+                    fail_open=True)
+            _safe_set(fut, v)

@@ -30,6 +30,20 @@ Response frame (server → client):
     cls ids u8 × n_cls
     rule ids u64 × n_rules
 
+Streaming bodies (benchmark config #5): a request frame whose mode byte
+has ``MODE_STREAM`` (0x80) set opens a body stream — its inline body bytes
+are the FIRST chunk; further chunks arrive as chunk frames:
+
+Chunk frame (client → server):
+    magic   u32  'KTPI' (b"KTPI")
+    length  u32
+    req_id  u64
+    flags   u8   — bit0 last chunk
+    bytes: body chunk data (may be empty, e.g. a bare last marker)
+
+The verdict response is sent after the last chunk (the reference's
+incremental body parse† finishes at body end the same way).
+
 Responses may arrive out of order; req_id correlates.
 """
 
@@ -44,19 +58,37 @@ from ingress_plus_tpu.serve.normalize import Request
 
 REQ_MAGIC = b"QTPI"
 RESP_MAGIC = b"RTPI"
+CHUNK_MAGIC = b"KTPI"
 
 _REQ_HEAD = struct.Struct("<QIBB III")   # req_id tenant mode m_len | uri hdr body
 _RESP_HEAD = struct.Struct("<QBIBH")     # req_id flags score n_cls n_rules
+_CHUNK_HEAD = struct.Struct("<QB")       # req_id flags
 
 FLAG_ATTACK = 1
 FLAG_BLOCKED = 2
 FLAG_FAIL_OPEN = 4
+
+MODE_STREAM = 0x80     # request-frame mode bit: body arrives chunked
+CHUNK_LAST = 1         # chunk-frame flag: final chunk of the stream
 
 MAX_FRAME = 8 << 20  # 8MB: bounded memory per connection
 
 
 class ProtocolError(Exception):
     pass
+
+
+def encode_chunk(req_id: int, data: bytes, last: bool = False) -> bytes:
+    payload = _CHUNK_HEAD.pack(req_id, CHUNK_LAST if last else 0) + data
+    return CHUNK_MAGIC + struct.pack("<I", len(payload)) + payload
+
+
+def decode_chunk(payload: bytes) -> Tuple[int, bool, bytes]:
+    """Returns (req_id, last, data)."""
+    if len(payload) < _CHUNK_HEAD.size:
+        raise ProtocolError("short chunk frame")
+    req_id, flags = _CHUNK_HEAD.unpack_from(payload)
+    return req_id, bool(flags & CHUNK_LAST), payload[_CHUNK_HEAD.size:]
 
 
 def encode_request(req: Request, req_id: int, mode: int = 2) -> bytes:
@@ -133,25 +165,38 @@ def decode_response(payload: bytes):
 
 
 class FrameReader:
-    """Incremental frame splitter for a byte stream."""
+    """Incremental frame splitter for a single-kind byte stream (thin
+    wrapper over MultiFrameReader so the framing loop exists once)."""
 
     def __init__(self, magic: bytes):
-        self.magic = magic
-        self.buf = bytearray()
+        self._inner = MultiFrameReader({magic: "frame"})
 
     def feed(self, data: bytes) -> List[bytes]:
+        return [payload for _, payload in self._inner.feed(data)]
+
+
+class MultiFrameReader:
+    """Frame splitter for a stream interleaving several frame kinds
+    (request + chunk frames on the server's inbound side)."""
+
+    def __init__(self, kinds: dict):
+        self.kinds = {bytes(m): name for m, name in kinds.items()}
+        self.buf = bytearray()
+
+    def feed(self, data: bytes) -> List[Tuple[str, bytes]]:
         self.buf += data
         out = []
         while True:
             if len(self.buf) < 8:
                 break
-            if bytes(self.buf[:4]) != self.magic:
+            kind = self.kinds.get(bytes(self.buf[:4]))
+            if kind is None:
                 raise ProtocolError("bad magic %r" % bytes(self.buf[:4]))
             (length,) = struct.unpack_from("<I", self.buf, 4)
             if length > MAX_FRAME:
                 raise ProtocolError("frame too large: %d" % length)
             if len(self.buf) < 8 + length:
                 break
-            out.append(bytes(self.buf[8:8 + length]))
+            out.append((kind, bytes(self.buf[8:8 + length])))
             del self.buf[:8 + length]
         return out

@@ -23,13 +23,21 @@ from pathlib import Path
 from typing import Optional
 
 from ingress_plus_tpu.serve.batcher import Batcher
+from ingress_plus_tpu.serve.stream import StreamState
 from ingress_plus_tpu.serve.protocol import (
+    CHUNK_MAGIC,
+    MODE_STREAM,
     REQ_MAGIC,
-    FrameReader,
+    MultiFrameReader,
     ProtocolError,
+    decode_chunk,
     decode_request,
     encode_response,
 )
+
+
+MAX_STREAMS_PER_CONN = 256  # bounded per-connection stream state
+_OVERFLOW = object()        # sentinel: stream rejected by the cap
 
 
 class ServeLoop:
@@ -48,8 +56,9 @@ class ServeLoop:
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
         self.connections += 1
-        frames = FrameReader(REQ_MAGIC)
+        frames = MultiFrameReader({REQ_MAGIC: "req", CHUNK_MAGIC: "chunk"})
         loop = asyncio.get_running_loop()
+        streams = {}  # req_id → StreamState | None (None = mode-off stream)
         write_lock = asyncio.Lock()
         classes_index = {c: i for i, c in enumerate(
             self.batcher.pipeline.ruleset.classes)}
@@ -84,10 +93,76 @@ class ServeLoop:
                     payloads = frames.feed(data)
                 except ProtocolError:
                     break  # corrupt stream: drop the connection
-                for payload in payloads:
+                for kind, payload in payloads:
+                    if kind == "chunk":
+                        try:
+                            req_id, last, chunk = decode_chunk(payload)
+                        except ProtocolError:
+                            continue
+                        if req_id not in streams:
+                            continue  # unknown/expired stream: ignore
+                        handle = streams[req_id]
+                        if isinstance(handle, StreamState) and chunk:
+                            self.batcher.feed_chunk(handle, chunk)
+                        if last:
+                            streams.pop(req_id)
+                            if not isinstance(handle, StreamState):
+                                # mode off (clean pass) or overflow
+                                # (pass + fail-open flag), unscanned
+                                from ingress_plus_tpu.models.pipeline import \
+                                    Verdict
+                                t = asyncio.ensure_future(respond(
+                                    req_id, Verdict(
+                                        request_id=str(req_id),
+                                        blocked=False, attack=False,
+                                        classes=[], rule_ids=[], score=0,
+                                        fail_open=handle is _OVERFLOW)))
+                                pending.add(t)
+                                t.add_done_callback(pending.discard)
+                                continue
+                            fut = self.batcher.finish_stream(handle)
+                            afut = asyncio.wrap_future(fut, loop=loop)
+                            task = asyncio.ensure_future(afut)
+                            pending.add(task)
+
+                            def _sdone(t, req_id=req_id,
+                                       request=handle.request):
+                                pending.discard(t)
+                                if (not t.cancelled()
+                                        and t.exception() is None
+                                        and not writer.is_closing()):
+                                    rt = asyncio.ensure_future(respond(
+                                        req_id, t.result(), request))
+                                    pending.add(rt)
+                                    rt.add_done_callback(pending.discard)
+                            task.add_done_callback(_sdone)
+                        continue
                     try:
                         req_id, mode, request = decode_request(payload)
                     except ProtocolError:
+                        continue
+                    if mode & MODE_STREAM:
+                        # streaming body: inline body = first chunk
+                        eff_mode = mode & ~MODE_STREAM
+                        if eff_mode == 0:
+                            streams[req_id] = None
+                            continue
+                        if (sum(1 for h in streams.values()
+                                if isinstance(h, StreamState))
+                                >= MAX_STREAMS_PER_CONN):
+                            # per-connection memory bound (the MAX_FRAME
+                            # bound of the non-stream path): excess
+                            # streams pass fail-open, never accumulate
+                            streams[req_id] = _OVERFLOW
+                            self.batcher.pipeline.stats.fail_open += 1
+                            continue
+                        request.mode = eff_mode
+                        first_chunk = request.body
+                        request.body = b""
+                        handle = self.batcher.begin_stream(request)
+                        streams[req_id] = handle
+                        if first_chunk:
+                            self.batcher.feed_chunk(handle, first_chunk)
                         continue
                     if mode == 0:
                         # wallarm_mode off: no processing at all (reference
@@ -115,6 +190,9 @@ class ServeLoop:
                             rt.add_done_callback(pending.discard)
                     task.add_done_callback(_done)
         finally:
+            for handle in streams.values():
+                if isinstance(handle, StreamState):
+                    self.batcher.abort_stream(handle)
             for t in pending:
                 t.cancel()
             writer.close()
@@ -140,6 +218,12 @@ class ServeLoop:
             "ipt_fail_open_total %d" % p.fail_open,
             "# TYPE ipt_deadline_overruns_total counter",
             "ipt_deadline_overruns_total %d" % s.deadline_overruns,
+            "# TYPE ipt_streams_total counter",
+            "ipt_streams_total %d" % s.streams,
+            "# TYPE ipt_stream_chunks_total counter",
+            "ipt_stream_chunks_total %d" % s.stream_chunks,
+            "# TYPE ipt_stream_bytes_total counter",
+            "ipt_stream_bytes_total %d" % s.stream_bytes,
             "# TYPE ipt_scan_rows_total counter",
             "ipt_scan_rows_total %d" % p.rows,
             "# TYPE ipt_scan_bytes_total counter",

@@ -1,0 +1,294 @@
+"""Streaming body scan — benchmark config #5 (BASELINE.md: chunked 1 MB
+POST bodies, pipelined sliding-window NFA).
+
+The reference's wallarm module parses request bodies incrementally as
+nginx feeds it chunks (SURVEY.md §5 "long-context": `client_body_buffer_
+size`†, incremental parse†).  TPU-native equivalent: the bitap NFA state
+vector (W uint32 words per scan row) is carried across chunk scans —
+``ops.scan.scan_bytes`` takes and returns (state, match) — so a body is
+scanned exactly once no matter how it arrives, and a factor spanning a
+chunk boundary is matched by the carried automaton state, no overlap
+window needed.
+
+Pieces:
+
+- ``IncrementalVariant`` — streaming normalization: the one-shot
+  ``variant_chain`` decoders (urlDecodeUni, htmlEntityDecode, squash)
+  applied incrementally, holding back the longest suffix that could be a
+  split escape/entity (≤5 B for ``%uXXXX``, ≤9 B for ``&entity;``) until
+  the next chunk completes it.  Guaranteed: concat(feed*, flush) ==
+  variant_chain(concat(chunks)) — the equivalence test's contract.
+- ``StreamState`` — per-request carry: per-variant (match, state) word
+  vectors + decoder tails + the capped raw body kept for the CPU confirm
+  stage.
+- ``StreamEngine`` — batches chunk scans across concurrent streams into
+  fixed-shape ``scan_bytes_jit`` dispatches (CHUNK_L-wide waves, pow2 row
+  padding: few executables, any chunk size), and at stream end folds the
+  final match words into rule hits (host factor→rule math, the same
+  mapping engine.detect_rows does on-device) and hands them to
+  ``DetectionPipeline.finalize``.
+
+Sequence-parallel note: this is the single-core sequential chunk chain —
+the SURVEY.md §5 default.  The cross-chip ring (state handoff via
+``ppermute`` when one giant body is sharded over the mesh) lives in
+``parallel/stream.py``; both carry the same O(W) state.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ingress_plus_tpu.compiler.bitap import (
+    factors_to_rules,
+    matches_to_factors,
+)
+from ingress_plus_tpu.compiler.seclang import STREAM_INDEX
+from ingress_plus_tpu.compiler.ruleset import VARIANTS
+from ingress_plus_tpu.models.pipeline import DetectionPipeline, Verdict
+from ingress_plus_tpu.ops.scan import pad_rows, scan_bytes_jit
+from ingress_plus_tpu.serve.normalize import (
+    Request,
+    html_entity_decode,
+    remove_nulls,
+    squash,
+    url_decode_uni,
+)
+
+# longest suffix that might be an incomplete %-escape: %, %X, %u, %uX..%uXXX
+_URL_TAIL = re.compile(rb"%(?:u[0-9a-fA-F]{0,3}|[0-9a-fA-F])?$")
+# longest suffix that might be an incomplete &entity; (decoder looks for
+# ';' within 9 bytes of '&', so anything longer can never decode)
+_ENT_TAIL = re.compile(rb"&[#a-zA-Z0-9]{0,8}$")
+
+CHUNK_L = 2048          # one scan-wave width → one executable per B tier
+DEFAULT_BODY_CAP = 1 << 20   # raw bytes kept for the confirm stage
+DEFAULT_SCAN_CAP = 16 << 20  # bytes scanned per stream (DoS bound): the
+                             # reference bounds body inspection the same
+                             # way (client_body_buffer_size† and module
+                             # parse limits); beyond it chunks pass
+                             # unscanned and the verdict is flagged
+
+
+def _split_tail(buf: bytes, pat: re.Pattern) -> Tuple[bytes, bytes]:
+    m = pat.search(buf)
+    return (buf[: m.start()], buf[m.start():]) if m else (buf, b"")
+
+
+class IncrementalVariant:
+    """Streaming ``variant_chain``: feed() returns the next decoded
+    increment, flush() releases held tails at end of stream."""
+
+    def __init__(self, variant: int):
+        self.variant = variant
+        self._url_tail = b""   # undecoded bytes (possible split escape)
+        self._ent_tail = b""   # url-decoded bytes (possible split entity)
+
+    def feed(self, data: bytes) -> bytes:
+        v = self.variant
+        if v == 0:
+            return data
+        if v == 3:
+            return squash(data)
+        safe, self._url_tail = _split_tail(self._url_tail + data, _URL_TAIL)
+        dec = remove_nulls(url_decode_uni(safe))
+        if v == 1:
+            return dec
+        safe2, self._ent_tail = _split_tail(self._ent_tail + dec, _ENT_TAIL)
+        out = html_entity_decode(safe2)
+        return squash(out) if v == 4 else out
+
+    def flush(self) -> bytes:
+        v = self.variant
+        if v in (0, 3):
+            return b""
+        out = remove_nulls(url_decode_uni(self._url_tail))
+        self._url_tail = b""
+        if v == 1:
+            return out
+        out = html_entity_decode(self._ent_tail + out)
+        self._ent_tail = b""
+        return squash(out) if v == 4 else out
+
+
+class StreamState:
+    """Carry for one streaming request.  Touched only by the batcher's
+    dispatch thread — no locking."""
+
+    def __init__(self, request: Request, variants: Sequence[Tuple[int, int]],
+                 n_words: int, version: str, body_cap: int,
+                 scan_cap: int = DEFAULT_SCAN_CAP):
+        self.request = request          # body stays b"" (scanned separately)
+        self.variants = list(variants)  # [(variant_id, sv_id), ...]
+        self.norms = [IncrementalVariant(v) for v, _ in self.variants]
+        self.match = np.zeros((len(self.variants), n_words), np.uint32)
+        self.state = np.zeros((len(self.variants), n_words), np.uint32)
+        self.version = version          # ruleset fingerprint at begin
+        self.base_hits: Optional[np.ndarray] = None  # (R,) from prefilter
+        self.acc = bytearray()          # capped raw body for confirm
+        self.body_cap = body_cap
+        self.scan_cap = scan_cap
+        self.body_len = 0
+        self.chunks = 0
+        self.truncated = False
+        self.aborted = False
+        self.error = False
+        self.t0 = time.perf_counter()
+
+    def feed(self, data: bytes) -> List[Tuple["StreamState", int, bytes]]:
+        """Raw chunk → per-variant scan increments."""
+        self.chunks += 1
+        scan_room = self.scan_cap - self.body_len
+        self.body_len += len(data)
+        room = self.body_cap - len(self.acc)
+        if room > 0:
+            self.acc += data[:room]
+        if len(data) > max(room, 0):
+            self.truncated = True
+        if scan_room <= 0:
+            if data:
+                self.truncated = True
+            return []  # scan bound hit: remaining bytes pass unscanned
+        if len(data) > scan_room:
+            self.truncated = True
+            data = data[:scan_room]
+        return [(self, vi, inc) for vi in range(len(self.variants))
+                if (inc := self.norms[vi].feed(data))]
+
+    def flush(self) -> List[Tuple["StreamState", int, bytes]]:
+        return [(self, vi, inc) for vi in range(len(self.variants))
+                if (inc := self.norms[vi].flush())]
+
+
+class StreamEngine:
+    """Chunk-batch scanner + stream finisher, driven by the batcher's
+    dispatch thread under its swap lock."""
+
+    def __init__(self, pipeline: DetectionPipeline,
+                 body_cap: int = DEFAULT_BODY_CAP):
+        self.pipeline = pipeline
+        self.body_cap = body_cap
+
+    # -------------------------------------------------------- lifecycle
+
+    def begin(self, request: Request) -> StreamState:
+        p = self.pipeline
+        si = STREAM_INDEX["body"]
+        variants = [(v, si * len(VARIANTS) + v) for v in range(len(VARIANTS))
+                    if si * len(VARIANTS) + v in p.needed_sv]
+        return StreamState(request, variants, p.ruleset.tables.n_words,
+                           p.ruleset.version, self.body_cap)
+
+    # ------------------------------------------------------------ scan
+
+    def scan(self, items: List[Tuple[StreamState, int, bytes]]) -> None:
+        """Scan increments for many (stream, variant) rows, batched into
+        CHUNK_L-wide waves.  Items for the same (stream, variant) are
+        concatenated in arrival order (state carry makes that exact)."""
+        merged: Dict[Tuple[int, int], List] = {}
+        for st, vi, data in items:
+            if st.aborted or st.error:
+                continue
+            if st.version != self.pipeline.ruleset.version:
+                # ruleset swapped mid-stream: old state words are
+                # meaningless against the new tables → fail-open at finish
+                st.error = True
+                continue
+            merged.setdefault((id(st), vi), [st, vi, bytearray()])[2].extend(
+                data)
+        all_rows = list(merged.values())
+        if not all_rows:
+            return
+        # Dedup identical scan work — the streaming twin of merge_rows'
+        # one-shot row dedup: rows whose (state, match, pending bytes) are
+        # byte-identical produce identical results (pure recurrence), so
+        # scan one representative and broadcast.  Dominant benign case: a
+        # plain-ASCII body makes every variant's increment equal raw's and
+        # their carried states stay equal → 1 scanned row, not ~5.
+        groups: Dict[bytes, List] = {}
+        for r in all_rows:
+            st, vi, data = r
+            key = (st.state[vi].tobytes() + st.match[vi].tobytes()
+                   + bytes(data))
+            groups.setdefault(key, []).append(r)
+        rows = [g[0] for g in groups.values()]
+        followers = {id(g[0]): g[1:] for g in groups.values()}
+        tables = self.pipeline.engine.tables.scan
+        offs = [0] * len(rows)
+        while True:
+            wave = [(i, r) for i, r in enumerate(rows)
+                    if offs[i] < len(r[2])]
+            if not wave:
+                break
+            chunks = []
+            for i, r in wave:
+                seg = bytes(r[2][offs[i] : offs[i] + CHUNK_L])
+                offs[i] += len(seg)
+                chunks.append(seg)
+            B = 8
+            while B < len(wave):
+                B *= 2
+            tokens, lengths = pad_rows(
+                chunks + [b""] * (B - len(wave)),
+                max_len=CHUNK_L, round_to=CHUNK_L)
+            W = wave[0][1][0].state.shape[1]
+            state = np.zeros((B, W), np.uint32)
+            match = np.zeros_like(state)
+            for j, (i, r) in enumerate(wave):
+                st, vi = r[0], r[1]
+                state[j] = st.state[vi]
+                match[j] = st.match[vi]
+            m_out, s_out = scan_bytes_jit(tables, tokens, lengths,
+                                          state, match)
+            m_out = np.asarray(m_out)
+            s_out = np.asarray(s_out)
+            for j, (i, r) in enumerate(wave):
+                for st, vi, _ in (r, *followers[id(r)]):
+                    st.state[vi] = s_out[j]
+                    st.match[vi] = m_out[j]
+
+    # ---------------------------------------------------------- finish
+
+    def finish(self, st: StreamState) -> Verdict:
+        p = self.pipeline
+        req = st.request
+        if st.error or st.version != p.ruleset.version:
+            p.stats.fail_open += 1
+            return Verdict(request_id=req.request_id, blocked=False,
+                           attack=False, classes=[], rule_ids=[], score=0,
+                           fail_open=True, elapsed_us=int(
+                               (time.perf_counter() - st.t0) * 1e6))
+        cr = p.ruleset
+        bt = cr.tables
+        R = cr.n_rules
+        body_hits = np.zeros((R,), dtype=bool)
+        applies_any = np.zeros((R,), dtype=bool)
+        for vi, (v, sv) in enumerate(st.variants):
+            rr = factors_to_rules(bt, matches_to_factors(bt, st.match[vi]))
+            applies = cr.rule_sv_mask[:, sv]
+            body_hits |= rr & applies
+            applies_any |= applies
+        # rules with no prefilter factors must always reach confirm when
+        # any applicable row was scanned (mirrors engine.detect_rows)
+        body_hits |= (bt.rule_nfactors == 0) & applies_any
+
+        hits = body_hits
+        if st.base_hits is not None:
+            hits = hits | st.base_hits
+        hits = p.mask_hits([req], hits[None])
+
+        # confirm runs on the accumulated (capped) raw body
+        confirm_req = Request(
+            method=req.method, uri=req.uri, headers=req.headers,
+            body=bytes(st.acc), tenant=req.tenant,
+            request_id=req.request_id, mode=req.mode)
+        v = p.finalize([confirm_req], hits, st.t0)[0]
+        # scan/confirm caps were hit: the verdict is based on a prefix —
+        # surface it the fail-open way (pass-and-flag, never silently)
+        if st.truncated and not v.attack:
+            v.fail_open = True
+        p.stats.requests += 1
+        return v

@@ -16,12 +16,17 @@ namespace ipt {
 constexpr uint32_t kMaxFrame = 8u << 20;
 inline const char kReqMagic[4] = {'Q', 'T', 'P', 'I'};
 inline const char kRespMagic[4] = {'R', 'T', 'P', 'I'};
+inline const char kChunkMagic[4] = {'K', 'T', 'P', 'I'};
 
 enum Flags : uint8_t {
   kAttack = 1,
   kBlocked = 2,
   kFailOpen = 4,
 };
+
+// Request-frame mode bit: body arrives as chunk frames (config #5).
+constexpr uint8_t kModeStream = 0x80;
+constexpr uint8_t kChunkLast = 1;
 
 struct Request {
   uint64_t req_id = 0;
@@ -81,6 +86,23 @@ inline std::string EncodeRequest(const Request& r) {
   std::string frame;
   frame.reserve(8 + payload.size());
   frame.append(kReqMagic, 4);
+  detail::put<uint32_t>(&frame, static_cast<uint32_t>(payload.size()));
+  frame += payload;
+  return frame;
+}
+
+// Body chunk for a stream opened with kModeStream (twin of
+// protocol.py encode_chunk: req_id u64, flags u8, data).
+inline std::string EncodeChunk(uint64_t req_id, const std::string& data,
+                               bool last) {
+  std::string payload;
+  payload.reserve(9 + data.size());
+  detail::put<uint64_t>(&payload, req_id);
+  payload.push_back(static_cast<char>(last ? kChunkLast : 0));
+  payload += data;
+  std::string frame;
+  frame.reserve(8 + payload.size());
+  frame.append(kChunkMagic, 4);
   detail::put<uint32_t>(&frame, static_cast<uint32_t>(payload.size()));
   frame += payload;
   return frame;

@@ -164,6 +164,38 @@ def test_python_client_roundtrip(server):
     assert 942100 in got[7001]["rule_ids"]
     assert not got[7002]["attack"]
 
+def test_streaming_body_over_wire(server):
+    """Config #5 on the wire: MODE_STREAM request + chunk frames; attack
+    spans a chunk boundary; a parallel clean stream passes."""
+    from ingress_plus_tpu.serve.normalize import Request
+    from ingress_plus_tpu.serve.protocol import (
+        MODE_STREAM, RESP_MAGIC, FrameReader, decode_response,
+        encode_chunk, encode_request)
+
+    s = socket.socket(socket.AF_UNIX)
+    s.connect(server)
+    s.settimeout(120)
+    # stream 1: attack split across inline-first-chunk + two chunk frames
+    s.sendall(encode_request(Request(uri="/upload", body=b"f=1 uni"),
+                             req_id=6001, mode=2 | MODE_STREAM))
+    s.sendall(encode_chunk(6001, b"on sele"))
+    # stream 2 interleaved: clean
+    s.sendall(encode_request(Request(uri="/upload2"),
+                             req_id=6002, mode=2 | MODE_STREAM))
+    s.sendall(encode_chunk(6002, b"hello "))
+    s.sendall(encode_chunk(6001, b"ct pass from users", last=True))
+    s.sendall(encode_chunk(6002, b"world", last=True))
+    reader, got = FrameReader(RESP_MAGIC), {}
+    while len(got) < 2:
+        for f in reader.feed(s.recv(65536)):
+            r = decode_response(f)
+            got[r["req_id"]] = r
+    s.close()
+    assert got[6001]["attack"] and got[6001]["blocked"]
+    assert 942100 in got[6001]["rule_ids"]
+    assert not got[6002]["attack"]
+
+
 def test_configuration_endpoints_and_dbg(server, tmp_path):
     """Dynamic-config plane: tenant push, ruleset hot-swap (sync-node
     analog), inspection — all through the dbg CLI code path."""
