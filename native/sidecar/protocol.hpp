@@ -108,6 +108,27 @@ inline std::string EncodeChunk(uint64_t req_id, const std::string& data,
   return frame;
 }
 
+// Verdict frame, server → client.  The sidecar also synthesizes these for
+// fail-open verdicts (deadline exceeded / upstream down — SURVEY.md §5
+// "fail-open contract is load-bearing").
+inline std::string EncodeResponse(const Response& r) {
+  std::string payload;
+  payload.reserve(16 + r.class_ids.size() + 8 * r.rule_ids.size());
+  detail::put<uint64_t>(&payload, r.req_id);
+  payload.push_back(static_cast<char>(r.flags));
+  detail::put<uint32_t>(&payload, r.score);
+  payload.push_back(static_cast<char>(r.class_ids.size()));
+  detail::put<uint16_t>(&payload, static_cast<uint16_t>(r.rule_ids.size()));
+  for (uint8_t c : r.class_ids) payload.push_back(static_cast<char>(c));
+  for (uint64_t id : r.rule_ids) detail::put<uint64_t>(&payload, id);
+  std::string frame;
+  frame.reserve(8 + payload.size());
+  frame.append(kRespMagic, 4);
+  detail::put<uint32_t>(&frame, static_cast<uint32_t>(payload.size()));
+  frame += payload;
+  return frame;
+}
+
 inline Response DecodeResponse(const uint8_t* p, size_t n) {
   if (n < 16) throw std::runtime_error("short response frame");
   Response r;
@@ -127,28 +148,69 @@ inline Response DecodeResponse(const uint8_t* p, size_t n) {
   return r;
 }
 
-// Incremental splitter for the response stream.
-class FrameReader {
+// Fixed-header payload minimums, enforced at the framing layer so no
+// consumer ever indexes a header field out of bounds.
+constexpr size_t kMinRequestPayload = 26;   // _REQ_HEAD: Q I B B I I I
+constexpr size_t kMinResponsePayload = 16;  // _RESP_HEAD + counts
+constexpr size_t kMinChunkPayload = 9;      // _CHUNK_HEAD: Q B
+
+// Incremental splitter for a stream interleaving several frame kinds —
+// C++ twin of protocol.py's MultiFrameReader (the framing loop exists
+// once; per-direction readers are instantiations).
+class MultiFrameReader {
  public:
-  // Appends data; invokes cb(payload, len) per complete frame.
+  struct Kind {
+    const char* magic;  // 4 bytes
+    int kind;
+    size_t min_payload;
+  };
+
+  explicit MultiFrameReader(std::vector<Kind> kinds)
+      : kinds_(std::move(kinds)) {}
+
+  // Appends data; invokes cb(kind, payload, len) per complete frame.
+  // Throws on a protocol violation (unknown magic / bad length).
   template <typename Cb>
   void Feed(const uint8_t* data, size_t n, Cb cb) {
     buf_.insert(buf_.end(), data, data + n);
     size_t off = 0;
     while (buf_.size() - off >= 8) {
-      if (std::memcmp(buf_.data() + off, kRespMagic, 4) != 0)
-        throw std::runtime_error("bad response magic");
+      const Kind* k = nullptr;
+      for (const Kind& cand : kinds_)
+        if (std::memcmp(buf_.data() + off, cand.magic, 4) == 0) {
+          k = &cand;
+          break;
+        }
+      if (!k) throw std::runtime_error("bad frame magic");
       uint32_t len = detail::get<uint32_t>(buf_.data() + off + 4);
       if (len > kMaxFrame) throw std::runtime_error("oversized frame");
+      if (len < k->min_payload) throw std::runtime_error("short frame");
       if (buf_.size() - off < 8ull + len) break;
-      cb(buf_.data() + off + 8, len);
+      cb(k->kind, buf_.data() + off + 8, size_t(len));
       off += 8ull + len;
     }
     buf_.erase(buf_.begin(), buf_.begin() + off);
   }
 
  private:
+  std::vector<Kind> kinds_;
   std::vector<uint8_t> buf_;
+};
+
+// Single-kind reader for the response stream (loadgen / shim side).
+class FrameReader {
+ public:
+  FrameReader()
+      : inner_({{kRespMagic, 0, kMinResponsePayload}}) {}
+
+  template <typename Cb>
+  void Feed(const uint8_t* data, size_t n, Cb cb) {
+    inner_.Feed(data, n,
+                [&](int, const uint8_t* p, size_t len) { cb(p, len); });
+  }
+
+ private:
+  MultiFrameReader inner_;
 };
 
 }  // namespace ipt

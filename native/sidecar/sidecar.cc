@@ -1,0 +1,724 @@
+// ingress_plus_tpu native sidecar — the nginx-side native boundary of the
+// TPU detection path (SURVEY.md §3.3 TPU variant; §2.2 "C++ shim module or
+// location-level routing to sidecar").
+//
+// Role: many downstream connections (nginx shim workers / loadgen) fan in
+// over a unix socket; the sidecar muxes their request/chunk frames onto ONE
+// upstream connection to the Python serve loop (whose Batcher forms device
+// batches), fans verdicts back, and — critically — OWNS the fail-open SLO:
+//
+//   * per-request deadline (default 50ms): expired requests get a
+//     synthesized pass+fail_open verdict; a late upstream verdict is
+//     dropped and counted.  Traffic is never blocked on the WAF being slow
+//     (the reference's `wallarm-fallback` contract, SURVEY.md §5).
+//   * upstream down / reconnecting: requests fail open immediately; the
+//     sidecar reconnects with backoff (TPU-restart story: buffer nothing,
+//     fail open until the serve loop is back).
+//   * upstream backpressure: if the upstream outbuf exceeds its cap the
+//     sidecar sheds load by failing new requests open (overload).
+//
+// Single-threaded epoll event loop — the nginx-worker concurrency model the
+// reference's data plane uses; run N processes for N cores.
+//
+// Counters are served as one-shot JSON on --status-port (the
+// `/wallarm-status` analog scraped by collectd in the reference).
+
+#include <errno.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <netinet/in.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <memory>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "protocol.hpp"
+
+namespace {
+
+uint64_t NowNs() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + uint64_t(ts.tv_nsec);
+}
+
+struct Options {
+  std::string listen_path;
+  std::string upstream_path;
+  double deadline_ms = 50.0;
+  int status_port = 0;
+  size_t max_upstream_buf = 4u << 20;   // shed load past this backlog
+  size_t max_down_buf = 8u << 20;       // slow downstream reader → close
+  int reconnect_ms = 100;
+};
+
+struct Counters {
+  uint64_t requests_in = 0;
+  uint64_t chunks_in = 0;
+  uint64_t forwarded = 0;
+  uint64_t responses = 0;
+  uint64_t fail_open_deadline = 0;
+  uint64_t fail_open_upstream = 0;
+  uint64_t fail_open_overload = 0;
+  uint64_t late_responses = 0;
+  uint64_t down_conns_total = 0;
+  uint64_t down_conns_active = 0;
+  uint64_t bad_frames = 0;
+  uint64_t upstream_reconnects = 0;
+};
+
+// The downstream direction carries TWO frame types (requests + body
+// chunks); min payload lengths are enforced by the framing layer.
+inline ipt::MultiFrameReader MakeDownReader() {
+  return ipt::MultiFrameReader({
+      {ipt::kReqMagic, 0, ipt::kMinRequestPayload},
+      {ipt::kChunkMagic, 1, ipt::kMinChunkPayload},
+  });
+}
+
+struct DownConn {
+  int fd = -1;
+  uint64_t id = 0;  // monotonic; pending entries reference conns by id so a
+                    // reused fd can never receive another conn's verdict
+  ipt::MultiFrameReader reader = MakeDownReader();
+  std::string outbuf;
+  size_t out_off = 0;
+  bool want_out = false;
+  // orig req_ids of this conn's open body streams, so a dying conn (or an
+  // expired stream) can be aborted upstream — otherwise the serve loop's
+  // per-connection StreamState leaks on the long-lived mux connection
+  // until its per-conn cap trips and streaming fails open permanently
+  std::unordered_set<uint64_t> open_streams;
+};
+
+struct Pending {
+  uint64_t conn_id = 0;
+  uint64_t orig_id = 0;    // downstream's req_id, restored on the way back
+  uint64_t deadline_ns = 0;
+};
+
+class Sidecar {
+ public:
+  explicit Sidecar(const Options& opt) : opt_(opt) {}
+
+  int Run() {
+    ep_ = epoll_create1(0);
+    if (ep_ < 0) { perror("epoll_create1"); return 4; }
+    if (!OpenListener()) return 3;
+    if (opt_.status_port && !OpenStatusListener()) return 3;
+    ConnectUpstream();  // failure tolerated: requests fail open meanwhile
+
+    epoll_event events[128];
+    while (true) {
+      int timeout = NextTimeoutMs();
+      int nev = epoll_wait(ep_, events, 128, timeout);
+      if (nev < 0) {
+        if (errno == EINTR) continue;
+        perror("epoll_wait");
+        return 4;
+      }
+      for (int i = 0; i < nev; ++i) Dispatch(events[i]);
+      uint64_t now = NowNs();
+      ExpireDeadlines(now);
+      if (up_fd_ < 0 && now >= up_retry_at_ns_) ConnectUpstream();
+      else if (up_connecting_ && now >= up_connect_deadline_ns_)
+        DropUpstream();  // connect() never completed
+      FlushUpstream();
+      // (no per-conn flush sweep: every downstream write path flushes
+      // inline, and partial writes arm EPOLLOUT which re-enters FlushDown)
+      CloseDoomed();
+    }
+  }
+
+ private:
+  // ---------------------------------------------------------- setup
+
+  static void SetNonblock(int fd) { fcntl(fd, F_SETFL, O_NONBLOCK); }
+
+  bool OpenListener() {
+    listen_fd_ = socket(AF_UNIX, SOCK_STREAM, 0);
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, opt_.listen_path.c_str(),
+            sizeof(addr.sun_path) - 1);
+    unlink(opt_.listen_path.c_str());
+    if (bind(listen_fd_, (sockaddr*)&addr, sizeof addr) != 0) {
+      perror("bind(listen)");
+      return false;
+    }
+    if (listen(listen_fd_, 512) != 0) { perror("listen"); return false; }
+    SetNonblock(listen_fd_);
+    Register(listen_fd_, EPOLLIN, kTagListener, 0);
+    return true;
+  }
+
+  bool OpenStatusListener() {
+    status_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(status_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(uint16_t(opt_.status_port));
+    if (bind(status_fd_, (sockaddr*)&addr, sizeof addr) != 0) {
+      perror("bind(status)");
+      return false;
+    }
+    if (listen(status_fd_, 16) != 0) { perror("listen(status)"); return false; }
+    SetNonblock(status_fd_);
+    Register(status_fd_, EPOLLIN, kTagStatus, 0);
+    return true;
+  }
+
+  bool UpReady() const { return up_fd_ >= 0 && !up_connecting_; }
+
+  void ConnectUpstream() {
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    SetNonblock(fd);  // BEFORE connect: a blocking connect (full listen
+                      // backlog on a wedged serve loop) would freeze the
+                      // event loop and turn fail-open into a hang
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, opt_.upstream_path.c_str(),
+            sizeof(addr.sun_path) - 1);
+    int rc = connect(fd, (sockaddr*)&addr, sizeof addr);
+    if (rc != 0 && errno != EINPROGRESS && errno != EAGAIN) {
+      close(fd);
+      up_retry_at_ns_ = NowNs() + uint64_t(opt_.reconnect_ms) * 1000000ull;
+      return;
+    }
+    up_fd_ = fd;
+    up_connecting_ = (rc != 0);
+    up_connect_deadline_ns_ = NowNs() + 1000000000ull;  // 1s to complete
+    up_reader_ = ipt::FrameReader();
+    up_outbuf_.clear();
+    up_out_off_ = 0;
+    up_want_out_ = false;
+    Register(fd, up_connecting_ ? (EPOLLIN | EPOLLOUT) : EPOLLIN,
+             kTagUpstream, 0);
+    if (!up_connecting_) ++counters_.upstream_reconnects;
+  }
+
+  void DropUpstream() {
+    if (up_fd_ >= 0) {
+      epoll_ctl(ep_, EPOLL_CTL_DEL, up_fd_, nullptr);
+      close(up_fd_);
+      up_fd_ = -1;
+    }
+    up_connecting_ = false;
+    up_outbuf_.clear();
+    up_out_off_ = 0;
+    // everything in flight on that connection is gone — fail it all open
+    for (auto& [up_id, p] : pending_) {
+      ++counters_.fail_open_upstream;
+      SendFailOpen(p);
+    }
+    pending_.clear();
+    streams_.clear();
+    for (auto& [id, c] : conns_) c->open_streams.clear();
+    up_retry_at_ns_ = NowNs() + uint64_t(opt_.reconnect_ms) * 1000000ull;
+  }
+
+  // ---------------------------------------------------------- epoll plumbing
+
+  // tag lives in the high 32 bits of epoll_data.u64; 0 = downstream conn
+  static constexpr uint32_t kTagListener = 1;
+  static constexpr uint32_t kTagUpstream = 2;
+  static constexpr uint32_t kTagStatus = 3;
+  static constexpr uint32_t kTagStatusConn = 4;
+
+  void Register(int fd, uint32_t ev_mask, uint32_t tag, uint32_t idx) {
+    epoll_event ev{};
+    ev.events = ev_mask;
+    ev.data.u64 = (uint64_t(tag) << 32) | idx;
+    epoll_ctl(ep_, EPOLL_CTL_ADD, fd, &ev);
+  }
+
+  void Modify(int fd, uint32_t ev_mask, uint32_t tag, uint32_t idx) {
+    epoll_event ev{};
+    ev.events = ev_mask;
+    ev.data.u64 = (uint64_t(tag) << 32) | idx;
+    epoll_ctl(ep_, EPOLL_CTL_MOD, fd, &ev);
+  }
+
+  void Dispatch(const epoll_event& ev) {
+    uint32_t tag = uint32_t(ev.data.u64 >> 32);
+    uint32_t idx = uint32_t(ev.data.u64 & 0xffffffffu);
+    switch (tag) {
+      case kTagListener: AcceptDown(); break;
+      case kTagUpstream: HandleUpstream(ev.events); break;
+      case kTagStatus: AcceptStatus(); break;
+      case kTagStatusConn: HandleStatusConn(int(idx)); break;
+      default: HandleDown(idx, ev.events); break;  // tag==0: conn id in idx
+    }
+  }
+
+  int NextTimeoutMs() {
+    uint64_t now = NowNs();
+    uint64_t next = UINT64_MAX;
+    while (!deadlines_.empty()) {
+      auto [dl, up_id] = deadlines_.top();
+      auto it = pending_.find(up_id);
+      if (it == pending_.end() || it->second.deadline_ns != dl) {
+        deadlines_.pop();  // stale (answered, or deadline refreshed)
+        continue;
+      }
+      next = dl;
+      break;
+    }
+    if (up_fd_ < 0 && up_retry_at_ns_ < next) next = up_retry_at_ns_;
+    if (next == UINT64_MAX) return 1000;
+    if (next <= now) return 0;
+    uint64_t ms = (next - now) / 1000000ull;
+    return int(ms > 1000 ? 1000 : ms) + 1;
+  }
+
+  // ---------------------------------------------------------- downstream
+
+  void AcceptDown() {
+    while (true) {
+      int fd = accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;
+      SetNonblock(fd);
+      // a doomed conn's entry may still occupy this (reused) fd key until
+      // the end-of-iteration CloseDoomed sweep — clear it now
+      auto stale = conns_.find(fd);
+      if (stale != conns_.end()) conns_.erase(stale);
+      auto c = std::make_unique<DownConn>();
+      c->fd = fd;
+      c->id = ++next_conn_id_;
+      Register(fd, EPOLLIN, 0, uint32_t(fd));
+      ++counters_.down_conns_total;
+      ++counters_.down_conns_active;
+      conns_by_id_[c->id] = c.get();
+      conns_.emplace(fd, std::move(c));
+    }
+  }
+
+  void HandleDown(uint32_t fd, uint32_t events) {
+    auto it = conns_.find(int(fd));
+    if (it == conns_.end() || it->second->fd < 0) return;
+    DownConn* c = it->second.get();
+    if (events & (EPOLLHUP | EPOLLERR)) { Doom(c); return; }
+    if (events & EPOLLIN) {
+      uint8_t buf[1 << 16];
+      ssize_t n;
+      while ((n = read(c->fd, buf, sizeof buf)) > 0) {
+        try {
+          c->reader.Feed(buf, size_t(n),
+                         [&](int kind, const uint8_t* p, size_t len) {
+            if (kind == 0) OnRequest(c, p, len);
+            else OnChunk(c, p, len);
+          });
+        } catch (const std::exception&) {
+          ++counters_.bad_frames;
+          Doom(c);
+          return;
+        }
+      }
+      if (n == 0) { Doom(c); return; }
+      if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) { Doom(c); return; }
+    }
+    FlushDown(c);
+  }
+
+  void OnRequest(DownConn* c, const uint8_t* payload, size_t len) {
+    ++counters_.requests_in;
+    uint64_t orig_id = ipt::detail::get<uint64_t>(payload);
+    uint8_t mode = payload[12];  // after req_id u64 + tenant u32
+    if (!UpReady()) {
+      ++counters_.fail_open_upstream;
+      SendFailOpenTo(c, orig_id);
+      return;
+    }
+    if (up_outbuf_.size() - up_out_off_ > opt_.max_upstream_buf) {
+      ++counters_.fail_open_overload;
+      SendFailOpenTo(c, orig_id);
+      return;
+    }
+    uint64_t up_id = ++next_up_id_;
+    uint64_t dl = NowNs() + uint64_t(opt_.deadline_ms * 1e6);
+    pending_[up_id] = Pending{c->id, orig_id, dl};
+    deadlines_.emplace(dl, up_id);
+    if (mode & ipt::kModeStream) {
+      streams_[StreamKey(c->id, orig_id)] = up_id;
+      c->open_streams.insert(orig_id);
+    }
+    AppendUpstream(ipt::kReqMagic, payload, len, up_id);
+  }
+
+  void OnChunk(DownConn* c, const uint8_t* payload, size_t len) {
+    ++counters_.chunks_in;
+    uint64_t orig_id = ipt::detail::get<uint64_t>(payload);
+    auto it = streams_.find(StreamKey(c->id, orig_id));
+    if (it == streams_.end()) return;  // stream already failed open/expired
+    uint64_t up_id = it->second;
+    bool last = payload[8] & ipt::kChunkLast;
+    if (!last && up_outbuf_.size() - up_out_off_ > opt_.max_upstream_buf) {
+      // backlog cap applies to chunk flow too: a single fast uploader
+      // against a stalled upstream must not grow the buffer unboundedly.
+      // Shed the whole stream: fail it open now, abort it upstream.
+      streams_.erase(it);
+      c->open_streams.erase(orig_id);
+      pending_.erase(up_id);
+      ++counters_.fail_open_overload;
+      SendFailOpenTo(c, orig_id);
+      AbortStreamUpstream(up_id);
+      return;
+    }
+    if (last) {
+      streams_.erase(it);
+      c->open_streams.erase(orig_id);
+    }
+    auto p = pending_.find(up_id);
+    if (p != pending_.end()) {
+      // a stream is alive while chunks flow: refresh its deadline so a
+      // long upload isn't failed open mid-body (the SLO covers verdict
+      // latency after body end, matching the reference's incremental parse)
+      p->second.deadline_ns = NowNs() + uint64_t(opt_.deadline_ms * 1e6);
+      deadlines_.emplace(p->second.deadline_ns, up_id);
+    }
+    AppendUpstream(ipt::kChunkMagic, payload, len, up_id);
+  }
+
+  // Synthesize an empty last-chunk so the serve loop finalizes and frees
+  // the stream's state (its verdict, if any, is dropped as late).
+  void AbortStreamUpstream(uint64_t up_id) {
+    if (!UpReady()) return;
+    std::string payload;
+    ipt::detail::put<uint64_t>(&payload, up_id);
+    payload.push_back(char(ipt::kChunkLast));
+    AppendUpstream(ipt::kChunkMagic,
+                   reinterpret_cast<const uint8_t*>(payload.data()),
+                   payload.size(), up_id);
+  }
+
+  void FlushDown(DownConn* c) {
+    if (c->fd < 0) return;
+    while (c->out_off < c->outbuf.size()) {
+      ssize_t n = write(c->fd, c->outbuf.data() + c->out_off,
+                        c->outbuf.size() - c->out_off);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        Doom(c);
+        return;
+      }
+      c->out_off += size_t(n);
+    }
+    if (c->out_off == c->outbuf.size()) {
+      c->outbuf.clear();
+      c->out_off = 0;
+    } else if (c->outbuf.size() - c->out_off > opt_.max_down_buf) {
+      Doom(c);  // reader stopped draining verdicts
+      return;
+    }
+    bool want = !c->outbuf.empty();
+    if (want != c->want_out) {
+      c->want_out = want;
+      Modify(c->fd, want ? (EPOLLIN | EPOLLOUT) : EPOLLIN, 0,
+             uint32_t(c->fd));
+    }
+  }
+
+  void Doom(DownConn* c) {
+    if (c->fd >= 0) {
+      epoll_ctl(ep_, EPOLL_CTL_DEL, c->fd, nullptr);
+      close(c->fd);
+      doomed_.push_back(c->fd);
+      c->fd = -1;
+      --counters_.down_conns_active;
+      conns_by_id_.erase(c->id);
+      // abort any body streams the conn left open, freeing the serve
+      // loop's per-stream state (verdicts for them will drop as late)
+      for (uint64_t orig_id : c->open_streams) {
+        auto it = streams_.find(StreamKey(c->id, orig_id));
+        if (it == streams_.end()) continue;
+        AbortStreamUpstream(it->second);
+        streams_.erase(it);
+      }
+      c->open_streams.clear();
+    }
+  }
+
+  void CloseDoomed() {
+    for (int fd : doomed_) {
+      auto it = conns_.find(fd);
+      // fd<0 check: a new conn may have reused the fd key this iteration
+      if (it != conns_.end() && it->second->fd < 0) conns_.erase(it);
+    }
+    // pending entries for closed conns stay until answer/deadline; the
+    // response path drops verdicts whose conn id no longer resolves
+    doomed_.clear();
+  }
+
+  // ---------------------------------------------------------- upstream
+
+  static uint64_t StreamKey(uint64_t conn_id, uint64_t orig_id) {
+    // conn ids are small monotonic; mix so (conn, req) collisions need
+    // matching low bits on both — fine for a lookup key (not security)
+    return conn_id * 0x9e3779b97f4a7c15ull ^ orig_id;
+  }
+
+  void AppendUpstream(const char magic[4], const uint8_t* payload, size_t len,
+                      uint64_t up_id) {
+    up_outbuf_.append(magic, 4);
+    ipt::detail::put<uint32_t>(&up_outbuf_, uint32_t(len));
+    size_t at = up_outbuf_.size();
+    up_outbuf_.append(reinterpret_cast<const char*>(payload), len);
+    std::memcpy(&up_outbuf_[at], &up_id, 8);  // re-id for global uniqueness
+    ++counters_.forwarded;
+  }
+
+  void FlushUpstream() {
+    if (up_fd_ < 0) return;
+    while (up_out_off_ < up_outbuf_.size()) {
+      ssize_t n = write(up_fd_, up_outbuf_.data() + up_out_off_,
+                        up_outbuf_.size() - up_out_off_);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        DropUpstream();
+        return;
+      }
+      up_out_off_ += size_t(n);
+    }
+    if (up_out_off_ == up_outbuf_.size()) {
+      up_outbuf_.clear();
+      up_out_off_ = 0;
+    }
+    bool want = !up_outbuf_.empty();
+    if (want != up_want_out_) {
+      up_want_out_ = want;
+      Modify(up_fd_, want ? (EPOLLIN | EPOLLOUT) : EPOLLIN, kTagUpstream, 0);
+    }
+  }
+
+  void HandleUpstream(uint32_t events) {
+    if (up_connecting_) {
+      if (events & (EPOLLHUP | EPOLLERR)) { DropUpstream(); return; }
+      if (events & EPOLLOUT) {  // nonblocking connect completed — how?
+        int err = 0;
+        socklen_t len = sizeof err;
+        getsockopt(up_fd_, SOL_SOCKET, SO_ERROR, &err, &len);
+        if (err != 0) { DropUpstream(); return; }
+        up_connecting_ = false;
+        up_want_out_ = false;
+        Modify(up_fd_, EPOLLIN, kTagUpstream, 0);
+        ++counters_.upstream_reconnects;
+      }
+      return;
+    }
+    if (events & (EPOLLHUP | EPOLLERR)) { DropUpstream(); return; }
+    if (events & EPOLLIN) {
+      uint8_t buf[1 << 16];
+      ssize_t n;
+      while (up_fd_ >= 0 && (n = read(up_fd_, buf, sizeof buf)) > 0) {
+        try {
+          up_reader_.Feed(buf, size_t(n), [&](const uint8_t* p, size_t len) {
+            OnVerdict(p, len);
+          });
+        } catch (const std::exception& e) {
+          fprintf(stderr, "upstream protocol error: %s\n", e.what());
+          DropUpstream();
+          return;
+        }
+      }
+      if (up_fd_ >= 0 && n == 0) { DropUpstream(); return; }
+      if (up_fd_ >= 0 && n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+        DropUpstream();  // hard error (e.g. ECONNRESET without EPOLLERR):
+        return;          // leaving the fd registered would busy-loop
+      }
+    }
+    FlushUpstream();
+  }
+
+  void OnVerdict(const uint8_t* payload, size_t len) {
+    uint64_t up_id = ipt::detail::get<uint64_t>(payload);
+    auto it = pending_.find(up_id);
+    if (it == pending_.end()) {
+      ++counters_.late_responses;  // answered after deadline fail-open
+      return;
+    }
+    Pending p = it->second;
+    pending_.erase(it);
+    ++counters_.responses;
+    auto cit = conns_by_id_.find(p.conn_id);
+    if (cit == conns_by_id_.end() || cit->second->fd < 0) return;  // gone
+    DownConn* c = cit->second;
+    // restore the downstream req_id in place, reuse the rest verbatim
+    std::string frame;
+    frame.reserve(8 + len);
+    frame.append(ipt::kRespMagic, 4);
+    ipt::detail::put<uint32_t>(&frame, uint32_t(len));
+    size_t at = frame.size();
+    frame.append(reinterpret_cast<const char*>(payload), len);
+    std::memcpy(&frame[at], &p.orig_id, 8);
+    c->outbuf += frame;
+    FlushDown(c);
+  }
+
+  // ---------------------------------------------------------- fail-open
+
+  void SendFailOpen(const Pending& p) {
+    auto cit = conns_by_id_.find(p.conn_id);
+    if (cit == conns_by_id_.end() || cit->second->fd < 0) return;
+    SendFailOpenTo(cit->second, p.orig_id);
+  }
+
+  void SendFailOpenTo(DownConn* c, uint64_t orig_id) {
+    ipt::Response r;
+    r.req_id = orig_id;
+    r.flags = ipt::kFailOpen;  // pass + flag, never block on WAF trouble
+    c->outbuf += ipt::EncodeResponse(r);
+    FlushDown(c);
+  }
+
+  void ExpireDeadlines(uint64_t now) {
+    while (!deadlines_.empty()) {
+      auto [dl, up_id] = deadlines_.top();
+      if (dl > now) break;
+      deadlines_.pop();
+      auto it = pending_.find(up_id);
+      if (it == pending_.end() || it->second.deadline_ns != dl) continue;
+      Pending p = it->second;
+      pending_.erase(it);
+      auto sit = streams_.find(StreamKey(p.conn_id, p.orig_id));
+      if (sit != streams_.end()) {  // stream stalled mid-body: abort it
+        AbortStreamUpstream(sit->second);
+        streams_.erase(sit);
+        auto cit = conns_by_id_.find(p.conn_id);
+        if (cit != conns_by_id_.end())
+          cit->second->open_streams.erase(p.orig_id);
+      }
+      ++counters_.fail_open_deadline;
+      SendFailOpen(p);
+    }
+  }
+
+  // ---------------------------------------------------------- status
+
+  void AcceptStatus() {
+    while (true) {
+      int fd = accept(status_fd_, nullptr, nullptr);
+      if (fd < 0) return;
+      SetNonblock(fd);
+      // answer after the client's (tiny) request arrives: writing before
+      // reading risks an RST discarding the response on close
+      Register(fd, EPOLLIN, kTagStatusConn, uint32_t(fd));
+    }
+  }
+
+  void HandleStatusConn(int fd) {
+    uint8_t drain[4096];
+    ssize_t n = read(fd, drain, sizeof drain);
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+    char body[1024];
+    int blen = snprintf(
+        body, sizeof body,
+        "{\"requests_in\": %llu, \"chunks_in\": %llu, "
+        "\"forwarded\": %llu, \"responses\": %llu, "
+        "\"fail_open_deadline\": %llu, \"fail_open_upstream\": %llu, "
+        "\"fail_open_overload\": %llu, \"late_responses\": %llu, "
+        "\"down_conns_total\": %llu, \"down_conns_active\": %llu, "
+        "\"bad_frames\": %llu, \"upstream_reconnects\": %llu, "
+        "\"upstream_connected\": %s, \"pending\": %zu}\n",
+        (unsigned long long)counters_.requests_in,
+        (unsigned long long)counters_.chunks_in,
+        (unsigned long long)counters_.forwarded,
+        (unsigned long long)counters_.responses,
+        (unsigned long long)counters_.fail_open_deadline,
+        (unsigned long long)counters_.fail_open_upstream,
+        (unsigned long long)counters_.fail_open_overload,
+        (unsigned long long)counters_.late_responses,
+        (unsigned long long)counters_.down_conns_total,
+        (unsigned long long)counters_.down_conns_active,
+        (unsigned long long)counters_.bad_frames,
+        (unsigned long long)counters_.upstream_reconnects,
+        up_fd_ >= 0 ? "true" : "false", pending_.size());
+    char resp[1400];
+    int rlen = snprintf(resp, sizeof resp,
+                        "HTTP/1.0 200 OK\r\n"
+                        "Content-Type: application/json\r\n"
+                        "Content-Length: %d\r\n\r\n%s",
+                        blen, body);
+    // one-shot local scrape: a single write covers it (fits the sndbuf)
+    ssize_t w = write(fd, resp, size_t(rlen));
+    (void)w;
+    epoll_ctl(ep_, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+  }
+
+  Options opt_;
+  Counters counters_;
+  int ep_ = -1;
+  int listen_fd_ = -1;
+  int status_fd_ = -1;
+
+  // event dispatch is keyed by fd (fits epoll's 32-bit payload next to the
+  // tag); verdict routing is keyed by the 64-bit monotonic conn id so a
+  // reused fd can never receive another conn's verdict
+  std::unordered_map<int, std::unique_ptr<DownConn>> conns_;
+  std::unordered_map<uint64_t, DownConn*> conns_by_id_;
+  std::vector<int> doomed_;
+  uint64_t next_conn_id_ = 0;
+
+  int up_fd_ = -1;
+  bool up_connecting_ = false;
+  uint64_t up_connect_deadline_ns_ = 0;
+  ipt::FrameReader up_reader_;
+  std::string up_outbuf_;
+  size_t up_out_off_ = 0;
+  bool up_want_out_ = false;
+  uint64_t up_retry_at_ns_ = 0;
+
+  uint64_t next_up_id_ = 0;
+  std::unordered_map<uint64_t, Pending> pending_;
+  std::unordered_map<uint64_t, uint64_t> streams_;  // (conn,orig) → up_id
+  // min-heap of (deadline, up_id); stale entries dropped lazily
+  using DlEntry = std::pair<uint64_t, uint64_t>;
+  std::priority_queue<DlEntry, std::vector<DlEntry>, std::greater<DlEntry>>
+      deadlines_;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options opt;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "missing value for %s\n", a.c_str());
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--listen") opt.listen_path = next();
+    else if (a == "--upstream") opt.upstream_path = next();
+    else if (a == "--deadline-ms") opt.deadline_ms = atof(next());
+    else if (a == "--status-port") opt.status_port = atoi(next());
+    else if (a == "--max-upstream-buf") opt.max_upstream_buf = size_t(atol(next()));
+    else if (a == "--max-down-buf") opt.max_down_buf = size_t(atol(next()));
+    else if (a == "--reconnect-ms") opt.reconnect_ms = atoi(next());
+    else { fprintf(stderr, "unknown arg %s\n", a.c_str()); return 2; }
+  }
+  if (opt.listen_path.empty() || opt.upstream_path.empty()) {
+    fprintf(stderr,
+            "usage: sidecar --listen <uds> --upstream <uds> "
+            "[--deadline-ms N] [--status-port P] [--max-upstream-buf B] "
+            "[--max-down-buf B] [--reconnect-ms N]\n");
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);
+  return Sidecar(opt).Run();
+}

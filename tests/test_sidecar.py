@@ -1,0 +1,307 @@
+"""Native sidecar e2e: C++ mux/fail-open tier between clients and the serve
+loop (SURVEY.md §3.3 TPU variant — the nginx-side native boundary).
+
+Covers: verdict parity through the sidecar (loadgen + Python client),
+streaming bodies through the mux, the deadline fail-open contract against a
+stalled upstream, immediate fail-open when the upstream is down, and the
+status-counter endpoint (the `/wallarm-status` analog).
+"""
+
+import json
+import os
+import shutil
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+BIN = REPO / "native" / "sidecar" / "sidecar"
+LOADGEN = REPO / "native" / "sidecar" / "loadgen"
+
+TINY_RULES = """
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)union\\s+select" \
+    "id:942100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-sqli'"
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)<script" \
+    "id:941100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-xss'"
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx /etc/passwd" \
+    "id:930120,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-lfi'"
+"""
+
+
+@pytest.fixture(scope="module")
+def binaries():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    subprocess.run(["make", "-s", "-C", str(REPO / "native" / "sidecar")],
+                   check=True)
+    assert BIN.exists() and LOADGEN.exists()
+    return BIN
+
+
+def _wait_socket(path, proc, what, timeout_s=60):
+    for _ in range(int(timeout_s * 10)):
+        if Path(path).exists():
+            try:
+                s = socket.socket(socket.AF_UNIX)
+                s.connect(str(path))
+                s.close()
+                return
+            except OSError:
+                pass
+        if proc.poll() is not None:
+            err = proc.stderr.read() if proc.stderr else ""
+            raise RuntimeError("%s died: %s" % (what, err))
+        time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError("%s socket never appeared" % what)
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory, binaries):
+    tmp = tmp_path_factory.mktemp("sideserve")
+    rules_dir = tmp / "rules"
+    rules_dir.mkdir()
+    (rules_dir / "tiny.conf").write_text(TINY_RULES)
+    sock = str(tmp / "serve.sock")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ingress_plus_tpu.serve",
+         "--socket", sock, "--rules-dir", str(rules_dir),
+         "--platform", "cpu", "--max-delay-us", "1000", "--no-warmup"],
+        cwd=str(REPO), env=env, stderr=subprocess.PIPE, text=True)
+    _wait_socket(sock, proc, "serve loop")
+    yield sock
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def sidecar(server, binaries, tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("sidecar")
+    listen = str(tmp / "side.sock")
+    proc = subprocess.Popen(
+        [str(BIN), "--listen", listen, "--upstream", server,
+         "--deadline-ms", "5000", "--status-port", "19911"],
+        stderr=subprocess.PIPE, text=True)
+    _wait_socket(listen, proc, "sidecar")
+    yield listen
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def _status(port=19911):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.sendall(b"GET / HTTP/1.0\r\n\r\n")
+    buf = b""
+    while True:
+        b = s.recv(4096)
+        if not b:
+            break
+        buf += b
+    s.close()
+    head, _, body = buf.partition(b"\r\n\r\n")
+    assert head.startswith(b"HTTP/1.0 200")
+    return json.loads(body)
+
+
+class Client:
+    """Minimal blocking UDS client speaking the sidecar/serve protocol."""
+
+    def __init__(self, path):
+        from ingress_plus_tpu.serve.protocol import FrameReader, RESP_MAGIC
+
+        self.sock = socket.socket(socket.AF_UNIX)
+        self.sock.connect(path)
+        self.sock.settimeout(30)
+        self.reader = FrameReader(RESP_MAGIC)
+
+    def send(self, data):
+        self.sock.sendall(data)
+
+    def recv_verdict(self):
+        from ingress_plus_tpu.serve.protocol import decode_response
+
+        while True:
+            got = self.reader.feed(self.sock.recv(65536))
+            if got:
+                return decode_response(got[0])
+
+    def close(self):
+        self.sock.close()
+
+
+def _request(uri, body=b"", mode=2, req_id=1):
+    from ingress_plus_tpu.serve.normalize import Request
+    from ingress_plus_tpu.serve.protocol import encode_request
+
+    return encode_request(
+        Request(method="GET", uri=uri, headers={"Host": "t"}, body=body),
+        req_id, mode=mode)
+
+
+def test_verdict_roundtrip(sidecar):
+    c = Client(sidecar)
+    c.send(_request("/?q=1%20union%20select%20x", req_id=7))
+    v = c.recv_verdict()
+    assert v["req_id"] == 7
+    assert v["attack"] and v["blocked"] and not v["fail_open"]
+    c.send(_request("/hello?x=1", req_id=8))
+    v = c.recv_verdict()
+    assert v["req_id"] == 8
+    assert not v["attack"] and not v["blocked"]
+    c.close()
+
+
+def test_loadgen_through_sidecar(sidecar, tmp_path):
+    from ingress_plus_tpu.utils.export_corpus import export
+
+    corpus = tmp_path / "c.bin"
+    export(str(corpus), n=150, seed=5, attack_fraction=0.3)
+    out = subprocess.run(
+        [str(LOADGEN), "--socket", sidecar, "--corpus", str(corpus),
+         "--connections", "4", "--inflight", "8", "--requests", "300"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    result = json.loads(out.stdout)
+    assert result["requests"] == 300
+    assert result["fail_open"] == 0
+    assert result["attacks"] > 0
+    assert result["blocked"] == result["attacks"]
+
+
+def test_streaming_body_through_sidecar(sidecar):
+    from ingress_plus_tpu.serve.protocol import MODE_STREAM, encode_chunk
+
+    c = Client(sidecar)
+    # stream an attack across chunk boundaries (pattern split mid-token)
+    c.send(_request("/upload", body=b"x=1 uni", mode=2 | MODE_STREAM,
+                    req_id=42))
+    c.send(encode_chunk(42, b"on sel"))
+    c.send(encode_chunk(42, b"ect password from users", last=True))
+    v = c.recv_verdict()
+    assert v["req_id"] == 42
+    assert v["attack"] and v["blocked"] and not v["fail_open"]
+    c.close()
+
+
+def test_status_counters(sidecar):
+    st = _status()
+    assert st["upstream_connected"] is True
+    assert st["requests_in"] >= 1
+    assert st["responses"] >= 1
+    assert st["bad_frames"] == 0
+
+
+def test_abandoned_streams_do_not_leak(sidecar):
+    """A conn dying mid-stream must be aborted upstream; otherwise the serve
+    loop's per-conn stream cap (256) on the one mux connection eventually
+    makes ALL streaming fail open."""
+    from ingress_plus_tpu.serve.protocol import MODE_STREAM, encode_chunk
+
+    for i in range(300):  # > MAX_STREAMS_PER_CONN
+        c = Client(sidecar)
+        c.send(_request("/up", body=b"x=", mode=2 | MODE_STREAM,
+                        req_id=1000 + i))
+        c.close()  # vanish without the last chunk
+    # streaming must still work end-to-end (real verdict, not fail-open)
+    c = Client(sidecar)
+    c.send(_request("/up", body=b"q=1 union", mode=2 | MODE_STREAM,
+                    req_id=5000))
+    c.send(encode_chunk(5000, b" select x", last=True))
+    v = c.recv_verdict()
+    assert v["attack"] and not v["fail_open"]
+    c.close()
+
+
+def test_malformed_frame_closes_conn_only(sidecar):
+    """A bad frame dooms that connection (counted), not the sidecar."""
+    import struct as _s
+
+    bad = socket.socket(socket.AF_UNIX)
+    bad.connect(sidecar)
+    bad.sendall(b"QTPI" + _s.pack("<I", 10) + b"0123456789")  # < min 26
+    bad.settimeout(5)
+    assert bad.recv(16) == b""  # sidecar closes the violating conn
+    bad.close()
+    # healthy conns keep working
+    c = Client(sidecar)
+    c.send(_request("/ok?x=1", req_id=77))
+    v = c.recv_verdict()
+    assert v["req_id"] == 77 and not v["fail_open"]
+    c.close()
+    assert _status()["bad_frames"] >= 1
+
+
+def test_deadline_fail_open(binaries, tmp_path):
+    """Upstream accepts but never answers → pass+fail_open within ~deadline."""
+    stall = str(tmp_path / "stall.sock")
+    srv = socket.socket(socket.AF_UNIX)
+    srv.bind(stall)
+    srv.listen(4)
+    held = []
+
+    def absorb():
+        try:
+            conn, _ = srv.accept()
+            held.append(conn)
+            while conn.recv(65536):
+                pass
+        except OSError:
+            pass
+
+    t = threading.Thread(target=absorb, daemon=True)
+    t.start()
+
+    listen = str(tmp_path / "side.sock")
+    proc = subprocess.Popen(
+        [str(BIN), "--listen", listen, "--upstream", stall,
+         "--deadline-ms", "80", "--status-port", "19912"],
+        stderr=subprocess.PIPE, text=True)
+    try:
+        _wait_socket(listen, proc, "sidecar")
+        c = Client(listen)
+        t0 = time.time()
+        c.send(_request("/?q=1%20union%20select%20x", req_id=9))
+        v = c.recv_verdict()
+        elapsed = time.time() - t0
+        assert v["req_id"] == 9
+        assert v["fail_open"] and not v["blocked"] and not v["attack"]
+        assert elapsed < 5.0  # deadline 80ms + scheduling slack
+        st = _status(19912)
+        assert st["fail_open_deadline"] == 1
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        srv.close()
+        for conn in held:
+            conn.close()
+
+
+def test_upstream_down_fail_open(binaries, tmp_path):
+    """No serve loop at all → requests fail open immediately, never hang."""
+    listen = str(tmp_path / "side.sock")
+    proc = subprocess.Popen(
+        [str(BIN), "--listen", listen,
+         "--upstream", str(tmp_path / "nonexistent.sock"),
+         "--deadline-ms", "1000"],
+        stderr=subprocess.PIPE, text=True)
+    try:
+        _wait_socket(listen, proc, "sidecar")
+        c = Client(listen)
+        t0 = time.time()
+        c.send(_request("/?q=<script>alert(1)</script>", req_id=3))
+        v = c.recv_verdict()
+        assert v["fail_open"] and not v["blocked"]
+        assert time.time() - t0 < 2.0
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
