@@ -131,8 +131,8 @@ def _sqli_token_patterns(tokens: List[Tuple[str, bytes]]) -> bool:
     return False
 
 
-def detect_sqli(data: bytes, max_len: int = 4096) -> bool:
-    """Strict-grammar SQLi check in three quote contexts."""
+def detect_sqli_py(data: bytes, max_len: int = 4096) -> bool:
+    """Strict-grammar SQLi check in three quote contexts (pure Python)."""
     data = data[:max_len]
     if not data:
         return False
@@ -164,8 +164,9 @@ _JS_URI_RX = re.compile(rb"(?:javascript|vbscript)\s*:", re.IGNORECASE)
 _DATA_URI_RX = re.compile(rb"data\s*:[^,]{0,60};\s*base64", re.IGNORECASE)
 
 
-def detect_xss(data: bytes, max_len: int = 4096) -> bool:
-    """Strict-ish XSS check: script-capable HTML constructs only."""
+def detect_xss_py(data: bytes, max_len: int = 4096) -> bool:
+    """Strict-ish XSS check: script-capable HTML constructs only
+    (pure Python)."""
     data = data[:max_len]
     if not data:
         return False
@@ -185,3 +186,53 @@ def detect_xss(data: bytes, max_len: int = 4096) -> bool:
     if b"&#" in low and b"script" in low:
         return True
     return False
+
+
+# ------------------------------------------------- native dispatch (C++)
+
+def _load_native():
+    """ctypes binding to native/confirm/libiptdetect.so (the C++ twin).
+
+    The sidecar-fast-path build of these detectors; semantics are pinned
+    to the Python reference by tests/test_native_confirm.py.  Absent lib
+    (or IPT_NO_NATIVE_CONFIRM=1) falls back to pure Python.
+    """
+    import ctypes
+    import os
+    from pathlib import Path
+
+    if os.environ.get("IPT_NO_NATIVE_CONFIRM"):
+        return None
+    so = Path(__file__).resolve().parents[2] / "native" / "confirm" / \
+        "libiptdetect.so"
+    if not so.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(so))
+    except OSError:
+        return None
+    for fn in (lib.ipt_detect_sqli, lib.ipt_detect_xss):
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    return lib
+
+
+_NATIVE = _load_native()
+
+
+def detect_sqli(data: bytes, max_len: int = 4096) -> bool:
+    """Strict-grammar SQLi check (native C++ when available)."""
+    window = data[:max_len]  # only the scanned window matters for the guard
+    if _NATIVE is not None and b"\x00" not in window:
+        # c_char_p is NUL-terminated; payloads with embedded NULs take the
+        # Python path (rare: normalizers strip/replace NULs upstream)
+        return bool(_NATIVE.ipt_detect_sqli(window, len(window)))
+    return detect_sqli_py(data, max_len)
+
+
+def detect_xss(data: bytes, max_len: int = 4096) -> bool:
+    """Strict-ish XSS check (native C++ when available)."""
+    window = data[:max_len]
+    if _NATIVE is not None and b"\x00" not in window:
+        return bool(_NATIVE.ipt_detect_xss(window, len(window)))
+    return detect_xss_py(data, max_len)

@@ -131,6 +131,7 @@ class Sidecar {
       for (int i = 0; i < nev; ++i) Dispatch(events[i]);
       uint64_t now = NowNs();
       ExpireDeadlines(now);
+      ExpireStatusConns(now);
       if (up_fd_ < 0 && now >= up_retry_at_ns_) ConnectUpstream();
       else if (up_connecting_ && now >= up_connect_deadline_ns_)
         DropUpstream();  // connect() never completed
@@ -232,35 +233,41 @@ class Sidecar {
 
   // ---------------------------------------------------------- epoll plumbing
 
-  // tag lives in the high 32 bits of epoll_data.u64; 0 = downstream conn
+  // epoll_data.u64 layout: high 3 bits = tag, low 61 bits = payload.
+  // Downstream conns (tag 0) carry their 64-bit monotonic conn id (fits:
+  // 2^61 conns is unreachable), NOT the fd — a stale queued event for a
+  // closed fd that was reused within the same epoll_wait batch must not
+  // resolve to the new connection.
+  static constexpr int kTagShift = 61;
+  static constexpr uint64_t kPayloadMask = (1ull << kTagShift) - 1;
   static constexpr uint32_t kTagListener = 1;
   static constexpr uint32_t kTagUpstream = 2;
   static constexpr uint32_t kTagStatus = 3;
   static constexpr uint32_t kTagStatusConn = 4;
 
-  void Register(int fd, uint32_t ev_mask, uint32_t tag, uint32_t idx) {
+  void Register(int fd, uint32_t ev_mask, uint32_t tag, uint64_t payload) {
     epoll_event ev{};
     ev.events = ev_mask;
-    ev.data.u64 = (uint64_t(tag) << 32) | idx;
+    ev.data.u64 = (uint64_t(tag) << kTagShift) | (payload & kPayloadMask);
     epoll_ctl(ep_, EPOLL_CTL_ADD, fd, &ev);
   }
 
-  void Modify(int fd, uint32_t ev_mask, uint32_t tag, uint32_t idx) {
+  void Modify(int fd, uint32_t ev_mask, uint32_t tag, uint64_t payload) {
     epoll_event ev{};
     ev.events = ev_mask;
-    ev.data.u64 = (uint64_t(tag) << 32) | idx;
+    ev.data.u64 = (uint64_t(tag) << kTagShift) | (payload & kPayloadMask);
     epoll_ctl(ep_, EPOLL_CTL_MOD, fd, &ev);
   }
 
   void Dispatch(const epoll_event& ev) {
-    uint32_t tag = uint32_t(ev.data.u64 >> 32);
-    uint32_t idx = uint32_t(ev.data.u64 & 0xffffffffu);
+    uint32_t tag = uint32_t(ev.data.u64 >> kTagShift);
+    uint64_t payload = ev.data.u64 & kPayloadMask;
     switch (tag) {
       case kTagListener: AcceptDown(); break;
       case kTagUpstream: HandleUpstream(ev.events); break;
       case kTagStatus: AcceptStatus(); break;
-      case kTagStatusConn: HandleStatusConn(int(idx)); break;
-      default: HandleDown(idx, ev.events); break;  // tag==0: conn id in idx
+      case kTagStatusConn: HandleStatusConn(int(payload)); break;
+      default: HandleDown(payload, ev.events); break;  // tag 0: conn id
     }
   }
 
@@ -298,7 +305,7 @@ class Sidecar {
       auto c = std::make_unique<DownConn>();
       c->fd = fd;
       c->id = ++next_conn_id_;
-      Register(fd, EPOLLIN, 0, uint32_t(fd));
+      Register(fd, EPOLLIN, 0, c->id);
       ++counters_.down_conns_total;
       ++counters_.down_conns_active;
       conns_by_id_[c->id] = c.get();
@@ -306,10 +313,10 @@ class Sidecar {
     }
   }
 
-  void HandleDown(uint32_t fd, uint32_t events) {
-    auto it = conns_.find(int(fd));
-    if (it == conns_.end() || it->second->fd < 0) return;
-    DownConn* c = it->second.get();
+  void HandleDown(uint64_t conn_id, uint32_t events) {
+    auto it = conns_by_id_.find(conn_id);
+    if (it == conns_by_id_.end() || it->second->fd < 0) return;
+    DownConn* c = it->second;
     if (events & (EPOLLHUP | EPOLLERR)) { Doom(c); return; }
     if (events & EPOLLIN) {
       uint8_t buf[1 << 16];
@@ -365,7 +372,9 @@ class Sidecar {
     if (it == streams_.end()) return;  // stream already failed open/expired
     uint64_t up_id = it->second;
     bool last = payload[8] & ipt::kChunkLast;
-    if (!last && up_outbuf_.size() - up_out_off_ > opt_.max_upstream_buf) {
+    if (up_outbuf_.size() - up_out_off_ > opt_.max_upstream_buf) {
+      // applies to last chunks too — the shed path's synthetic abort is
+      // 17 bytes where the real chunk could be megabytes
       // backlog cap applies to chunk flow too: a single fast uploader
       // against a stalled upstream must not grow the buffer unboundedly.
       // Shed the whole stream: fail it open now, abort it upstream.
@@ -426,8 +435,7 @@ class Sidecar {
     bool want = !c->outbuf.empty();
     if (want != c->want_out) {
       c->want_out = want;
-      Modify(c->fd, want ? (EPOLLIN | EPOLLOUT) : EPOLLIN, 0,
-             uint32_t(c->fd));
+      Modify(c->fd, want ? (EPOLLIN | EPOLLOUT) : EPOLLIN, 0, c->id);
     }
   }
 
@@ -611,14 +619,32 @@ class Sidecar {
     while (true) {
       int fd = accept(status_fd_, nullptr, nullptr);
       if (fd < 0) return;
+      if (status_conns_.size() >= 32) { close(fd); continue; }  // bounded
       SetNonblock(fd);
       // answer after the client's (tiny) request arrives: writing before
       // reading risks an RST discarding the response on close
-      Register(fd, EPOLLIN, kTagStatusConn, uint32_t(fd));
+      Register(fd, EPOLLIN, kTagStatusConn, uint64_t(fd));
+      status_conns_[fd] = NowNs() + 5000000000ull;  // idle cutoff: 5s
+    }
+  }
+
+  void CloseStatusConn(int fd) {
+    epoll_ctl(ep_, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+    status_conns_.erase(fd);
+  }
+
+  void ExpireStatusConns(uint64_t now) {
+    for (auto it = status_conns_.begin(); it != status_conns_.end();) {
+      int fd = it->first;
+      uint64_t dl = it->second;
+      ++it;  // CloseStatusConn erases; advance first
+      if (now >= dl) CloseStatusConn(fd);
     }
   }
 
   void HandleStatusConn(int fd) {
+    if (!status_conns_.count(fd)) return;  // stale event after close
     uint8_t drain[4096];
     ssize_t n = read(fd, drain, sizeof drain);
     if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
@@ -654,8 +680,7 @@ class Sidecar {
     // one-shot local scrape: a single write covers it (fits the sndbuf)
     ssize_t w = write(fd, resp, size_t(rlen));
     (void)w;
-    epoll_ctl(ep_, EPOLL_CTL_DEL, fd, nullptr);
-    close(fd);
+    CloseStatusConn(fd);
   }
 
   Options opt_;
@@ -664,13 +689,14 @@ class Sidecar {
   int listen_fd_ = -1;
   int status_fd_ = -1;
 
-  // event dispatch is keyed by fd (fits epoll's 32-bit payload next to the
-  // tag); verdict routing is keyed by the 64-bit monotonic conn id so a
-  // reused fd can never receive another conn's verdict
+  // conns_ (fd-keyed) owns; conns_by_id_ routes both epoll events and
+  // verdicts by the monotonic conn id, so neither a reused fd nor a stale
+  // queued epoll event can ever reach the wrong connection
   std::unordered_map<int, std::unique_ptr<DownConn>> conns_;
   std::unordered_map<uint64_t, DownConn*> conns_by_id_;
   std::vector<int> doomed_;
   uint64_t next_conn_id_ = 0;
+  std::unordered_map<int, uint64_t> status_conns_;  // fd → idle deadline
 
   int up_fd_ = -1;
   bool up_connecting_ = false;

@@ -205,7 +205,7 @@ def test_configuration_endpoints_and_dbg(server, tmp_path):
 
     conf = json.loads(urllib.request.urlopen(
         "http://127.0.0.1:19901/configuration", timeout=10).read())
-    assert conf["rules"] == 3 and conf["tenants"] == 1
+    assert conf["rules"] == 3 and conf["tenants"] == 1, conf
 
     # push a tenant table: tenant 1 = sqli only
     req = urllib.request.Request(
