@@ -20,13 +20,13 @@ import sys
 import urllib.request
 
 
-def _call(server: str, path: str, payload=None) -> str:
+def _call(server: str, path: str, payload=None, timeout: float = 10) -> str:
     url = "http://%s%s" % (server, path)
     data = json.dumps(payload).encode() if payload is not None else None
     req = urllib.request.Request(
         url, data=data, method="POST" if data else "GET",
         headers={"Content-Type": "application/json"} if data else {})
-    with urllib.request.urlopen(req, timeout=10) as resp:
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
         return resp.read().decode()
 
 
@@ -61,9 +61,11 @@ def main(argv=None) -> int:
                 print("ruleset requires --swap <artifact path>",
                       file=sys.stderr)
                 return 2
+            # the swap responds only after the new pipeline is compiled
+            # and warm (zero serve gap) — minutes-grade, not 10s
             out = _call(args.server, "/configuration/ruleset",
                         {"path": args.swap,
-                         "paranoia_level": args.paranoia})
+                         "paranoia_level": args.paranoia}, timeout=300)
     except (OSError, ValueError) as e:  # ValueError covers bad --set JSON
         print("error: %s" % e, file=sys.stderr)
         return 1

@@ -1,0 +1,245 @@
+"""Deployment rendering — the packaging/operator tier (SURVEY.md §2.3 L6).
+
+Reference: the wallarm-extended Helm chart† (controller Deployment +
+wallarm sidecars + Tarantool postanalytics Deployment, driven by
+``values.yaml`` ``controller.wallarm.*`` keys) and the pre-rendered static
+manifests under ``deploy/static/``†.
+
+This module is the same idea sized to the TPU framework: a typed values
+object rendered into k8s manifests.  The pod layout it emits is the
+architecture of SURVEY.md §3.3 (TPU variant):
+
+    [ingress pod]        nginx + shim (unchanged data plane)
+      └─ sidecar         native/sidecar (mux, balancer, fail-open SLO)
+      └─ serve-loop × N  one per TPU chip, each on its own UDS
+      └─ postanalytics   spool consolidator (the cron-sidecar analog)
+
+The spool emptyDir is pod-local, so the consolidator MUST live in the
+ingress pod (the reference runs its export cron as a controller-pod
+sidecar for the same reason); the serve loops' in-process PostChannel +
+spool plays the Tarantool-queue role, and a central collector — when one
+exists — is reached via ``export_url``.
+
+Manifests are YAML text rendered by template strings — the reference
+renders Go templates to text the same way; no YAML library is needed (or
+available) and the golden tests pin the output byte-for-byte
+(tests/test_deploy.py, template_test.go† style).
+
+``python -m ingress_plus_tpu.control.deploy [outdir]`` regenerates
+``deploy/static/`` (the chart→static pipeline of the reference).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List
+
+
+@dataclass
+class DeployValues:
+    """values.yaml analog — the operator-tunable surface."""
+
+    namespace: str = "ingress-plus-tpu"
+    name: str = "ipt"
+    replicas: int = 2                    # ingress pods (DP over hosts)
+    chips_per_host: int = 4              # serve loops per pod (1/chip)
+    image: str = "ingress-plus-tpu:latest"
+    balance: str = "rr"                  # rr | ewma | chash
+    deadline_ms: int = 50
+    status_port: int = 9902
+    http_port: int = 9901                # serve loop 0's metrics/config
+    mode: str = "block"
+    rules_configmap: str = "ipt-rules"
+    fail_open: bool = True
+    batch_window_us: int = 500
+    max_batch: int = 256
+    spool_dir: str = "/var/spool/ipt"
+    export_url: str = ""                 # postanalytics collector
+    export_interval_s: float = 5.0
+    tenants: Dict[int, List[str]] = field(default_factory=dict)
+
+
+def _serve_socket(i: int) -> str:
+    return "/run/ipt/serve-%d.sock" % i
+
+
+def render_configmap(v: DeployValues) -> str:
+    """Global-config ConfigMap (the ~200-key ConfigMap tier, ours)."""
+    lines = [
+        "apiVersion: v1",
+        "kind: ConfigMap",
+        "metadata:",
+        "  name: %s-config" % v.name,
+        "  namespace: %s" % v.namespace,
+        "data:",
+        "  enable-detection: \"true\"",
+        "  detection-backend: \"tpu\"",
+        "  default-mode: \"%s\"" % v.mode,
+        "  fail-open: \"%s\"" % ("true" if v.fail_open else "false"),
+        "  batch-window-us: \"%d\"" % v.batch_window_us,
+        "  max-batch: \"%d\"" % v.max_batch,
+        "  sidecar-socket: \"/run/ipt/detect.sock\"",
+        "  detect-timeout-ms: \"%d\"" % v.deadline_ms,
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def render_deployment(v: DeployValues) -> str:
+    """The ingress pod: nginx+shim container, native sidecar, N serve
+    loops (one per chip) — the wallarm-sidecar-per-pod layout of the
+    chart, TPU-shaped."""
+    upstreams = ",".join(_serve_socket(i) for i in range(v.chips_per_host))
+    out = [
+        "apiVersion: apps/v1",
+        "kind: Deployment",
+        "metadata:",
+        "  name: %s-controller" % v.name,
+        "  namespace: %s" % v.namespace,
+        "spec:",
+        "  replicas: %d" % v.replicas,
+        "  selector:",
+        "    matchLabels: {app: %s-controller}" % v.name,
+        "  template:",
+        "    metadata:",
+        "      labels: {app: %s-controller}" % v.name,
+        "    spec:",
+        "      volumes:",
+        "        - name: ipt-run",
+        "          emptyDir: {}",
+        "        - name: ipt-rules",
+        "          configMap: {name: %s}" % v.rules_configmap,
+        "        - name: ipt-spool",
+        "          emptyDir: {}",
+        "      containers:",
+        "        - name: controller",
+        "          image: %s" % v.image,
+        "          args: [\"/nginx-ingress-controller\"]",
+        "          volumeMounts:",
+        "            - {name: ipt-run, mountPath: /run/ipt}",
+        "        - name: detect-sidecar",
+        "          image: %s" % v.image,
+        "          command:",
+        "            - /usr/local/bin/ipt-sidecar",
+        "            - --listen",
+        "            - /run/ipt/detect.sock",
+        "            - --upstream",
+        "            - %s" % upstreams,
+        "            - --balance",
+        "            - %s" % v.balance,
+        "            - --deadline-ms",
+        "            - \"%d\"" % v.deadline_ms,
+        "            - --status-port",
+        "            - \"%d\"" % v.status_port,
+        "          volumeMounts:",
+        "            - {name: ipt-run, mountPath: /run/ipt}",
+    ]
+    for i in range(v.chips_per_host):
+        out += [
+            "        - name: serve-%d" % i,
+            "          image: %s" % v.image,
+            "          command:",
+            "            - python",
+            "            - -m",
+            "            - ingress_plus_tpu.serve",
+            "            - --socket",
+            "            - %s" % _serve_socket(i),
+            "            - --mode",
+            "            - %s" % v.mode,
+            "            - --rules-dir",
+            "            - /etc/ipt/rules",
+            "            - --max-batch",
+            "            - \"%d\"" % v.max_batch,
+            "            - --max-delay-us",
+            "            - \"%d\"" % v.batch_window_us,
+            "            - --http-port",
+            "            - \"%d\"" % (v.http_port + i),
+            "            - --spool-dir",
+            "            - %s" % v.spool_dir,
+            "          env:",
+            "            - {name: TPU_VISIBLE_CHIPS, value: \"%d\"}" % i,
+            "          resources:",
+            "            limits: {google.com/tpu: 1}",
+            "          livenessProbe:",
+            "            httpGet: {path: /healthz, port: %d}"
+            % (v.http_port + i),
+            "            initialDelaySeconds: 30",
+            "            periodSeconds: 5",
+            "          volumeMounts:",
+            "            - {name: ipt-run, mountPath: /run/ipt}",
+            "            - {name: ipt-rules, mountPath: /etc/ipt/rules}",
+            "            - {name: ipt-spool, mountPath: %s}" % v.spool_dir,
+        ]
+    # postanalytics consolidator — shares the pod's spool emptyDir (a
+    # separate Deployment could never see it; emptyDir is pod-local)
+    out += [
+        "        - name: postanalytics",
+        "          image: %s" % v.image,
+        "          command:",
+        "            - python",
+        "            - -m",
+        "            - ingress_plus_tpu.post.export",
+        "            - --spool-dir",
+        "            - %s" % v.spool_dir,
+        "            - --interval-s",
+        "            - \"%g\"" % v.export_interval_s,
+    ]
+    if v.export_url:
+        out += [
+            "            - --url",
+            "            - %s" % v.export_url,
+        ]
+    out += [
+        "          volumeMounts:",
+        "            - {name: ipt-spool, mountPath: %s}" % v.spool_dir,
+    ]
+    return "\n".join(out) + "\n"
+
+
+def render_service(v: DeployValues) -> str:
+    out = [
+        "apiVersion: v1",
+        "kind: Service",
+        "metadata:",
+        "  name: %s-metrics" % v.name,
+        "  namespace: %s" % v.namespace,
+        "spec:",
+        "  selector: {app: %s-controller}" % v.name,
+        "  ports:",
+        "    - {name: sidecar-status, port: %d}" % v.status_port,
+    ]
+    for i in range(v.chips_per_host):
+        out.append("    - {name: serve-%d-http, port: %d}"
+                   % (i, v.http_port + i))
+    return "\n".join(out) + "\n"
+
+
+def render_all(v: DeployValues) -> Dict[str, str]:
+    """filename → manifest text (the chart's template set)."""
+    return {
+        "configmap.yaml": render_configmap(v),
+        "deployment.yaml": render_deployment(v),
+        "service.yaml": render_service(v),
+    }
+
+
+def write_static(outdir: str | Path,
+                 values: DeployValues | None = None) -> List[str]:
+    """Regenerate the static manifests (deploy/static analog)."""
+    outdir = Path(outdir)
+    outdir.mkdir(parents=True, exist_ok=True)
+    v = values or DeployValues()
+    written = []
+    for name, text in render_all(v).items():
+        (outdir / name).write_text(text)
+        written.append(name)
+    return sorted(written)
+
+
+if __name__ == "__main__":
+    import sys
+
+    target = sys.argv[1] if len(sys.argv) > 1 else \
+        Path(__file__).resolve().parents[2] / "deploy" / "static"
+    for f in write_static(target):
+        print("wrote %s" % f)

@@ -1,0 +1,124 @@
+"""Multi-host (DCN) support — the distributed communication backend tier.
+
+The reference scales its data plane horizontally with N controller/nginx
+replicas behind a Service; coordination is k8s API state, and no traffic
+crosses replicas (SURVEY.md §2.4: no NCCL/MPI — DP is process-level).
+The TPU framework mirrors that shape the TPU-native way:
+
+  * each host runs its own sidecar + serve loop feeding its local chips —
+    requests NEVER cross hosts (like nginx replicas, the batch dim is
+    host-local);
+  * the device mesh can still span hosts for ruleset sharding when a
+    ruleset is too big for one host's HBM: ``hybrid_mesh`` places the
+    ``data`` axis outermost over DCN (cheap: per-verdict traffic is a few
+    bytes) and the ``model`` axis innermost over ICI (the psum vote-merge
+    rides the fast fabric — jax-ml scaling-book recipe);
+  * process bring-up is ``jax.distributed.initialize`` — the analog of the
+    reference's replica registration, driven by env/flags instead of the
+    k8s API.
+
+Single-process (the common case and every CI path) degrades to the plain
+single-host mesh with zero DCN machinery.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ingress_plus_tpu.parallel.mesh import make_mesh
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Gated ``jax.distributed.initialize``.
+
+    Args fall back to the standard env (JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID).  Returns True when a multi-process
+    runtime was (or already is) initialized, False for the single-process
+    fallback — callers never need to branch on environment themselves.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if jax.process_count() > 1:
+        return True  # already initialized by a launcher
+    if not coordinator_address or not num_processes or num_processes <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id if process_id is not None else 0)
+    return True
+
+
+def hybrid_mesh(
+    n_model: Optional[int] = None,
+    devices: Optional[list] = None,
+) -> Mesh:
+    """("data", "model") mesh with hosts on the data axis.
+
+    Multi-process: data axis = process count (DCN outermost), model axis =
+    local devices per process (ICI innermost) — so the per-batch psum
+    vote-merge never leaves a host, and only host-local batches ride each
+    data-axis slot.  ``n_model`` may further split a host's devices
+    between data and model.  Single-process: identical to
+    ``make_mesh(n_model=...)``.
+    """
+    procs = jax.process_count()
+    if procs <= 1:
+        return make_mesh(n_model=n_model, devices=devices)
+    devices = list(devices if devices is not None else jax.devices())
+    per_proc = len(devices) // procs
+    if n_model is None:
+        n_model = per_proc
+    if per_proc % n_model != 0:
+        raise ValueError("n_model=%d does not divide %d local devices"
+                         % (n_model, per_proc))
+    # order devices host-major so rows of the mesh are host-local: the
+    # model axis (fast collectives) then never crosses DCN
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    arr = np.asarray(devices).reshape(procs * (per_proc // n_model), n_model)
+    return Mesh(arr, axis_names=("data", "model"))
+
+
+def local_batch_bounds(mesh: Mesh, global_batch: int) -> Tuple[int, int]:
+    """[start, end) of the global batch this process feeds.
+
+    The serve loop on each host device_puts only its own slice (requests
+    are host-local, like nginx replica traffic); with B divisible by the
+    data axis this is the standard per-process addressable shard.
+    """
+    n_data = mesh.shape["data"]
+    if global_batch % n_data != 0:
+        raise ValueError("batch %d not divisible by data axis %d"
+                         % (global_batch, n_data))
+    per_row = global_batch // n_data
+    # rows owned by this process: those whose devices are all local
+    rows = [i for i in range(n_data)
+            if all(d.process_index == jax.process_index()
+                   for d in np.asarray(mesh.devices)[i])]
+    if not rows:  # single-process meshes own everything
+        return 0, global_batch
+    return rows[0] * per_row, (rows[-1] + 1) * per_row
+
+
+def device_duty_summary() -> dict:
+    """Small DCN-aware observability blob for /healthz: process topology
+    plus local device inventory (the reference's replica-status analog)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": [str(d) for d in jax.local_devices()],
+        "global_device_count": len(jax.devices()),
+    }

@@ -209,3 +209,82 @@ class RulesetWatcher:
         if self._thread is not None:
             self._thread.join(timeout=2)
             self._thread = None
+
+
+# --------------------------------------------------------------- CLI
+# The standalone consolidator the postanalytics Deployment runs (the
+# cron-sidecar process of the reference†: read the queue store, ship
+# attacks to the collector, keep nothing on failure loss-y).
+
+def consolidate_once(spool_dir: str | Path, url: Optional[str] = None,
+                     keep: bool = True) -> int:
+    """Claim the current attacks.jsonl (atomic rename), forward/fold it.
+
+    Returns records processed.  On delivery failure the claimed file is
+    left in place (`*.sending`) and retried next cycle — at-least-once,
+    like the reference's export scripts.
+    """
+    spool = Path(spool_dir)
+    out = spool / "consolidated"
+    out.mkdir(exist_ok=True)
+    n = 0
+    # retry leftovers first, then claim the live spool
+    live = spool / "attacks.jsonl"
+    if live.exists():
+        claimed = spool / ("attacks.%d.sending" % int(time.time() * 1e6))
+        try:
+            live.rename(claimed)
+        except OSError:
+            pass
+    for f in sorted(spool.glob("attacks.*.sending")):
+        try:
+            records = [json.loads(line)
+                       for line in f.read_text().splitlines() if line]
+        except (OSError, json.JSONDecodeError):
+            f.rename(f.with_suffix(".corrupt"))
+            continue
+        if not records:
+            f.unlink()
+            continue
+        if url:
+            try:
+                req = urllib.request.Request(
+                    url, data=json.dumps(records).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=10).read()
+            except Exception:
+                continue  # left as .sending → retried next cycle
+        if keep:
+            with (out / "attacks.jsonl").open("a") as fh:
+                for r in records:
+                    fh.write(json.dumps(r) + "\n")
+        f.unlink()
+        n += len(records)
+    return n
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="ingress_plus_tpu.post.export")
+    ap.add_argument("--spool-dir", required=True)
+    ap.add_argument("--url", default=None,
+                    help="HTTP collector; default keeps a consolidated "
+                         "jsonl under <spool>/consolidated/")
+    ap.add_argument("--interval-s", type=float, default=5.0)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args(argv)
+    while True:
+        # with a collector the records live there; keeping a local copy
+        # too would grow the pod's emptyDir without bound
+        n = consolidate_once(args.spool_dir, url=args.url,
+                             keep=not args.url)
+        if n:
+            print("consolidated %d attack records" % n, flush=True)
+        if args.once:
+            break
+        time.sleep(args.interval_s)
+
+
+if __name__ == "__main__":
+    main()

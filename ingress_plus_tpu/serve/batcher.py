@@ -26,6 +26,7 @@ from typing import List, Optional
 from ingress_plus_tpu.models.pipeline import DetectionPipeline, Verdict
 from ingress_plus_tpu.serve.normalize import Request
 from ingress_plus_tpu.serve.stream import StreamEngine, StreamState
+from ingress_plus_tpu.utils.trace import BatchTrace, TraceRing
 
 
 def _safe_set(fut: "Future", value) -> None:
@@ -80,6 +81,8 @@ class Batcher:
         self.max_delay_s = max_delay_s
         self.hard_deadline_s = hard_deadline_s
         self.stats = BatcherStats()
+        # per-batch span records for /traces (SURVEY.md §5 tracing)
+        self.traces = TraceRing()
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._swap_lock = threading.Lock()
@@ -210,6 +213,8 @@ class Batcher:
                                             len(reqs))
             for ts, _, _ in reqs:
                 self.stats.queue_delay_us_sum += int((t0 - ts) * 1e6)
+            ps = self.pipeline.stats
+            engine_us0, confirm_us0 = ps.engine_us, ps.confirm_us
             with self._swap_lock:
                 self._stream_step(begins, chunks, finishes)
                 requests = [r for _, r, _ in reqs]
@@ -230,6 +235,17 @@ class Batcher:
             if took > self.hard_deadline_s:
                 self.stats.deadline_overruns += len(reqs) + len(finishes)
             self.stats.completed += len(reqs) + len(finishes)
+            ps = self.pipeline.stats  # same object across hot-swaps
+            self.traces.record(BatchTrace(
+                ts=time.time(),
+                n_requests=len(reqs),
+                n_stream_items=len(begins) + len(chunks) + len(finishes),
+                queue_delay_us=int((t0 - min(ts for _, ts, _, _ in batch))
+                                   * 1e6),
+                batch_us=int(took * 1e6),
+                engine_us=ps.engine_us - engine_us0,
+                confirm_us=ps.confirm_us - confirm_us0,
+                request_ids=[r.request_id for _, r, _ in reqs[:8]]))
 
     def _stream_step(self, begins, chunks, finishes) -> None:
         """Streaming work for one dispatch cycle (called under the swap

@@ -300,6 +300,21 @@ class ServeLoop:
         if path.startswith("/metrics"):
             return ("200 OK", "text/plain; version=0.0.4",
                     self._metrics_text().encode())
+        if path.startswith("/traces"):
+            # recent per-batch span records; ?slowest[=N] sorts by batch_us
+            # (request-id attribution for slow verdicts — SURVEY.md §5)
+            from urllib.parse import parse_qs, urlsplit
+            q = parse_qs(urlsplit(path).query, keep_blank_values=True)
+            if "slowest" in q:
+                try:
+                    n = int(q["slowest"][0] or 20)
+                except ValueError:
+                    n = 20
+                body = self.batcher.traces.slowest(n)
+            else:
+                body = self.batcher.traces.snapshot(50)
+            return ("200 OK", "application/json",
+                    json.dumps({"traces": body}).encode())
         if path.startswith("/wallarm-status"):
             # node counters JSON — the reference module's `/wallarm-status`
             # endpoint that collectd scrapes (SURVEY.md §3.5)
@@ -461,6 +476,9 @@ def main(argv=None) -> None:
     ap.add_argument("--artifact-dir", default=None,
                     help="watch this dir for compiled-ruleset artifacts "
                          "and hot-swap (sync-node analog)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="collect a jax.profiler (XProf) trace of the "
+                         "serve loop into this dir until shutdown")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -491,8 +509,10 @@ def main(argv=None) -> None:
         watcher.start()
 
     loop = ServeLoop(batcher, args.socket, args.http_port, post=post)
+    from ingress_plus_tpu.utils.trace import profiled
     try:
-        asyncio.run(loop.run_forever())
+        with profiled(args.trace_dir):
+            asyncio.run(loop.run_forever())
     finally:
         if watcher is not None:
             watcher.close()

@@ -1,0 +1,89 @@
+"""Tracing — per-batch spans + device profiler hooks (SURVEY.md §5).
+
+The reference traces requests with nginx-opentracing + jaeger/zipkin C++
+clients and profiles the Go side with pprof.  The TPU-native equivalents:
+
+  * ``TraceRing`` — a bounded ring of per-batch span records (queue delay,
+    host prep, device scan, confirm, the request ids in the batch) kept by
+    the batcher and served at ``/traces``; a slow verdict is attributable
+    to its batch, and the batch to its stage — the "propagate a request-id
+    so a slow verdict is attributable" requirement without a tracing
+    daemon.
+  * ``profiled`` — wraps a region in ``jax.profiler`` trace collection
+    (XProf/TensorBoard — the device-side flamegraph the CUDA world gets
+    from nsys); enabled on the serve loop with ``--trace-dir``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import asdict, dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class BatchTrace:
+    """One dispatch cycle's span record (all µs, wall-clock host side)."""
+
+    ts: float                 # unix time at dispatch start
+    n_requests: int
+    n_stream_items: int
+    queue_delay_us: int       # oldest request's wait before dispatch
+    batch_us: int             # full dispatch cycle
+    engine_us: int            # device scan portion (cumulative delta)
+    confirm_us: int           # CPU confirm portion (cumulative delta)
+    request_ids: List[str] = field(default_factory=list)  # sample, ≤8
+
+
+class TraceRing:
+    """Bounded, thread-safe ring of recent batch traces."""
+
+    def __init__(self, capacity: int = 256):
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, trace: BatchTrace) -> None:
+        with self._lock:
+            self._ring.append(trace)
+
+    def snapshot(self, n: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            items = list(self._ring)
+        if n is not None:
+            items = items[-n:]
+        return [asdict(t) for t in items]
+
+    def slowest(self, n: int = 10) -> List[dict]:
+        with self._lock:
+            items = list(self._ring)
+        items.sort(key=lambda t: t.batch_us, reverse=True)
+        return [asdict(t) for t in items[:n]]
+
+
+@contextmanager
+def profiled(trace_dir: Optional[str]):
+    """JAX profiler region (no-op when trace_dir is falsy).
+
+    Traces land as XProf protobufs under trace_dir; view with
+    TensorBoard's profile plugin.  Kept coarse (whole-region) because the
+    serve loop's dispatch is one jit call per batch — per-op detail comes
+    from the trace itself, not from host-side span nesting.
+    """
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        import sys
+
+        print("profiler trace (%.1fs) written to %s"
+              % (time.time() - t0, trace_dir), file=sys.stderr)

@@ -3,24 +3,35 @@
 // location-level routing to sidecar").
 //
 // Role: many downstream connections (nginx shim workers / loadgen) fan in
-// over a unix socket; the sidecar muxes their request/chunk frames onto ONE
-// upstream connection to the Python serve loop (whose Batcher forms device
-// batches), fans verdicts back, and — critically — OWNS the fail-open SLO:
+// over a unix socket; the sidecar balances their request/chunk frames
+// across one or more upstream serve loops (one per chip), fans verdicts
+// back, and — critically — OWNS the fail-open SLO:
 //
 //   * per-request deadline (default 50ms): expired requests get a
 //     synthesized pass+fail_open verdict; a late upstream verdict is
 //     dropped and counted.  Traffic is never blocked on the WAF being slow
 //     (the reference's `wallarm-fallback` contract, SURVEY.md §5).
-//   * upstream down / reconnecting: requests fail open immediately; the
-//     sidecar reconnects with backoff (TPU-restart story: buffer nothing,
-//     fail open until the serve loop is back).
-//   * upstream backpressure: if the upstream outbuf exceeds its cap the
-//     sidecar sheds load by failing new requests open (overload).
+//   * upstream down / reconnecting: that upstream's in-flight requests
+//     fail open and it is taken out of rotation while the sidecar
+//     reconnects with backoff (TPU-restart story: buffer nothing, fail
+//     open until a serve loop is back).
+//   * upstream backpressure: if an upstream's outbuf exceeds its cap the
+//     request is routed elsewhere or shed fail-open (overload).
+//
+// Balancing (the reference's balancer.lua analog at the native boundary —
+// round_robin/ewma/chash strategies, SURVEY.md §2.3), selected with
+// --balance:
+//   rr    — rotate over ready upstreams (default)
+//   ewma  — lowest latency EWMA scaled by in-flight (peak-EWMA style)
+//   chash — consistent hash on the tenant id (keeps a tenant's rule
+//           masks/XLA shapes hot on one chip), 64 vnodes per upstream
+// Body streams are always sticky to the upstream that saw the first frame
+// (the sticky-session analog: carried NFA state lives there).
 //
 // Single-threaded epoll event loop — the nginx-worker concurrency model the
 // reference's data plane uses; run N processes for N cores.
 //
-// Counters are served as one-shot JSON on --status-port (the
+// Counters are served as one-shot HTTP/1.0 JSON on --status-port (the
 // `/wallarm-status` analog scraped by collectd in the reference).
 
 #include <errno.h>
@@ -36,6 +47,7 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <map>
 #include <memory>
 #include <queue>
 #include <string>
@@ -53,12 +65,15 @@ uint64_t NowNs() {
   return uint64_t(ts.tv_sec) * 1000000000ull + uint64_t(ts.tv_nsec);
 }
 
+enum class Balance { kRoundRobin, kEwma, kChash };
+
 struct Options {
   std::string listen_path;
-  std::string upstream_path;
+  std::vector<std::string> upstream_paths;
+  Balance balance = Balance::kRoundRobin;
   double deadline_ms = 50.0;
   int status_port = 0;
-  size_t max_upstream_buf = 4u << 20;   // shed load past this backlog
+  size_t max_upstream_buf = 4u << 20;   // per-upstream backlog cap
   size_t max_down_buf = 8u << 20;       // slow downstream reader → close
   int reconnect_ms = 100;
 };
@@ -89,8 +104,8 @@ inline ipt::MultiFrameReader MakeDownReader() {
 
 struct DownConn {
   int fd = -1;
-  uint64_t id = 0;  // monotonic; pending entries reference conns by id so a
-                    // reused fd can never receive another conn's verdict
+  uint64_t id = 0;  // monotonic; all routing references conns by id so a
+                    // reused fd / stale epoll event can never cross wires
   ipt::MultiFrameReader reader = MakeDownReader();
   std::string outbuf;
   size_t out_off = 0;
@@ -102,22 +117,55 @@ struct DownConn {
   std::unordered_set<uint64_t> open_streams;
 };
 
+struct Upstream {
+  std::string path;
+  int fd = -1;
+  bool connecting = false;
+  uint64_t connect_deadline_ns = 0;
+  uint64_t retry_at_ns = 0;
+  ipt::FrameReader reader;
+  std::string outbuf;
+  size_t out_off = 0;
+  bool want_out = false;
+  double ewma_ms = 1.0;   // optimistic prior so fresh upstreams get traffic
+  uint64_t inflight = 0;
+  uint64_t forwarded = 0;
+
+  bool Ready() const { return fd >= 0 && !connecting; }
+  size_t Backlog() const { return outbuf.size() - out_off; }
+};
+
 struct Pending {
   uint64_t conn_id = 0;
   uint64_t orig_id = 0;    // downstream's req_id, restored on the way back
   uint64_t deadline_ns = 0;
+  uint64_t sent_ns = 0;
+  int up_idx = 0;
 };
 
 class Sidecar {
  public:
-  explicit Sidecar(const Options& opt) : opt_(opt) {}
+  explicit Sidecar(const Options& opt) : opt_(opt) {
+    for (const std::string& p : opt_.upstream_paths) {
+      ups_.emplace_back();
+      ups_.back().path = p;
+    }
+    // consistent-hash ring: 64 vnodes per upstream (FNV-mixed)
+    for (size_t u = 0; u < ups_.size(); ++u)
+      for (uint64_t v = 0; v < 64; ++v) {
+        uint64_t h = 1469598103934665603ull;
+        for (char c : ups_[u].path) h = (h ^ uint8_t(c)) * 1099511628211ull;
+        h = (h ^ v) * 1099511628211ull;
+        ring_[h] = int(u);
+      }
+  }
 
   int Run() {
     ep_ = epoll_create1(0);
     if (ep_ < 0) { perror("epoll_create1"); return 4; }
     if (!OpenListener()) return 3;
     if (opt_.status_port && !OpenStatusListener()) return 3;
-    ConnectUpstream();  // failure tolerated: requests fail open meanwhile
+    for (size_t u = 0; u < ups_.size(); ++u) ConnectUpstream(int(u));
 
     epoll_event events[128];
     while (true) {
@@ -132,10 +180,13 @@ class Sidecar {
       uint64_t now = NowNs();
       ExpireDeadlines(now);
       ExpireStatusConns(now);
-      if (up_fd_ < 0 && now >= up_retry_at_ns_) ConnectUpstream();
-      else if (up_connecting_ && now >= up_connect_deadline_ns_)
-        DropUpstream();  // connect() never completed
-      FlushUpstream();
+      for (size_t u = 0; u < ups_.size(); ++u) {
+        Upstream& up = ups_[u];
+        if (up.fd < 0 && now >= up.retry_at_ns) ConnectUpstream(int(u));
+        else if (up.connecting && now >= up.connect_deadline_ns)
+          DropUpstream(int(u));  // connect() never completed
+        FlushUpstream(int(u));
+      }
       // (no per-conn flush sweep: every downstream write path flushes
       // inline, and partial writes arm EPOLLOUT which re-enters FlushDown)
       CloseDoomed();
@@ -182,53 +233,116 @@ class Sidecar {
     return true;
   }
 
-  bool UpReady() const { return up_fd_ >= 0 && !up_connecting_; }
-
-  void ConnectUpstream() {
+  void ConnectUpstream(int u) {
+    Upstream& up = ups_[size_t(u)];
     int fd = socket(AF_UNIX, SOCK_STREAM, 0);
     SetNonblock(fd);  // BEFORE connect: a blocking connect (full listen
                       // backlog on a wedged serve loop) would freeze the
                       // event loop and turn fail-open into a hang
     sockaddr_un addr{};
     addr.sun_family = AF_UNIX;
-    strncpy(addr.sun_path, opt_.upstream_path.c_str(),
-            sizeof(addr.sun_path) - 1);
+    strncpy(addr.sun_path, up.path.c_str(), sizeof(addr.sun_path) - 1);
     int rc = connect(fd, (sockaddr*)&addr, sizeof addr);
     if (rc != 0 && errno != EINPROGRESS && errno != EAGAIN) {
       close(fd);
-      up_retry_at_ns_ = NowNs() + uint64_t(opt_.reconnect_ms) * 1000000ull;
+      up.retry_at_ns = NowNs() + uint64_t(opt_.reconnect_ms) * 1000000ull;
       return;
     }
-    up_fd_ = fd;
-    up_connecting_ = (rc != 0);
-    up_connect_deadline_ns_ = NowNs() + 1000000000ull;  // 1s to complete
-    up_reader_ = ipt::FrameReader();
-    up_outbuf_.clear();
-    up_out_off_ = 0;
-    up_want_out_ = false;
-    Register(fd, up_connecting_ ? (EPOLLIN | EPOLLOUT) : EPOLLIN,
-             kTagUpstream, 0);
-    if (!up_connecting_) ++counters_.upstream_reconnects;
+    up.fd = fd;
+    up.connecting = (rc != 0);
+    up.connect_deadline_ns = NowNs() + 1000000000ull;  // 1s to complete
+    up.reader = ipt::FrameReader();
+    up.outbuf.clear();
+    up.out_off = 0;
+    up.want_out = false;
+    Register(fd, up.connecting ? (EPOLLIN | EPOLLOUT) : EPOLLIN,
+             kTagUpstream, uint64_t(u));
+    if (!up.connecting) ++counters_.upstream_reconnects;
   }
 
-  void DropUpstream() {
-    if (up_fd_ >= 0) {
-      epoll_ctl(ep_, EPOLL_CTL_DEL, up_fd_, nullptr);
-      close(up_fd_);
-      up_fd_ = -1;
+  void DropUpstream(int u) {
+    Upstream& up = ups_[size_t(u)];
+    if (up.fd >= 0) {
+      epoll_ctl(ep_, EPOLL_CTL_DEL, up.fd, nullptr);
+      close(up.fd);
+      up.fd = -1;
     }
-    up_connecting_ = false;
-    up_outbuf_.clear();
-    up_out_off_ = 0;
-    // everything in flight on that connection is gone — fail it all open
-    for (auto& [up_id, p] : pending_) {
+    up.connecting = false;
+    up.outbuf.clear();
+    up.out_off = 0;
+    up.inflight = 0;
+    // everything in flight on that connection is gone — fail it all open;
+    // other upstreams' requests are untouched
+    for (auto it = pending_.begin(); it != pending_.end();) {
+      if (it->second.up_idx != u) { ++it; continue; }
+      Pending p = it->second;
+      it = pending_.erase(it);
+      streams_.erase(StreamKey(p.conn_id, p.orig_id));
+      auto cit = conns_by_id_.find(p.conn_id);
+      if (cit != conns_by_id_.end())
+        cit->second->open_streams.erase(p.orig_id);
       ++counters_.fail_open_upstream;
       SendFailOpen(p);
     }
-    pending_.clear();
-    streams_.clear();
-    for (auto& [id, c] : conns_) c->open_streams.clear();
-    up_retry_at_ns_ = NowNs() + uint64_t(opt_.reconnect_ms) * 1000000ull;
+    up.retry_at_ns = NowNs() + uint64_t(opt_.reconnect_ms) * 1000000ull;
+  }
+
+  // ---------------------------------------------------------- balancing
+
+  bool AnyReady() const {
+    for (const Upstream& up : ups_)
+      if (up.Ready()) return true;
+    return false;
+  }
+
+  // -1 = nothing usable (all down or over backlog cap) → caller fails open
+  int PickUpstream(uint32_t tenant) {
+    auto usable = [&](int u) {
+      const Upstream& up = ups_[size_t(u)];
+      return up.Ready() && up.Backlog() <= opt_.max_upstream_buf;
+    };
+    int n = int(ups_.size());
+    switch (opt_.balance) {
+      case Balance::kRoundRobin: {
+        for (int step = 0; step < n; ++step) {
+          int u = int((rr_next_ + uint64_t(step)) % uint64_t(n));
+          if (usable(u)) {
+            rr_next_ = uint64_t(u) + 1;
+            return u;
+          }
+        }
+        return -1;
+      }
+      case Balance::kEwma: {
+        // peak-EWMA: score = latency estimate × (1 + inflight) — the same
+        // load-shading the reference's ewma.lua applies
+        int best = -1;
+        double best_score = 0;
+        for (int u = 0; u < n; ++u) {
+          if (!usable(u)) continue;
+          const Upstream& up = ups_[size_t(u)];
+          double score = up.ewma_ms * double(1 + up.inflight);
+          if (best < 0 || score < best_score) {
+            best = u;
+            best_score = score;
+          }
+        }
+        return best;
+      }
+      case Balance::kChash: {
+        uint64_t h = 1469598103934665603ull;
+        for (int b = 0; b < 4; ++b)
+          h = (h ^ ((tenant >> (8 * b)) & 0xff)) * 1099511628211ull;
+        auto it = ring_.lower_bound(h);
+        // walk the ring until a usable upstream (consistent failover)
+        for (size_t step = 0; step < ring_.size(); ++step, ++it) {
+          if (it == ring_.end()) it = ring_.begin();
+          if (usable(it->second)) return it->second;
+        }
+        return -1;
+      }
+    }
+    return -1;
   }
 
   // ---------------------------------------------------------- epoll plumbing
@@ -264,7 +378,7 @@ class Sidecar {
     uint64_t payload = ev.data.u64 & kPayloadMask;
     switch (tag) {
       case kTagListener: AcceptDown(); break;
-      case kTagUpstream: HandleUpstream(ev.events); break;
+      case kTagUpstream: HandleUpstream(int(payload), ev.events); break;
       case kTagStatus: AcceptStatus(); break;
       case kTagStatusConn: HandleStatusConn(int(payload)); break;
       default: HandleDown(payload, ev.events); break;  // tag 0: conn id
@@ -284,7 +398,11 @@ class Sidecar {
       next = dl;
       break;
     }
-    if (up_fd_ < 0 && up_retry_at_ns_ < next) next = up_retry_at_ns_;
+    for (const Upstream& up : ups_) {
+      if (up.fd < 0 && up.retry_at_ns < next) next = up.retry_at_ns;
+      if (up.connecting && up.connect_deadline_ns < next)
+        next = up.connect_deadline_ns;
+    }
     if (next == UINT64_MAX) return 1000;
     if (next <= now) return 0;
     uint64_t ms = (next - now) / 1000000ull;
@@ -343,26 +461,25 @@ class Sidecar {
   void OnRequest(DownConn* c, const uint8_t* payload, size_t len) {
     ++counters_.requests_in;
     uint64_t orig_id = ipt::detail::get<uint64_t>(payload);
+    uint32_t tenant = ipt::detail::get<uint32_t>(payload + 8);
     uint8_t mode = payload[12];  // after req_id u64 + tenant u32
-    if (!UpReady()) {
-      ++counters_.fail_open_upstream;
+    int u = PickUpstream(tenant);
+    if (u < 0) {
+      if (AnyReady()) ++counters_.fail_open_overload;
+      else ++counters_.fail_open_upstream;
       SendFailOpenTo(c, orig_id);
       return;
     }
-    if (up_outbuf_.size() - up_out_off_ > opt_.max_upstream_buf) {
-      ++counters_.fail_open_overload;
-      SendFailOpenTo(c, orig_id);
-      return;
-    }
+    uint64_t now = NowNs();
     uint64_t up_id = ++next_up_id_;
-    uint64_t dl = NowNs() + uint64_t(opt_.deadline_ms * 1e6);
-    pending_[up_id] = Pending{c->id, orig_id, dl};
+    uint64_t dl = now + uint64_t(opt_.deadline_ms * 1e6);
+    pending_[up_id] = Pending{c->id, orig_id, dl, now, u};
     deadlines_.emplace(dl, up_id);
     if (mode & ipt::kModeStream) {
       streams_[StreamKey(c->id, orig_id)] = up_id;
       c->open_streams.insert(orig_id);
     }
-    AppendUpstream(ipt::kReqMagic, payload, len, up_id);
+    AppendUpstream(u, ipt::kReqMagic, payload, len, up_id);
   }
 
   void OnChunk(DownConn* c, const uint8_t* payload, size_t len) {
@@ -371,44 +488,47 @@ class Sidecar {
     auto it = streams_.find(StreamKey(c->id, orig_id));
     if (it == streams_.end()) return;  // stream already failed open/expired
     uint64_t up_id = it->second;
+    auto p = pending_.find(up_id);
+    if (p == pending_.end()) {  // should not happen; be safe
+      streams_.erase(it);
+      c->open_streams.erase(orig_id);
+      return;
+    }
+    int u = p->second.up_idx;  // streams are sticky to their upstream
     bool last = payload[8] & ipt::kChunkLast;
-    if (up_outbuf_.size() - up_out_off_ > opt_.max_upstream_buf) {
-      // applies to last chunks too — the shed path's synthetic abort is
-      // 17 bytes where the real chunk could be megabytes
-      // backlog cap applies to chunk flow too: a single fast uploader
-      // against a stalled upstream must not grow the buffer unboundedly.
-      // Shed the whole stream: fail it open now, abort it upstream.
+    if (ups_[size_t(u)].Backlog() > opt_.max_upstream_buf) {
+      // backlog cap applies to chunk flow too (last chunks included: the
+      // shed path's synthetic abort is 17 bytes where the real chunk
+      // could be megabytes) — a single fast uploader against a stalled
+      // upstream must not grow the buffer unboundedly
       streams_.erase(it);
       c->open_streams.erase(orig_id);
       pending_.erase(up_id);
       ++counters_.fail_open_overload;
       SendFailOpenTo(c, orig_id);
-      AbortStreamUpstream(up_id);
+      AbortStreamUpstream(u, up_id);
       return;
     }
     if (last) {
       streams_.erase(it);
       c->open_streams.erase(orig_id);
     }
-    auto p = pending_.find(up_id);
-    if (p != pending_.end()) {
-      // a stream is alive while chunks flow: refresh its deadline so a
-      // long upload isn't failed open mid-body (the SLO covers verdict
-      // latency after body end, matching the reference's incremental parse)
-      p->second.deadline_ns = NowNs() + uint64_t(opt_.deadline_ms * 1e6);
-      deadlines_.emplace(p->second.deadline_ns, up_id);
-    }
-    AppendUpstream(ipt::kChunkMagic, payload, len, up_id);
+    // a stream is alive while chunks flow: refresh its deadline so a
+    // long upload isn't failed open mid-body (the SLO covers verdict
+    // latency after body end, matching the reference's incremental parse)
+    p->second.deadline_ns = NowNs() + uint64_t(opt_.deadline_ms * 1e6);
+    deadlines_.emplace(p->second.deadline_ns, up_id);
+    AppendUpstream(u, ipt::kChunkMagic, payload, len, up_id);
   }
 
   // Synthesize an empty last-chunk so the serve loop finalizes and frees
   // the stream's state (its verdict, if any, is dropped as late).
-  void AbortStreamUpstream(uint64_t up_id) {
-    if (!UpReady()) return;
+  void AbortStreamUpstream(int u, uint64_t up_id) {
+    if (!ups_[size_t(u)].Ready()) return;
     std::string payload;
     ipt::detail::put<uint64_t>(&payload, up_id);
     payload.push_back(char(ipt::kChunkLast));
-    AppendUpstream(ipt::kChunkMagic,
+    AppendUpstream(u, ipt::kChunkMagic,
                    reinterpret_cast<const uint8_t*>(payload.data()),
                    payload.size(), up_id);
   }
@@ -452,7 +572,9 @@ class Sidecar {
       for (uint64_t orig_id : c->open_streams) {
         auto it = streams_.find(StreamKey(c->id, orig_id));
         if (it == streams_.end()) continue;
-        AbortStreamUpstream(it->second);
+        auto p = pending_.find(it->second);
+        if (p != pending_.end())
+          AbortStreamUpstream(p->second.up_idx, it->second);
         streams_.erase(it);
       }
       c->open_streams.clear();
@@ -478,88 +600,105 @@ class Sidecar {
     return conn_id * 0x9e3779b97f4a7c15ull ^ orig_id;
   }
 
-  void AppendUpstream(const char magic[4], const uint8_t* payload, size_t len,
-                      uint64_t up_id) {
-    up_outbuf_.append(magic, 4);
-    ipt::detail::put<uint32_t>(&up_outbuf_, uint32_t(len));
-    size_t at = up_outbuf_.size();
-    up_outbuf_.append(reinterpret_cast<const char*>(payload), len);
-    std::memcpy(&up_outbuf_[at], &up_id, 8);  // re-id for global uniqueness
+  void AppendUpstream(int u, const char magic[4], const uint8_t* payload,
+                      size_t len, uint64_t up_id) {
+    Upstream& up = ups_[size_t(u)];
+    up.outbuf.append(magic, 4);
+    ipt::detail::put<uint32_t>(&up.outbuf, uint32_t(len));
+    size_t at = up.outbuf.size();
+    up.outbuf.append(reinterpret_cast<const char*>(payload), len);
+    std::memcpy(&up.outbuf[at], &up_id, 8);  // re-id for global uniqueness
+    if (std::memcmp(magic, ipt::kReqMagic, 4) == 0) {
+      ++up.inflight;
+      ++up.forwarded;
+    }
     ++counters_.forwarded;
   }
 
-  void FlushUpstream() {
-    if (up_fd_ < 0) return;
-    while (up_out_off_ < up_outbuf_.size()) {
-      ssize_t n = write(up_fd_, up_outbuf_.data() + up_out_off_,
-                        up_outbuf_.size() - up_out_off_);
+  void FlushUpstream(int u) {
+    Upstream& up = ups_[size_t(u)];
+    if (up.fd < 0 || up.connecting) return;
+    while (up.out_off < up.outbuf.size()) {
+      ssize_t n = write(up.fd, up.outbuf.data() + up.out_off,
+                        up.outbuf.size() - up.out_off);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) break;
-        DropUpstream();
+        DropUpstream(u);
         return;
       }
-      up_out_off_ += size_t(n);
+      up.out_off += size_t(n);
     }
-    if (up_out_off_ == up_outbuf_.size()) {
-      up_outbuf_.clear();
-      up_out_off_ = 0;
+    if (up.out_off == up.outbuf.size()) {
+      up.outbuf.clear();
+      up.out_off = 0;
     }
-    bool want = !up_outbuf_.empty();
-    if (want != up_want_out_) {
-      up_want_out_ = want;
-      Modify(up_fd_, want ? (EPOLLIN | EPOLLOUT) : EPOLLIN, kTagUpstream, 0);
+    bool want = !up.outbuf.empty();
+    if (want != up.want_out) {
+      up.want_out = want;
+      Modify(up.fd, want ? (EPOLLIN | EPOLLOUT) : EPOLLIN, kTagUpstream,
+             uint64_t(u));
     }
   }
 
-  void HandleUpstream(uint32_t events) {
-    if (up_connecting_) {
-      if (events & (EPOLLHUP | EPOLLERR)) { DropUpstream(); return; }
+  void HandleUpstream(int u, uint32_t events) {
+    Upstream& up = ups_[size_t(u)];
+    if (up.connecting) {
+      if (events & (EPOLLHUP | EPOLLERR)) { DropUpstream(u); return; }
       if (events & EPOLLOUT) {  // nonblocking connect completed — how?
         int err = 0;
         socklen_t len = sizeof err;
-        getsockopt(up_fd_, SOL_SOCKET, SO_ERROR, &err, &len);
-        if (err != 0) { DropUpstream(); return; }
-        up_connecting_ = false;
-        up_want_out_ = false;
-        Modify(up_fd_, EPOLLIN, kTagUpstream, 0);
+        getsockopt(up.fd, SOL_SOCKET, SO_ERROR, &err, &len);
+        if (err != 0) { DropUpstream(u); return; }
+        up.connecting = false;
+        up.want_out = false;
+        Modify(up.fd, EPOLLIN, kTagUpstream, uint64_t(u));
         ++counters_.upstream_reconnects;
       }
       return;
     }
-    if (events & (EPOLLHUP | EPOLLERR)) { DropUpstream(); return; }
+    if (events & (EPOLLHUP | EPOLLERR)) { DropUpstream(u); return; }
     if (events & EPOLLIN) {
       uint8_t buf[1 << 16];
       ssize_t n;
-      while (up_fd_ >= 0 && (n = read(up_fd_, buf, sizeof buf)) > 0) {
+      while (up.fd >= 0 && (n = read(up.fd, buf, sizeof buf)) > 0) {
         try {
-          up_reader_.Feed(buf, size_t(n), [&](const uint8_t* p, size_t len) {
-            OnVerdict(p, len);
+          up.reader.Feed(buf, size_t(n), [&](const uint8_t* p, size_t len) {
+            OnVerdict(u, p, len);
           });
         } catch (const std::exception& e) {
-          fprintf(stderr, "upstream protocol error: %s\n", e.what());
-          DropUpstream();
+          fprintf(stderr, "upstream %s protocol error: %s\n",
+                  up.path.c_str(), e.what());
+          DropUpstream(u);
           return;
         }
       }
-      if (up_fd_ >= 0 && n == 0) { DropUpstream(); return; }
-      if (up_fd_ >= 0 && n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
-        DropUpstream();  // hard error (e.g. ECONNRESET without EPOLLERR):
-        return;          // leaving the fd registered would busy-loop
+      if (up.fd >= 0 && n == 0) { DropUpstream(u); return; }
+      if (up.fd >= 0 && n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+        DropUpstream(u);  // hard error (e.g. ECONNRESET without EPOLLERR):
+        return;           // leaving the fd registered would busy-loop
       }
     }
-    FlushUpstream();
+    FlushUpstream(u);
   }
 
-  void OnVerdict(const uint8_t* payload, size_t len) {
+  void OnVerdict(int u, const uint8_t* payload, size_t len) {
     uint64_t up_id = ipt::detail::get<uint64_t>(payload);
     auto it = pending_.find(up_id);
+    Upstream& up = ups_[size_t(u)];
     if (it == pending_.end()) {
-      ++counters_.late_responses;  // answered after deadline fail-open
+      // answered after deadline fail-open — ExpireDeadlines already
+      // decremented inflight for it; decrementing again here would hide
+      // a slow upstream's load from the ewma policy
+      ++counters_.late_responses;
       return;
     }
+    if (up.inflight > 0) --up.inflight;
     Pending p = it->second;
     pending_.erase(it);
     ++counters_.responses;
+    // EWMA latency update (α = 0.1) feeds the ewma balancing policy
+    double ms = double(NowNs() - p.sent_ns) / 1e6;
+    up.ewma_ms += 0.1 * (ms - up.ewma_ms);
     auto cit = conns_by_id_.find(p.conn_id);
     if (cit == conns_by_id_.end() || cit->second->fd < 0) return;  // gone
     DownConn* c = cit->second;
@@ -600,9 +739,11 @@ class Sidecar {
       if (it == pending_.end() || it->second.deadline_ns != dl) continue;
       Pending p = it->second;
       pending_.erase(it);
+      Upstream& up = ups_[size_t(p.up_idx)];
+      if (up.inflight > 0) --up.inflight;
       auto sit = streams_.find(StreamKey(p.conn_id, p.orig_id));
       if (sit != streams_.end()) {  // stream stalled mid-body: abort it
-        AbortStreamUpstream(sit->second);
+        AbortStreamUpstream(p.up_idx, sit->second);
         streams_.erase(sit);
         auto cit = conns_by_id_.find(p.conn_id);
         if (cit != conns_by_id_.end())
@@ -648,16 +789,34 @@ class Sidecar {
     uint8_t drain[4096];
     ssize_t n = read(fd, drain, sizeof drain);
     if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
-    char body[1024];
-    int blen = snprintf(
-        body, sizeof body,
+    // std::string throughout: upstream count/paths are unbounded, so a
+    // fixed stack buffer would truncate — or worse, a raw snprintf return
+    // used as a write length would leak adjacent stack bytes
+    auto item = [](const char* fmt, auto... args) {
+      char b[512];
+      int n = snprintf(b, sizeof b, fmt, args...);
+      if (n < 0) n = 0;
+      if (n >= int(sizeof b)) n = int(sizeof b) - 1;
+      return std::string(b, size_t(n));
+    };
+    std::string ups_json = "[";
+    for (size_t u = 0; u < ups_.size(); ++u)
+      ups_json += item(
+          "%s{\"path\": \"%s\", \"connected\": %s, \"ewma_ms\": %.3f, "
+          "\"inflight\": %llu, \"forwarded\": %llu}",
+          u ? ", " : "", ups_[u].path.c_str(),
+          ups_[u].Ready() ? "true" : "false", ups_[u].ewma_ms,
+          (unsigned long long)ups_[u].inflight,
+          (unsigned long long)ups_[u].forwarded);
+    ups_json += "]";
+    std::string body = item(
         "{\"requests_in\": %llu, \"chunks_in\": %llu, "
         "\"forwarded\": %llu, \"responses\": %llu, "
         "\"fail_open_deadline\": %llu, \"fail_open_upstream\": %llu, "
         "\"fail_open_overload\": %llu, \"late_responses\": %llu, "
         "\"down_conns_total\": %llu, \"down_conns_active\": %llu, "
         "\"bad_frames\": %llu, \"upstream_reconnects\": %llu, "
-        "\"upstream_connected\": %s, \"pending\": %zu}\n",
+        "\"upstream_connected\": %s, \"pending\": %zu, ",
         (unsigned long long)counters_.requests_in,
         (unsigned long long)counters_.chunks_in,
         (unsigned long long)counters_.forwarded,
@@ -670,15 +829,13 @@ class Sidecar {
         (unsigned long long)counters_.down_conns_active,
         (unsigned long long)counters_.bad_frames,
         (unsigned long long)counters_.upstream_reconnects,
-        up_fd_ >= 0 ? "true" : "false", pending_.size());
-    char resp[1400];
-    int rlen = snprintf(resp, sizeof resp,
-                        "HTTP/1.0 200 OK\r\n"
-                        "Content-Type: application/json\r\n"
-                        "Content-Length: %d\r\n\r\n%s",
-                        blen, body);
+        AnyReady() ? "true" : "false", pending_.size());
+    body += "\"upstreams\": " + ups_json + "}\n";
+    std::string resp =
+        item("HTTP/1.0 200 OK\r\nContent-Type: application/json\r\n"
+             "Content-Length: %zu\r\n\r\n", body.size()) + body;
     // one-shot local scrape: a single write covers it (fits the sndbuf)
-    ssize_t w = write(fd, resp, size_t(rlen));
+    ssize_t w = write(fd, resp.data(), resp.size());
     (void)w;
     CloseStatusConn(fd);
   }
@@ -698,14 +855,9 @@ class Sidecar {
   uint64_t next_conn_id_ = 0;
   std::unordered_map<int, uint64_t> status_conns_;  // fd → idle deadline
 
-  int up_fd_ = -1;
-  bool up_connecting_ = false;
-  uint64_t up_connect_deadline_ns_ = 0;
-  ipt::FrameReader up_reader_;
-  std::string up_outbuf_;
-  size_t up_out_off_ = 0;
-  bool up_want_out_ = false;
-  uint64_t up_retry_at_ns_ = 0;
+  std::vector<Upstream> ups_;
+  std::map<uint64_t, int> ring_;  // chash: vnode hash → upstream index
+  uint64_t rr_next_ = 0;
 
   uint64_t next_up_id_ = 0;
   std::unordered_map<uint64_t, Pending> pending_;
@@ -730,7 +882,27 @@ int main(int argc, char** argv) {
       return argv[++i];
     };
     if (a == "--listen") opt.listen_path = next();
-    else if (a == "--upstream") opt.upstream_path = next();
+    else if (a == "--upstream") {
+      // comma-separated list of serve-loop sockets (one per chip)
+      std::string v = next();
+      size_t start = 0;
+      while (start <= v.size()) {
+        size_t comma = v.find(',', start);
+        std::string p = v.substr(
+            start, comma == std::string::npos ? std::string::npos
+                                              : comma - start);
+        if (!p.empty()) opt.upstream_paths.push_back(p);
+        if (comma == std::string::npos) break;
+        start = comma + 1;
+      }
+    }
+    else if (a == "--balance") {
+      std::string v = next();
+      if (v == "rr") opt.balance = Balance::kRoundRobin;
+      else if (v == "ewma") opt.balance = Balance::kEwma;
+      else if (v == "chash") opt.balance = Balance::kChash;
+      else { fprintf(stderr, "unknown balance policy %s\n", v.c_str()); return 2; }
+    }
     else if (a == "--deadline-ms") opt.deadline_ms = atof(next());
     else if (a == "--status-port") opt.status_port = atoi(next());
     else if (a == "--max-upstream-buf") opt.max_upstream_buf = size_t(atol(next()));
@@ -738,11 +910,11 @@ int main(int argc, char** argv) {
     else if (a == "--reconnect-ms") opt.reconnect_ms = atoi(next());
     else { fprintf(stderr, "unknown arg %s\n", a.c_str()); return 2; }
   }
-  if (opt.listen_path.empty() || opt.upstream_path.empty()) {
+  if (opt.listen_path.empty() || opt.upstream_paths.empty()) {
     fprintf(stderr,
-            "usage: sidecar --listen <uds> --upstream <uds> "
-            "[--deadline-ms N] [--status-port P] [--max-upstream-buf B] "
-            "[--max-down-buf B] [--reconnect-ms N]\n");
+            "usage: sidecar --listen <uds> --upstream <uds>[,<uds>...] "
+            "[--balance rr|ewma|chash] [--deadline-ms N] [--status-port P] "
+            "[--max-upstream-buf B] [--max-down-buf B] [--reconnect-ms N]\n");
     return 2;
   }
   signal(SIGPIPE, SIG_IGN);

@@ -1,0 +1,55 @@
+"""Multi-host (DCN) tier: gated init, hybrid mesh fallback, batch slicing.
+
+True multi-process DCN cannot run in CI (single host); these tests pin the
+single-process degradation paths plus the mesh/slice math — the driver's
+dryrun_multichip covers the sharded compile itself.
+"""
+
+import jax
+import pytest
+
+from ingress_plus_tpu.parallel.dcn import (
+    device_duty_summary,
+    hybrid_mesh,
+    init_distributed,
+    local_batch_bounds,
+)
+
+
+def test_init_distributed_noop_single_process(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert init_distributed() is False  # no coordinator → local mode
+
+
+def test_init_distributed_rejects_bad_env(monkeypatch):
+    # num_processes=1 with an address is still single-process
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    assert init_distributed() is False
+
+
+def test_hybrid_mesh_single_process_fallback():
+    mesh = hybrid_mesh(n_model=4)
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.shape["model"] == 4
+    assert mesh.shape["data"] * 4 == len(jax.devices())
+
+
+def test_local_batch_bounds_single_process():
+    mesh = hybrid_mesh(n_model=4)
+    start, end = local_batch_bounds(mesh, 64)
+    assert (start, end) == (0, 64)  # single process owns everything
+
+
+def test_local_batch_bounds_divisibility():
+    mesh = hybrid_mesh(n_model=4)
+    with pytest.raises(ValueError):
+        local_batch_bounds(mesh, 63)
+
+
+def test_duty_summary_shape():
+    s = device_duty_summary()
+    assert s["process_count"] == 1
+    assert s["global_device_count"] == len(jax.devices())
+    assert len(s["local_devices"]) >= 1

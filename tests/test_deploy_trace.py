@@ -1,0 +1,117 @@
+"""Packaging renderer (Helm-chart analog), trace ring, and the
+postanalytics consolidator CLI — golden-file style like the reference's
+template_test.go† (SURVEY.md §4)."""
+
+import json
+from pathlib import Path
+
+from ingress_plus_tpu.control.deploy import (
+    DeployValues,
+    render_all,
+    write_static,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_render_contains_architecture():
+    v = DeployValues(chips_per_host=2, balance="ewma", deadline_ms=30)
+    out = render_all(v)
+    dep = out["deployment.yaml"]
+    # one serve loop per chip, each with its own socket + chip binding
+    assert dep.count("name: serve-") == 2
+    assert "/run/ipt/serve-0.sock" in dep and "/run/ipt/serve-1.sock" in dep
+    assert "google.com/tpu: 1" in dep
+    # sidecar balances across both and owns the fail-open deadline
+    assert "- /run/ipt/serve-0.sock,/run/ipt/serve-1.sock" in dep
+    assert "- ewma" in dep
+    assert '- "30"' in dep
+    # liveness probes wired to the serve loops' /healthz
+    assert dep.count("path: /healthz") == 2
+    # postanalytics consolidator shares the pod's spool emptyDir (a
+    # separate Deployment's emptyDir would always be empty)
+    assert "ingress_plus_tpu.post.export" in dep
+    assert dep.count("name: ipt-spool, mountPath") >= 3
+    cm = out["configmap.yaml"]
+    assert 'detection-backend: "tpu"' in cm
+    assert 'fail-open: "true"' in cm
+    assert "attacks" not in out["service.yaml"]  # no hot-path port leaks
+
+
+def test_static_manifests_in_sync(tmp_path):
+    """deploy/static must equal a fresh default render (the reference
+    regenerates deploy/static from the chart the same way)."""
+    fresh = tmp_path / "static"
+    write_static(fresh)
+    committed = REPO / "deploy" / "static"
+    fresh_names = sorted(p.name for p in fresh.iterdir())
+    assert sorted(p.name for p in committed.iterdir()) == fresh_names, \
+        "deploy/static file set is stale"
+    for f in fresh.iterdir():
+        assert (committed / f.name).read_text() == f.read_text(), \
+            "deploy/static/%s is stale — run python -m " \
+            "ingress_plus_tpu.control.deploy" % f.name
+
+
+def test_trace_ring_bounds_and_slowest():
+    from ingress_plus_tpu.utils.trace import BatchTrace, TraceRing
+
+    ring = TraceRing(capacity=8)
+    for i in range(20):
+        ring.record(BatchTrace(
+            ts=float(i), n_requests=1, n_stream_items=0, queue_delay_us=5,
+            batch_us=1000 + i, engine_us=800, confirm_us=50,
+            request_ids=["r%d" % i]))
+    snap = ring.snapshot()
+    assert len(snap) == 8                      # bounded
+    assert snap[-1]["request_ids"] == ["r19"]  # newest kept
+    slow = ring.slowest(3)
+    assert [t["batch_us"] for t in slow] == [1019, 1018, 1017]
+
+
+def test_batcher_records_traces():
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.batcher import Batcher
+    from ingress_plus_tpu.serve.normalize import Request
+
+    rules = """
+SecRule ARGS "@rx (?i)union\\s+select" "id:942100,phase:2,block,severity:CRITICAL,tag:'attack-sqli'"
+"""
+    b = Batcher(DetectionPipeline(compile_ruleset(parse_seclang(rules))),
+                max_delay_s=0.001)
+    try:
+        fut = b.submit(Request(uri="/?q=1%20union%20select%20x",
+                               request_id="t-1"))
+        assert fut.result(timeout=60).attack
+        traces = b.traces.snapshot()
+        assert traces and traces[-1]["n_requests"] == 1
+        assert traces[-1]["request_ids"] == ["t-1"]
+        assert traces[-1]["batch_us"] > 0
+    finally:
+        b.close()
+
+
+def test_consolidator_cli(tmp_path):
+    from ingress_plus_tpu.post.export import consolidate_once
+
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    records = [{"first_ts": 1.0, "classes": ["sqli"], "count": 3},
+               {"first_ts": 2.0, "classes": ["xss"], "count": 1}]
+    with (spool / "attacks.jsonl").open("w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    assert consolidate_once(spool) == 2
+    assert not (spool / "attacks.jsonl").exists()          # claimed
+    merged = (spool / "consolidated" / "attacks.jsonl").read_text()
+    assert len(merged.splitlines()) == 2
+    # idempotent on empty spool
+    assert consolidate_once(spool) == 0
+    # unreachable collector keeps the claim for retry (at-least-once)
+    with (spool / "attacks.jsonl").open("w") as f:
+        f.write(json.dumps(records[0]) + "\n")
+    assert consolidate_once(spool, url="http://127.0.0.1:1/x") == 0
+    assert list(spool.glob("attacks.*.sending"))
+    assert consolidate_once(spool) == 1                    # retried, kept

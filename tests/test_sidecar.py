@@ -239,6 +239,126 @@ def test_malformed_frame_closes_conn_only(sidecar):
     assert _status()["bad_frames"] >= 1
 
 
+@pytest.fixture(scope="module")
+def two_servers(tmp_path_factory, binaries):
+    """Two serve loops — the one-serve-loop-per-chip layout the balancer
+    (balancer.lua analog) spreads traffic across."""
+    tmp = tmp_path_factory.mktemp("twoserve")
+    rules_dir = tmp / "rules"
+    rules_dir.mkdir()
+    (rules_dir / "tiny.conf").write_text(TINY_RULES)
+    socks, procs = [], []
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    for i in range(2):
+        sock = str(tmp / ("serve%d.sock" % i))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ingress_plus_tpu.serve",
+             "--socket", sock, "--rules-dir", str(rules_dir),
+             "--platform", "cpu", "--max-delay-us", "1000", "--no-warmup",
+             "--http-port", "0"],
+            cwd=str(REPO), env=env, stderr=subprocess.PIPE, text=True)
+        socks.append(sock)
+        procs.append(proc)
+    for sock, proc in zip(socks, procs):
+        _wait_socket(sock, proc, "serve loop")
+    yield socks, procs
+    for proc in procs:
+        if proc.poll() is None:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+def _run_sidecar(listen, upstreams, port, balance="rr", deadline_ms=5000):
+    return subprocess.Popen(
+        [str(BIN), "--listen", listen, "--upstream", ",".join(upstreams),
+         "--balance", balance, "--deadline-ms", str(deadline_ms),
+         "--status-port", str(port)],
+        stderr=subprocess.PIPE, text=True)
+
+
+def test_balancer_round_robin_spreads(two_servers, tmp_path):
+    socks, _ = two_servers
+    listen = str(tmp_path / "side.sock")
+    proc = _run_sidecar(listen, socks, 19913)
+    try:
+        _wait_socket(listen, proc, "sidecar")
+        c = Client(listen)
+        for i in range(40):
+            c.send(_request("/x?i=%d" % i, req_id=100 + i))
+            assert not c.recv_verdict()["fail_open"]
+        c.close()
+        st = _status(19913)
+        fwd = [u["forwarded"] for u in st["upstreams"]]
+        assert sum(fwd) == 40
+        assert min(fwd) >= 15  # rr: near-even split
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_balancer_chash_tenant_affinity(two_servers, tmp_path):
+    from ingress_plus_tpu.serve.normalize import Request
+    from ingress_plus_tpu.serve.protocol import encode_request
+
+    socks, _ = two_servers
+    listen = str(tmp_path / "side.sock")
+    proc = _run_sidecar(listen, socks, 19914, balance="chash")
+    try:
+        _wait_socket(listen, proc, "sidecar")
+        c = Client(listen)
+        rid = 500
+        for tenant in (3, 9):
+            for _ in range(10):
+                c.send(encode_request(
+                    Request(uri="/x", headers={"Host": "t"}, tenant=tenant),
+                    rid))
+                assert not c.recv_verdict()["fail_open"]
+                rid += 1
+        c.close()
+        st = _status(19914)
+        fwd = sorted(u["forwarded"] for u in st["upstreams"])
+        # each tenant maps to exactly one upstream; with 2 tenants the
+        # split is either 10/10 (different ring slots) or 0/20 (same)
+        assert sum(fwd) == 20
+        assert fwd[0] in (0, 10)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_balancer_failover(two_servers, tmp_path):
+    socks, procs = two_servers
+    listen = str(tmp_path / "side.sock")
+    proc = _run_sidecar(listen, socks, 19915)
+    try:
+        _wait_socket(listen, proc, "sidecar")
+        c = Client(listen)
+        for i in range(10):
+            c.send(_request("/x?i=%d" % i, req_id=700 + i))
+            assert not c.recv_verdict()["fail_open"]
+        # kill one serve loop: traffic must continue on the survivor
+        procs[1].terminate()
+        procs[1].wait(timeout=10)
+        time.sleep(0.3)
+        ok = 0
+        for i in range(20):
+            c.send(_request("/?q=1%%20union%%20select%%20x&i=%d" % i,
+                            req_id=800 + i))
+            v = c.recv_verdict()
+            if not v["fail_open"]:
+                ok += 1
+                assert v["attack"]
+        assert ok >= 18  # at most the in-flight moment wobbles
+        c.close()
+        st = _status(19915)
+        alive = [u for u in st["upstreams"] if u["connected"]]
+        assert len(alive) == 1
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
 def test_deadline_fail_open(binaries, tmp_path):
     """Upstream accepts but never answers → pass+fail_open within ~deadline."""
     stall = str(tmp_path / "stall.sock")
