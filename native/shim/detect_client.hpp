@@ -1,0 +1,222 @@
+// Synchronous detection client — the nginx-shim side of the UDS boundary
+// (SURVEY.md §3.3 TPU variant: nginx ⇄ shim ⇄ sidecar ⇄ serve loop).
+//
+// This is the blocking core the nginx module (ngx_http_detect_tpu_module.c)
+// runs on an ngx_thread_pool task, and what anything else that wants a
+// verdict (tests, CLI tools, other data planes) links directly.  One
+// instance per thread; it owns one connection to the sidecar (or a serve
+// loop directly) and reconnects lazily.
+//
+// The fail-open contract lives HERE as well as in the sidecar: any error
+// or deadline miss returns a pass+fail_open verdict — the caller never
+// blocks traffic on WAF trouble (`wallarm-fallback`† behavior).
+
+#pragma once
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <string>
+
+#include "../sidecar/protocol.hpp"
+
+namespace ipt {
+
+class DetectClient {
+ public:
+  explicit DetectClient(std::string socket_path, double deadline_ms = 50.0)
+      : path_(std::move(socket_path)), deadline_ms_(deadline_ms) {}
+
+  ~DetectClient() { Close(); }
+
+  DetectClient(const DetectClient&) = delete;
+  DetectClient& operator=(const DetectClient&) = delete;
+
+  // Blocking: ship the request, wait for its verdict until the deadline.
+  // Never throws; never blocks past deadline_ms; fail-open on any trouble.
+  Response Detect(const Request& req) {
+    Response fail;
+    fail.req_id = req.req_id;
+    fail.flags = kFailOpen;
+    uint64_t deadline = NowNs() + uint64_t(deadline_ms_ * 1e6);
+    if (fd_ < 0 && !Connect()) return fail;
+    std::string frame = EncodeRequest(req);
+    if (!SendAll(frame.data(), frame.size(), deadline)) {
+      Close();
+      return fail;
+    }
+    return WaitVerdict(req.req_id, deadline, fail);
+  }
+
+  // Streaming-body variant: open with Detect-style request (mode must
+  // include kModeStream), then feed chunks, then FinishStream for the
+  // verdict.  Mirrors the wallarm module's incremental body parse†.
+  bool BeginStream(const Request& req) {
+    if (fd_ < 0 && !Connect()) return false;
+    Request r = req;
+    r.mode |= kModeStream;
+    std::string frame = EncodeRequest(r);
+    uint64_t deadline = NowNs() + uint64_t(deadline_ms_ * 1e6);
+    if (!SendAll(frame.data(), frame.size(), deadline)) {
+      Close();
+      return false;
+    }
+    return true;
+  }
+
+  bool SendChunk(uint64_t req_id, const std::string& data,
+                 bool last = false) {
+    if (fd_ < 0) return false;
+    std::string frame = EncodeChunk(req_id, data, last);
+    uint64_t deadline = NowNs() + uint64_t(deadline_ms_ * 1e6);
+    if (!SendAll(frame.data(), frame.size(), deadline)) {
+      Close();
+      return false;
+    }
+    return true;
+  }
+
+  Response FinishStream(uint64_t req_id) {
+    Response fail;
+    fail.req_id = req_id;
+    fail.flags = kFailOpen;
+    if (fd_ < 0) return fail;
+    uint64_t deadline = NowNs() + uint64_t(deadline_ms_ * 1e6);
+    return WaitVerdict(req_id, deadline, fail);
+  }
+
+  bool connected() const { return fd_ >= 0; }
+
+ private:
+  static uint64_t NowNs() {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return uint64_t(ts.tv_sec) * 1000000000ull + uint64_t(ts.tv_nsec);
+  }
+
+  bool Connect() {
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return false;
+    // nonblocking BEFORE connect: a wedged sidecar with a full accept
+    // backlog must produce fail-open at the deadline, not a pinned
+    // pool thread (connect on a blocking socket ignores the deadline)
+    int flags = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, path_.c_str(), sizeof(addr.sun_path) - 1);
+    if (connect(fd, (sockaddr*)&addr, sizeof addr) != 0) {
+      if (errno != EINPROGRESS && errno != EAGAIN) {
+        close(fd);
+        return false;
+      }
+      uint64_t deadline = NowNs() + uint64_t(deadline_ms_ * 1e6);
+      pollfd p{fd, POLLOUT, 0};
+      uint64_t now = NowNs();
+      int rc = now < deadline
+          ? poll(&p, 1, int((deadline - now) / 1000000ull) + 1) : 0;
+      int err = 0;
+      socklen_t len = sizeof err;
+      if (rc <= 0 || !(p.revents & POLLOUT) ||
+          getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 ||
+          err != 0) {
+        close(fd);
+        return false;
+      }
+    }
+    fd_ = fd;
+    reader_ = FrameReader();
+    return true;
+  }
+
+  void Close() {
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  bool SendAll(const char* data, size_t n, uint64_t deadline) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t w = send(fd_, data + off, n - off, MSG_NOSIGNAL);
+      if (w > 0) {
+        off += size_t(w);
+        continue;
+      }
+      if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        if (!PollFor(POLLOUT, deadline)) return false;
+        continue;
+      }
+      return false;
+    }
+    return true;
+  }
+
+  bool PollFor(short events, uint64_t deadline) {
+    uint64_t now = NowNs();
+    if (now >= deadline) return false;
+    pollfd p{fd_, events, 0};
+    int rc = poll(&p, 1, int((deadline - now) / 1000000ull) + 1);
+    return rc > 0 && (p.revents & events);
+  }
+
+  // Reads frames until req_id's verdict or the deadline.  Verdicts for
+  // OTHER ids (a previous call that timed out and was answered late) are
+  // discarded — each client instance is single-stream by contract.
+  Response WaitVerdict(uint64_t req_id, uint64_t deadline,
+                       const Response& fail) {
+    while (true) {
+      Response got;
+      bool have = false;
+      try {
+        // drain already-buffered frames first
+        reader_.Feed(nullptr, 0, [&](const uint8_t* p, size_t len) {
+          Response r = DecodeResponse(p, len);
+          if (r.req_id == req_id) {
+            got = r;
+            have = true;
+          }
+        });
+      } catch (const std::exception&) {
+        Close();
+        return fail;
+      }
+      if (have) return got;
+      if (!PollFor(POLLIN, deadline)) return fail;  // deadline → fail-open
+      uint8_t buf[1 << 16];
+      ssize_t n = recv(fd_, buf, sizeof buf, 0);
+      if (n <= 0) {
+        if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) continue;
+        Close();
+        return fail;
+      }
+      try {
+        reader_.Feed(buf, size_t(n), [&](const uint8_t* p, size_t len) {
+          Response r = DecodeResponse(p, len);
+          if (r.req_id == req_id) {
+            got = r;
+            have = true;
+          }
+        });
+      } catch (const std::exception&) {
+        Close();
+        return fail;
+      }
+      if (have) return got;
+    }
+  }
+
+  std::string path_;
+  double deadline_ms_;
+  int fd_ = -1;
+  FrameReader reader_;
+};
+
+}  // namespace ipt

@@ -1,0 +1,534 @@
+/*
+ * ngx_http_detect_tpu_module — nginx-side shim for the TPU detection path.
+ *
+ * The native boundary of SURVEY.md §2.2: the reference integrates its WAF
+ * as a closed-source nginx module (ngx_http_wallarm_module†) hooked into
+ * the rewrite/access phases; this module is the open equivalent for the
+ * TPU backend, implementing exactly the directives the template renderer
+ * (ingress_plus_tpu/control/template.py) emits for
+ * `detection-backend: tpu` locations:
+ *
+ *     detect_tpu on;
+ *     detect_tpu_socket /run/ipt/detect.sock;
+ *     detect_tpu_mode block | monitoring | off;
+ *     detect_tpu_timeout_ms 30;
+ *     detect_tpu_fail_open on;
+ *     detect_tpu_tenant 7;
+ *     detect_tpu_block_page /blocked.html;
+ *     detect_tpu_parse_response on;        (body-filter phase, later)
+ *     detect_tpu_parse_websocket on;
+ *     detect_tpu_parser_disable xml;
+ *     detect_tpu_metrics 127.0.0.1:9901;   (server scope)
+ *
+ * Request flow (nginx worker threads must never block on a verdict):
+ *
+ *   ACCESS phase, entry 1:  create ctx, start the client-body read
+ *                           (ngx_http_read_client_request_body with a
+ *                           continuation — the mirror-module pattern);
+ *                           return NGX_DONE.
+ *   body continuation:      re-enter the phase walk.
+ *   ACCESS phase, entry 2:  capture method/uri/headers/body into the ctx
+ *                           ON THE EVENT THREAD (the pool thread never
+ *                           touches ngx_http_request_t), post the
+ *                           blocking DetectClient round-trip
+ *                           (detect_client.hpp) onto the "detect_tpu"
+ *                           ngx_thread_pool; return NGX_AGAIN.
+ *   task completion event:  (event-loop thread) mark the ctx done —
+ *                           the ONLY completion signal the handler
+ *                           reads — and re-enter the phase walk.
+ *   ACCESS phase, entry 3:  apply the verdict: 403/block-page when
+ *                           blocked in block mode; otherwise pass, with
+ *                           an `X-Detect-TPU: fail-open` response header
+ *                           when the verdict was a fail-open (the
+ *                           load-bearing fallback contract, SURVEY.md §5
+ *                           — enforced here AND in the sidecar).
+ *
+ * BUILD: requires the nginx source tree (not present in this dev image —
+ * tests cover DetectClient itself via shim_selftest):
+ *
+ *     ./configure --add-module=/path/to/native/shim \
+ *                 --with-threads --with-compat
+ *
+ * and an nginx.conf `thread_pool detect_tpu threads=32;` block.  The
+ * `config` file next to this source declares the module to nginx's build
+ * system; C++ linkage for detect_client is isolated behind
+ * detect_tpu_roundtrip() (shim_bridge.cc).
+ */
+
+#include <ngx_config.h>
+#include <ngx_core.h>
+#include <ngx_http.h>
+
+/* implemented in shim_bridge.cc (C++, wraps ipt::DetectClient; one
+ * thread-local client per pool thread, keyed on socket+timeout) */
+extern ngx_int_t detect_tpu_roundtrip(
+    const char *socket_path, double timeout_ms, uint64_t req_id,
+    uint32_t tenant, uint8_t mode, const char *method, size_t method_len,
+    const char *uri, size_t uri_len, const char *headers, size_t headers_len,
+    const char *body, size_t body_len,
+    /* out */ uint8_t *flags, uint32_t *score);
+
+#define DETECT_TPU_FLAG_ATTACK    0x01
+#define DETECT_TPU_FLAG_BLOCKED   0x02
+#define DETECT_TPU_FLAG_FAIL_OPEN 0x04
+
+typedef struct {
+    ngx_flag_t   enabled;          /* detect_tpu              */
+    ngx_str_t    socket_path;      /* detect_tpu_socket       */
+    ngx_uint_t   mode;             /* 0 off 1 monitoring 2 block */
+    ngx_uint_t   timeout_ms;       /* detect_tpu_timeout_ms   */
+    ngx_flag_t   fail_open;        /* detect_tpu_fail_open    */
+    ngx_uint_t   tenant;           /* detect_tpu_tenant       */
+    ngx_str_t    block_page;       /* detect_tpu_block_page   */
+    /* response/websocket scanning + parser toggles are captured from the
+     * rendered config for parity with the reference's wallarm_* set; the
+     * response side hooks a body filter in a later phase of the build */
+    ngx_flag_t   parse_response;   /* detect_tpu_parse_response  */
+    ngx_flag_t   parse_websocket;  /* detect_tpu_parse_websocket */
+    ngx_array_t *parser_disable;   /* detect_tpu_parser_disable  */
+    ngx_str_t    metrics_addr;     /* detect_tpu_metrics: the serve loop's
+                                    * HTTP config/metrics plane (rendered
+                                    * at server scope by the template) */
+} ngx_http_detect_tpu_loc_conf_t;
+
+typedef struct {
+    ngx_http_request_t  *request;
+    /* captured on the event thread before the task is posted; the pool
+     * thread reads ONLY this struct, never the ngx_http_request_t */
+    ngx_str_t            method;
+    ngx_str_t            uri;
+    ngx_str_t            headers_blob;
+    ngx_str_t            body;
+    ngx_str_t            socket_path;
+    double               timeout_ms;
+    uint32_t             tenant;
+    uint8_t              mode;
+    /* result (written by the pool thread, read by the handler strictly
+     * after the completion event — the pool queue is the barrier) */
+    uint8_t              flags;
+    uint32_t             score;
+    /* state machine, event-loop thread only */
+    unsigned             body_ready:1;
+    unsigned             task_posted:1;
+    unsigned             done_ev:1;
+} ngx_http_detect_tpu_ctx_t;
+
+static ngx_int_t ngx_http_detect_tpu_handler(ngx_http_request_t *r);
+static void ngx_http_detect_tpu_body_done(ngx_http_request_t *r);
+static void ngx_http_detect_tpu_thread_func(void *data, ngx_log_t *log);
+static void ngx_http_detect_tpu_thread_done(ngx_event_t *ev);
+static void *ngx_http_detect_tpu_create_loc_conf(ngx_conf_t *cf);
+static char *ngx_http_detect_tpu_merge_loc_conf(ngx_conf_t *cf, void *parent,
+                                                void *child);
+static ngx_int_t ngx_http_detect_tpu_init(ngx_conf_t *cf);
+
+static ngx_conf_enum_t ngx_http_detect_tpu_modes[] = {
+    { ngx_string("off"), 0 },
+    { ngx_string("monitoring"), 1 },
+    { ngx_string("safe_blocking"), 1 },
+    { ngx_string("block"), 2 },
+    { ngx_null_string, 0 }
+};
+
+static ngx_command_t ngx_http_detect_tpu_commands[] = {
+
+    { ngx_string("detect_tpu"),
+      NGX_HTTP_MAIN_CONF|NGX_HTTP_SRV_CONF|NGX_HTTP_LOC_CONF|NGX_CONF_FLAG,
+      ngx_conf_set_flag_slot,
+      NGX_HTTP_LOC_CONF_OFFSET,
+      offsetof(ngx_http_detect_tpu_loc_conf_t, enabled),
+      NULL },
+
+    { ngx_string("detect_tpu_socket"),
+      NGX_HTTP_MAIN_CONF|NGX_HTTP_SRV_CONF|NGX_HTTP_LOC_CONF|NGX_CONF_TAKE1,
+      ngx_conf_set_str_slot,
+      NGX_HTTP_LOC_CONF_OFFSET,
+      offsetof(ngx_http_detect_tpu_loc_conf_t, socket_path),
+      NULL },
+
+    { ngx_string("detect_tpu_mode"),
+      NGX_HTTP_MAIN_CONF|NGX_HTTP_SRV_CONF|NGX_HTTP_LOC_CONF|NGX_CONF_TAKE1,
+      ngx_conf_set_enum_slot,
+      NGX_HTTP_LOC_CONF_OFFSET,
+      offsetof(ngx_http_detect_tpu_loc_conf_t, mode),
+      &ngx_http_detect_tpu_modes },
+
+    { ngx_string("detect_tpu_timeout_ms"),
+      NGX_HTTP_MAIN_CONF|NGX_HTTP_SRV_CONF|NGX_HTTP_LOC_CONF|NGX_CONF_TAKE1,
+      ngx_conf_set_num_slot,
+      NGX_HTTP_LOC_CONF_OFFSET,
+      offsetof(ngx_http_detect_tpu_loc_conf_t, timeout_ms),
+      NULL },
+
+    { ngx_string("detect_tpu_fail_open"),
+      NGX_HTTP_MAIN_CONF|NGX_HTTP_SRV_CONF|NGX_HTTP_LOC_CONF|NGX_CONF_FLAG,
+      ngx_conf_set_flag_slot,
+      NGX_HTTP_LOC_CONF_OFFSET,
+      offsetof(ngx_http_detect_tpu_loc_conf_t, fail_open),
+      NULL },
+
+    { ngx_string("detect_tpu_tenant"),
+      NGX_HTTP_MAIN_CONF|NGX_HTTP_SRV_CONF|NGX_HTTP_LOC_CONF|NGX_CONF_TAKE1,
+      ngx_conf_set_num_slot,
+      NGX_HTTP_LOC_CONF_OFFSET,
+      offsetof(ngx_http_detect_tpu_loc_conf_t, tenant),
+      NULL },
+
+    { ngx_string("detect_tpu_block_page"),
+      NGX_HTTP_MAIN_CONF|NGX_HTTP_SRV_CONF|NGX_HTTP_LOC_CONF|NGX_CONF_TAKE1,
+      ngx_conf_set_str_slot,
+      NGX_HTTP_LOC_CONF_OFFSET,
+      offsetof(ngx_http_detect_tpu_loc_conf_t, block_page),
+      NULL },
+
+    { ngx_string("detect_tpu_parse_response"),
+      NGX_HTTP_MAIN_CONF|NGX_HTTP_SRV_CONF|NGX_HTTP_LOC_CONF|NGX_CONF_FLAG,
+      ngx_conf_set_flag_slot,
+      NGX_HTTP_LOC_CONF_OFFSET,
+      offsetof(ngx_http_detect_tpu_loc_conf_t, parse_response),
+      NULL },
+
+    { ngx_string("detect_tpu_parse_websocket"),
+      NGX_HTTP_MAIN_CONF|NGX_HTTP_SRV_CONF|NGX_HTTP_LOC_CONF|NGX_CONF_FLAG,
+      ngx_conf_set_flag_slot,
+      NGX_HTTP_LOC_CONF_OFFSET,
+      offsetof(ngx_http_detect_tpu_loc_conf_t, parse_websocket),
+      NULL },
+
+    { ngx_string("detect_tpu_parser_disable"),
+      NGX_HTTP_MAIN_CONF|NGX_HTTP_SRV_CONF|NGX_HTTP_LOC_CONF|NGX_CONF_1MORE,
+      ngx_conf_set_str_array_slot,
+      NGX_HTTP_LOC_CONF_OFFSET,
+      offsetof(ngx_http_detect_tpu_loc_conf_t, parser_disable),
+      NULL },
+
+    { ngx_string("detect_tpu_metrics"),
+      NGX_HTTP_MAIN_CONF|NGX_HTTP_SRV_CONF|NGX_CONF_TAKE1,
+      ngx_conf_set_str_slot,
+      NGX_HTTP_LOC_CONF_OFFSET,
+      offsetof(ngx_http_detect_tpu_loc_conf_t, metrics_addr),
+      NULL },
+
+      ngx_null_command
+};
+
+static ngx_http_module_t ngx_http_detect_tpu_module_ctx = {
+    NULL,                                  /* preconfiguration  */
+    ngx_http_detect_tpu_init,              /* postconfiguration */
+    NULL, NULL,                            /* main conf         */
+    NULL, NULL,                            /* srv conf          */
+    ngx_http_detect_tpu_create_loc_conf,   /* create loc conf   */
+    ngx_http_detect_tpu_merge_loc_conf     /* merge loc conf    */
+};
+
+ngx_module_t ngx_http_detect_tpu_module = {
+    NGX_MODULE_V1,
+    &ngx_http_detect_tpu_module_ctx,
+    ngx_http_detect_tpu_commands,
+    NGX_HTTP_MODULE,
+    NULL, NULL, NULL, NULL, NULL, NULL, NULL,
+    NGX_MODULE_V1_PADDING
+};
+
+/* join request headers as "k: v\x1f k: v" — the wire blob the serve
+ * loop's normalizer splits back into per-header match units */
+static ngx_int_t
+ngx_http_detect_tpu_headers_blob(ngx_http_request_t *r, ngx_str_t *out)
+{
+    size_t            len = 0;
+    ngx_uint_t        i;
+    ngx_list_part_t  *part;
+    ngx_table_elt_t  *h;
+    u_char           *p;
+
+    for (part = &r->headers_in.headers.part; part; part = part->next) {
+        h = part->elts;
+        for (i = 0; i < part->nelts; i++) {
+            len += h[i].key.len + 2 + h[i].value.len + 1;
+        }
+    }
+    if (len == 0) {
+        ngx_str_null(out);
+        return NGX_OK;
+    }
+    p = ngx_pnalloc(r->pool, len);
+    if (p == NULL) {
+        return NGX_ERROR;
+    }
+    out->data = p;
+    for (part = &r->headers_in.headers.part; part; part = part->next) {
+        h = part->elts;
+        for (i = 0; i < part->nelts; i++) {
+            p = ngx_cpymem(p, h[i].key.data, h[i].key.len);
+            *p++ = ':'; *p++ = ' ';
+            p = ngx_cpymem(p, h[i].value.data, h[i].value.len);
+            *p++ = 0x1f;
+        }
+    }
+    out->len = p - out->data - 1;   /* drop the trailing separator */
+    return NGX_OK;
+}
+
+/* flatten the read body chain (memory and file buffers both) into one
+ * contiguous capture for the wire frame */
+static ngx_int_t
+ngx_http_detect_tpu_capture_body(ngx_http_request_t *r, ngx_str_t *out)
+{
+    size_t        len = 0, size;
+    ssize_t       n;
+    u_char       *p;
+    ngx_buf_t    *b;
+    ngx_chain_t  *cl;
+
+    ngx_str_null(out);
+    if (r->request_body == NULL || r->request_body->bufs == NULL) {
+        return NGX_OK;
+    }
+    for (cl = r->request_body->bufs; cl; cl = cl->next) {
+        b = cl->buf;
+        len += b->in_file ? (size_t) (b->file_last - b->file_pos)
+                          : (size_t) (b->last - b->pos);
+    }
+    if (len == 0) {
+        return NGX_OK;
+    }
+    p = ngx_pnalloc(r->pool, len);
+    if (p == NULL) {
+        return NGX_ERROR;
+    }
+    out->data = p;
+    out->len = len;
+    for (cl = r->request_body->bufs; cl; cl = cl->next) {
+        b = cl->buf;
+        if (b->in_file) {
+            size = (size_t) (b->file_last - b->file_pos);
+            n = ngx_read_file(b->file, p, size, b->file_pos);
+            if (n != (ssize_t) size) {
+                return NGX_ERROR;
+            }
+            p += size;
+        } else {
+            p = ngx_cpymem(p, b->pos, b->last - b->pos);
+        }
+    }
+    return NGX_OK;
+}
+
+/* client-body-read continuation: just re-enter the phase walk (the
+ * mirror-module pattern); the handler's second entry does the capture */
+static void
+ngx_http_detect_tpu_body_done(ngx_http_request_t *r)
+{
+    ngx_http_detect_tpu_ctx_t *ctx;
+
+    ctx = ngx_http_get_module_ctx(r, ngx_http_detect_tpu_module);
+    ctx->body_ready = 1;
+    r->preserve_body = 1;
+    r->write_event_handler = ngx_http_core_run_phases;
+    ngx_http_core_run_phases(r);
+}
+
+static void
+ngx_http_detect_tpu_thread_func(void *data, ngx_log_t *log)
+{
+    ngx_http_detect_tpu_ctx_t *ctx = data;
+
+    (void) log;
+    /* blocking round-trip on the pool thread; reads only the ctx */
+    if (detect_tpu_roundtrip((const char *) ctx->socket_path.data,
+                             ctx->timeout_ms,
+                             (uint64_t) (uintptr_t) ctx->request,
+                             ctx->tenant, ctx->mode,
+                             (const char *) ctx->method.data,
+                             ctx->method.len,
+                             (const char *) ctx->uri.data, ctx->uri.len,
+                             (const char *) ctx->headers_blob.data,
+                             ctx->headers_blob.len,
+                             (const char *) ctx->body.data, ctx->body.len,
+                             &ctx->flags, &ctx->score) != NGX_OK)
+    {
+        ctx->flags = DETECT_TPU_FLAG_FAIL_OPEN;
+        ctx->score = 0;
+    }
+}
+
+static void
+ngx_http_detect_tpu_thread_done(ngx_event_t *ev)
+{
+    ngx_http_detect_tpu_ctx_t *ctx = ev->data;
+    ngx_http_request_t        *r = ctx->request;
+
+    r->main->blocked--;
+    r->aio = 0;
+    ctx->done_ev = 1;    /* the sole completion signal; set on the event
+                          * loop so the handler can never observe a
+                          * half-done state from the pool thread */
+    r->write_event_handler = ngx_http_core_run_phases;
+    ngx_http_core_run_phases(r);
+}
+
+static ngx_int_t
+ngx_http_detect_tpu_add_fail_open_header(ngx_http_request_t *r)
+{
+    ngx_table_elt_t *h;
+
+    h = ngx_list_push(&r->headers_out.headers);
+    if (h == NULL) {
+        return NGX_ERROR;
+    }
+    h->hash = 1;
+    ngx_str_set(&h->key, "X-Detect-TPU");
+    ngx_str_set(&h->value, "fail-open");
+    return NGX_OK;
+}
+
+static ngx_int_t
+ngx_http_detect_tpu_handler(ngx_http_request_t *r)
+{
+    ngx_http_detect_tpu_loc_conf_t  *conf;
+    ngx_http_detect_tpu_ctx_t       *ctx;
+    ngx_thread_task_t               *task;
+    ngx_thread_pool_t               *tp;
+    ngx_int_t                        rc;
+    ngx_str_t                        pool_name = ngx_string("detect_tpu");
+
+    conf = ngx_http_get_module_loc_conf(r, ngx_http_detect_tpu_module);
+    if (!conf->enabled || conf->mode == 0) {
+        return NGX_DECLINED;
+    }
+
+    ctx = ngx_http_get_module_ctx(r, ngx_http_detect_tpu_module);
+
+    if (ctx == NULL) {
+        /* entry 1: start the body read, suspend the phase walk */
+        ctx = ngx_pcalloc(r->pool, sizeof(ngx_http_detect_tpu_ctx_t));
+        if (ctx == NULL) {
+            return conf->fail_open ? NGX_DECLINED : NGX_ERROR;
+        }
+        ctx->request = r;
+        ngx_http_set_ctx(r, ctx, ngx_http_detect_tpu_module);
+        rc = ngx_http_read_client_request_body(
+            r, ngx_http_detect_tpu_body_done);
+        if (rc >= NGX_HTTP_SPECIAL_RESPONSE) {
+            return rc;
+        }
+        return NGX_DONE;
+    }
+
+    if (!ctx->task_posted) {
+        if (!ctx->body_ready) {
+            return NGX_AGAIN;   /* body still streaming in */
+        }
+        /* entry 2: capture everything on the event thread, post task */
+        tp = ngx_thread_pool_get((ngx_cycle_t *) ngx_cycle, &pool_name);
+        if (tp == NULL) {
+            /* no `thread_pool detect_tpu` block configured:
+             * fail open rather than block traffic */
+            return conf->fail_open ? NGX_DECLINED
+                                   : NGX_HTTP_SERVICE_UNAVAILABLE;
+        }
+        if (ngx_http_detect_tpu_headers_blob(r, &ctx->headers_blob)
+                != NGX_OK
+            || ngx_http_detect_tpu_capture_body(r, &ctx->body) != NGX_OK)
+        {
+            return conf->fail_open ? NGX_DECLINED : NGX_ERROR;
+        }
+        ctx->method = r->method_name;
+        ctx->uri = r->unparsed_uri;
+        ctx->socket_path = conf->socket_path;
+        ctx->timeout_ms = (double) conf->timeout_ms;
+        ctx->tenant = (uint32_t) conf->tenant;
+        ctx->mode = (uint8_t) conf->mode;
+
+        task = ngx_thread_task_alloc(r->pool, 0);
+        if (task == NULL) {
+            return conf->fail_open ? NGX_DECLINED : NGX_ERROR;
+        }
+        task->ctx = ctx;
+        task->handler = ngx_http_detect_tpu_thread_func;
+        task->event.handler = ngx_http_detect_tpu_thread_done;
+        task->event.data = ctx;
+        if (ngx_thread_task_post(tp, task) != NGX_OK) {
+            return conf->fail_open ? NGX_DECLINED : NGX_ERROR;
+        }
+        ctx->task_posted = 1;
+        r->main->blocked++;
+        r->aio = 1;
+        return NGX_AGAIN;
+    }
+
+    if (!ctx->done_ev) {
+        return NGX_AGAIN;       /* verdict still in flight */
+    }
+
+    /* entry 3: verdict available — apply it (event-loop thread only) */
+    if ((ctx->flags & DETECT_TPU_FLAG_BLOCKED) && conf->mode == 2) {
+        if (conf->block_page.len) {
+            (void) ngx_http_internal_redirect(r, &conf->block_page, NULL);
+            return NGX_DONE;
+        }
+        return NGX_HTTP_FORBIDDEN;
+    }
+    if (ctx->flags & DETECT_TPU_FLAG_FAIL_OPEN) {
+        (void) ngx_http_detect_tpu_add_fail_open_header(r);
+    }
+    return NGX_DECLINED;        /* pass (clean, monitoring, or fail-open) */
+}
+
+static void *
+ngx_http_detect_tpu_create_loc_conf(ngx_conf_t *cf)
+{
+    ngx_http_detect_tpu_loc_conf_t *conf;
+
+    conf = ngx_pcalloc(cf->pool, sizeof(ngx_http_detect_tpu_loc_conf_t));
+    if (conf == NULL) {
+        return NULL;
+    }
+    conf->enabled = NGX_CONF_UNSET;
+    conf->mode = NGX_CONF_UNSET_UINT;
+    conf->timeout_ms = NGX_CONF_UNSET_UINT;
+    conf->fail_open = NGX_CONF_UNSET;
+    conf->tenant = NGX_CONF_UNSET_UINT;
+    conf->parse_response = NGX_CONF_UNSET;
+    conf->parse_websocket = NGX_CONF_UNSET;
+    conf->parser_disable = NGX_CONF_UNSET_PTR;
+    return conf;
+}
+
+static char *
+ngx_http_detect_tpu_merge_loc_conf(ngx_conf_t *cf, void *parent, void *child)
+{
+    ngx_http_detect_tpu_loc_conf_t *prev = parent;
+    ngx_http_detect_tpu_loc_conf_t *conf = child;
+
+    ngx_conf_merge_value(conf->enabled, prev->enabled, 0);
+    ngx_conf_merge_str_value(conf->socket_path, prev->socket_path,
+                             "/run/ipt/detect.sock");
+    ngx_conf_merge_uint_value(conf->mode, prev->mode, 1);
+    ngx_conf_merge_uint_value(conf->timeout_ms, prev->timeout_ms, 30);
+    ngx_conf_merge_value(conf->fail_open, prev->fail_open, 1);
+    ngx_conf_merge_uint_value(conf->tenant, prev->tenant, 0);
+    ngx_conf_merge_str_value(conf->block_page, prev->block_page, "");
+    ngx_conf_merge_value(conf->parse_response, prev->parse_response, 0);
+    ngx_conf_merge_value(conf->parse_websocket, prev->parse_websocket, 0);
+    ngx_conf_merge_ptr_value(conf->parser_disable, prev->parser_disable,
+                             NULL);
+    ngx_conf_merge_str_value(conf->metrics_addr, prev->metrics_addr,
+                             "127.0.0.1:9901");
+    return NGX_CONF_OK;
+}
+
+static ngx_int_t
+ngx_http_detect_tpu_init(ngx_conf_t *cf)
+{
+    ngx_http_handler_pt        *h;
+    ngx_http_core_main_conf_t  *cmcf;
+
+    cmcf = ngx_http_conf_get_module_main_conf(cf, ngx_http_core_module);
+    h = ngx_array_push(&cmcf->phases[NGX_HTTP_ACCESS_PHASE].handlers);
+    if (h == NULL) {
+        return NGX_ERROR;
+    }
+    *h = ngx_http_detect_tpu_handler;
+    return NGX_OK;
+}

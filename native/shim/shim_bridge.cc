@@ -1,0 +1,57 @@
+// C linkage bridge between the nginx module (C) and ipt::DetectClient
+// (C++).  One thread-local client per ngx_thread_pool thread — threads in
+// the "detect_tpu" pool each hold a persistent sidecar connection, so the
+// per-request cost is one framed write + poll, no connect.
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "detect_client.hpp"
+
+namespace {
+
+thread_local std::unique_ptr<ipt::DetectClient> g_client;
+thread_local std::string g_client_path;
+thread_local double g_client_timeout = 0;
+
+ipt::DetectClient* ClientFor(const char* socket_path, double timeout_ms) {
+  // keyed on (path, timeout): per-location detect_tpu_timeout_ms values
+  // must not inherit whichever deadline this thread saw first
+  if (!g_client || g_client_path != socket_path ||
+      g_client_timeout != timeout_ms) {
+    g_client_path = socket_path;
+    g_client_timeout = timeout_ms;
+    g_client = std::make_unique<ipt::DetectClient>(g_client_path, timeout_ms);
+  }
+  return g_client.get();
+}
+
+}  // namespace
+
+extern "C" int detect_tpu_roundtrip(
+    const char* socket_path, double timeout_ms, uint64_t req_id,
+    uint32_t tenant, uint8_t mode, const char* method, size_t method_len,
+    const char* uri, size_t uri_len, const char* headers, size_t headers_len,
+    const char* body, size_t body_len,
+    uint8_t* flags, uint32_t* score) {
+  try {
+    ipt::DetectClient* client = ClientFor(socket_path, timeout_ms);
+    ipt::Request req;
+    req.req_id = req_id;
+    req.tenant = tenant;
+    req.mode = mode;
+    req.method.assign(method ? method : "", method_len);
+    req.uri.assign(uri ? uri : "", uri_len);
+    req.headers_blob.assign(headers ? headers : "", headers_len);
+    req.body.assign(body ? body : "", body_len);
+    ipt::Response r = client->Detect(req);
+    *flags = r.flags;
+    *score = r.score;
+    return 0;  /* NGX_OK */
+  } catch (...) {
+    *flags = 4;  /* fail_open */
+    *score = 0;
+    return 0;    /* fail open is a successful outcome, not an error */
+  }
+}

@@ -1,0 +1,59 @@
+// Self-test driver for DetectClient — exercises the blocking shim path a
+// real nginx worker thread would run: plain verdicts, a streamed body,
+// and the fail-open deadline against a dead socket.  Prints one JSON line
+// per scenario; tests/test_shim.py asserts on them.
+
+#include <stdio.h>
+
+#include <string>
+
+#include "detect_client.hpp"
+
+static void print_verdict(const char* name, const ipt::Response& r) {
+  printf("{\"case\": \"%s\", \"attack\": %s, \"blocked\": %s, "
+         "\"fail_open\": %s, \"n_rules\": %zu}\n",
+         name, r.attack() ? "true" : "false", r.blocked() ? "true" : "false",
+         r.fail_open() ? "true" : "false", r.rule_ids.size());
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: shim_selftest <socket> [dead_socket]\n");
+    return 2;
+  }
+  ipt::DetectClient client(argv[1], /*deadline_ms=*/8000);
+
+  ipt::Request attack;
+  attack.req_id = 1;
+  attack.uri = "/?q=1%20union%20select%20x";
+  attack.headers_blob = "Host: t";
+  print_verdict("attack", client.Detect(attack));
+
+  ipt::Request benign;
+  benign.req_id = 2;
+  benign.uri = "/index.html?page=3";
+  benign.headers_blob = "Host: t";
+  print_verdict("benign", client.Detect(benign));
+
+  // streamed body: attack split across chunk boundaries
+  ipt::Request stream;
+  stream.req_id = 3;
+  stream.uri = "/upload";
+  stream.headers_blob = "Host: t";
+  stream.body = "x=1 uni";
+  if (client.BeginStream(stream) && client.SendChunk(3, "on sel") &&
+      client.SendChunk(3, "ect password from users", /*last=*/true)) {
+    print_verdict("stream", client.FinishStream(3));
+  } else {
+    printf("{\"case\": \"stream\", \"error\": true}\n");
+  }
+
+  if (argc > 2) {
+    ipt::DetectClient dead(argv[2], /*deadline_ms=*/100);
+    ipt::Request r;
+    r.req_id = 4;
+    r.uri = "/?q=<script>";
+    print_verdict("dead_socket", dead.Detect(r));
+  }
+  return 0;
+}

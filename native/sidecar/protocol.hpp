@@ -172,7 +172,7 @@ class MultiFrameReader {
   // Throws on a protocol violation (unknown magic / bad length).
   template <typename Cb>
   void Feed(const uint8_t* data, size_t n, Cb cb) {
-    buf_.insert(buf_.end(), data, data + n);
+    if (n) buf_.insert(buf_.end(), data, data + n);
     size_t off = 0;
     while (buf_.size() - off >= 8) {
       const Kind* k = nullptr;
