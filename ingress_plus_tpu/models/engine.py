@@ -31,7 +31,7 @@ import numpy as np
 
 from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, N_SV
 from ingress_plus_tpu.compiler.seclang import CLASSES
-from ingress_plus_tpu.ops.scan import ScanTables, scan_bytes
+from ingress_plus_tpu.ops.scan import ScanTables, scan_bytes, scan_pairs
 
 
 @jax.tree_util.register_pytree_node_class
@@ -94,7 +94,16 @@ def detect_rows(
     match: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """The full detection step (jit this with static num_requests)."""
-    match_words, state = scan_bytes(tables.scan, tokens, lengths, state, match)
+    if tables.scan.pair_reach is not None and state is None:
+        # class-pair stride: half the steps, one reach gather per two
+        # bytes (ops/scan.py scan_pairs) — the request path only consumes
+        # the match mask, so the pair path's zero-state-after-padding
+        # contract is fine here; explicit carries use the byte path
+        match_words, state = scan_pairs(
+            tables.scan, tokens, lengths, None, match)
+    else:
+        match_words, state = scan_bytes(
+            tables.scan, tokens, lengths, state, match)
 
     # factor hits: gather each factor's word, test its bit     (B, F)
     mw = jnp.take(match_words, tables.factor_word, axis=1)

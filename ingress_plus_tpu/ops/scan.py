@@ -47,28 +47,70 @@ class ScanTables:
     byte_planes: jax.Array  # (256, 4W) bfloat16 — plane-major [b0|b1|b2|b3]
     init_mask: jax.Array    # (W,) uint32
     final_mask: jax.Array   # (W,) uint32
+    # ---- byte-class compression (Hyperscan-style): the 256 byte rows
+    # collapse to k distinct classes (k≈75 on the CRS corpus).  Class
+    # index k is the reserved DEAD class (all-zero reach) used as padding,
+    # which makes per-step validity masks unnecessary: once a row runs
+    # into padding its state dies and its match mask is stable.
+    byte_class: Optional[jax.Array] = None   # (257,) int32: byte→class,
+                                             #   [256] = dead class k
+    class_table: Optional[jax.Array] = None  # (k+1, W) uint32
+    # ---- class-pair stride (one W-word gather per TWO bytes):
+    #   S2 = ((S<<2) | (I<<1) | I) & R'[c1,c2]
+    #   R'[c1,c2] = ((T[c1]<<1) | I) & T[c2]
+    # (exact: expanding ((S<<2)|(I<<1)|I) & ((T1<<1)|I) & T2 reproduces
+    # the two-step shift-and because every cross term is absorbed by the
+    # unconditional I coverage of initial states).  Odd-position match
+    # ends are collected via FA[c1] = T[c1] & final.
+    pair_reach: Optional[jax.Array] = None   # ((k+1)^2, W) uint32
+    pair_final: Optional[jax.Array] = None   # (k+1, W) uint32: T[c] & F
 
     @classmethod
-    def from_bitap(cls, t: BitapTables) -> "ScanTables":
+    def from_bitap(cls, t: BitapTables, classes: bool = True
+                   ) -> "ScanTables":
         bt = t.byte_table.astype(np.uint32)
         planes = np.concatenate(
             [((bt >> (8 * k)) & 0xFF).astype(np.float32) for k in range(4)],
             axis=1,
         )
-        return cls(
+        fields = dict(
             byte_table=jnp.asarray(bt),
             byte_planes=jnp.asarray(planes, dtype=jnp.bfloat16),
             init_mask=jnp.asarray(t.init_mask, dtype=jnp.uint32),
             final_mask=jnp.asarray(t.final_mask, dtype=jnp.uint32),
         )
+        if classes:
+            uniq, inv = np.unique(bt, axis=0, return_inverse=True)
+            inv = inv.ravel()  # numpy <2.0 returns (256, 1) with axis=0
+            k = uniq.shape[0]
+            T = np.vstack([uniq, np.zeros((1, bt.shape[1]), np.uint32)])
+            byte_class = np.concatenate(
+                [inv.astype(np.int32), np.asarray([k], np.int32)])
+            init = t.init_mask.astype(np.uint32)[None, None, :]
+            pair = ((T[:, None, :] << np.uint32(1)) | init) & T[None, :, :]
+            fields.update(
+                byte_class=jnp.asarray(byte_class),
+                class_table=jnp.asarray(T),
+                pair_reach=jnp.asarray(
+                    pair.reshape((k + 1) * (k + 1), -1)),
+                pair_final=jnp.asarray(
+                    T & t.final_mask.astype(np.uint32)[None, :]),
+            )
+        return cls(**fields)
 
     @property
     def n_words(self) -> int:
         return self.byte_table.shape[1]
 
+    @property
+    def n_classes(self) -> int:
+        """Real classes (excluding the dead padding class)."""
+        return self.class_table.shape[0] - 1
+
     def tree_flatten(self):
         return (self.byte_table, self.byte_planes, self.init_mask,
-                self.final_mask), None
+                self.final_mask, self.byte_class, self.class_table,
+                self.pair_reach, self.pair_final), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -148,6 +190,68 @@ def scan_bytes(
 def scan_bytes_jit(tables, tokens, lengths, state=None, match=None,
                    unroll: int = 8, gather: str = "auto"):
     return scan_bytes(tables, tokens, lengths, state, match, unroll, gather)
+
+
+def scan_pairs(
+    tables: ScanTables,
+    tokens: jax.Array,   # (B, L) int32/uint8, L even
+    lengths: jax.Array,  # (B,) int32
+    state: Optional[jax.Array] = None,
+    match: Optional[jax.Array] = None,
+    unroll: int = 8,
+) -> Tuple[jax.Array, jax.Array]:
+    """Class-pair-stride scan: L/2 steps, ONE (B, W) reach gather per TWO
+    bytes (see ScanTables.pair_reach for the folded recurrence) plus one
+    small (B, W) gather for odd-position match ends.  Returns the same
+    (match, state) as ``scan_bytes``, with one contract difference: rows
+    shorter than L are padded with the DEAD class, so their returned
+    ``state`` is zero, not the state after ``length`` bytes — use this
+    path for request scans (only ``match`` is consumed) and equal-length
+    chunk waves, NOT for carrying state across ragged streaming chunks.
+    """
+    B, L = tokens.shape
+    if L % 2:
+        raise ValueError("scan_pairs needs even L (pad_rows rounds to 128)")
+    W = tables.n_words
+    if state is None:
+        state = jnp.zeros((B, W), dtype=jnp.uint32)
+    if match is None:
+        match = jnp.zeros((B, W), dtype=jnp.uint32)
+    k1 = tables.class_table.shape[0]  # k + 1 (dead class last)
+
+    # byte → class, with padding mapped to the dead class (reach 0): the
+    # scan needs no per-step validity selects at all
+    toks = jnp.where(
+        jnp.arange(L, dtype=jnp.int32)[None, :] < lengths.astype(jnp.int32)[:, None],
+        tokens.astype(jnp.int32), jnp.int32(256))
+    cls = jnp.take(tables.byte_class, toks, axis=0)       # (B, L)
+    c1 = jnp.transpose(cls[:, 0::2])                      # (L/2, B)
+    c2 = jnp.transpose(cls[:, 1::2])
+    pair_idx = c1 * jnp.int32(k1) + c2
+
+    I = tables.init_mask[None, :]
+    IOR = (I << jnp.uint32(1)) | I
+    final = tables.final_mask[None, :]
+
+    def step(carry, xs):
+        S, M = carry
+        pidx, cc1 = xs
+        R = jnp.take(tables.pair_reach, pidx, axis=0)     # (B, W)
+        FA1 = jnp.take(tables.pair_final, cc1, axis=0)    # (B, W)
+        M = M | (((S << jnp.uint32(1)) | I) & FA1)        # ends at byte 1
+        S = ((S << jnp.uint32(2)) | IOR) & R
+        M = M | (S & final)                               # ends at byte 2
+        return (S, M), None
+
+    (state, match), _ = jax.lax.scan(
+        step, (state, match), (pair_idx, c1), unroll=unroll)
+    return match, state
+
+
+@functools.partial(jax.jit, static_argnames=("unroll",))
+def scan_pairs_jit(tables, tokens, lengths, state=None, match=None,
+                   unroll: int = 8):
+    return scan_pairs(tables, tokens, lengths, state, match, unroll)
 
 
 def scan_bytes_reference(tables: ScanTables, data: bytes) -> np.ndarray:

@@ -66,6 +66,45 @@ def bench_scan(tables: ScanTables, batch: int, length: int, gather: str,
     return batch * length / per_scan / 1e6
 
 
+def bench_pairs(tables: ScanTables, batch: int, length: int,
+                iters: int = 65, unroll: int = 16) -> float:
+    """MB/s for the class-pair-stride scan (ops/scan.py scan_pairs),
+    K-diff timed like bench_scan."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from ingress_plus_tpu.ops.scan import scan_pairs
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def scan_k(key, k):
+        tokens = jax.random.randint(key, (batch, length), 32, 127,
+                                    dtype=jnp.int32)
+        lengths = jnp.full((batch,), length, dtype=jnp.int32)
+
+        def body(i, carry):
+            s, m = carry
+            m, s = scan_pairs(tables, tokens, lengths, state=s, match=m,
+                              unroll=unroll)
+            return (s, m)
+
+        s = jnp.zeros((batch, tables.n_words), jnp.uint32)
+        m = jnp.zeros((batch, tables.n_words), jnp.uint32)
+        s, m = jax.lax.fori_loop(0, k, body, (s, m))
+        return m.sum()
+
+    def timed(k: int) -> float:
+        key = jax.random.PRNGKey(k)
+        scan_k(key, k).block_until_ready()  # compile
+        t0 = time.time()
+        scan_k(key, k).block_until_ready()
+        return time.time() - t0
+
+    t1, tk = timed(1), timed(iters)
+    per = (tk - t1) / (iters - 1)
+    return batch * length / per / 1e6
+
+
 def bench_pallas(tables: ScanTables, batch: int, length: int,
                  iters: int = 65, TB: int = 8, CL: int = 128,
                  MR: int = 256) -> float:
@@ -119,7 +158,7 @@ def main() -> None:
     ap.add_argument("--len", dest="length", type=int, default=1024)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--only", default=None,
-                    choices=[None, "take", "onehot", "pallas"])
+                    choices=[None, "take", "onehot", "pallas", "pair"])
     ap.add_argument("--tb", type=int, default=8)
     ap.add_argument("--cl", type=int, default=128)
     args = ap.parse_args()
@@ -128,7 +167,7 @@ def main() -> None:
     tables = ScanTables.from_bitap(cr.tables)
     print("backend=%s  W=%d words  rules=%d" % (
         jax.default_backend(), tables.n_words, cr.n_rules))
-    for gather in ("take", "onehot", "pallas"):
+    for gather in ("take", "onehot", "pallas", "pair"):
         if args.only and gather != args.only:
             continue
         for batch in (args.batch, args.batch * 4):
@@ -136,6 +175,9 @@ def main() -> None:
                 if gather == "pallas":
                     mbs = bench_pallas(tables, batch, args.length,
                                        args.iters, TB=args.tb, CL=args.cl)
+                elif gather == "pair":
+                    mbs = bench_pairs(tables, batch, args.length,
+                                      args.iters)
                 else:
                     mbs = bench_scan(tables, batch, args.length, gather,
                                      args.iters)
