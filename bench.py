@@ -19,7 +19,9 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -29,7 +31,57 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def probe_backend(timeouts=(120, 60)):
+    """Decide which backend to use WITHOUT risking the parent process.
+
+    Round-1 failure modes of the axon (remote-TPU-tunnel) backend, both
+    observed: fail fast with UNAVAILABLE at the first dispatch (BENCH_r01
+    rc=1), and hang indefinitely during client init (MULTICHIP_r01
+    rc=124).  An in-process try can't recover from the hang, so the probe
+    runs ``jax.devices()`` in a THROWAWAY SUBPROCESS under a hard timeout;
+    the parent only initializes a backend after the verdict is known.
+
+    Returns (platform, error_string_or_None) and, on TPU failure, forces
+    the parent's platform to CPU so the bench still produces a number.
+    """
+    import subprocess
+
+    last_err = "unknown"
+    for attempt, tmo in enumerate(timeouts):
+        if attempt:
+            log("TPU probe retry %d/%d (last: %s)"
+                % (attempt, len(timeouts) - 1, last_err[:200]))
+            time.sleep(5)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); "
+                 "print('PLATFORM=' + d[0].platform)"],
+                capture_output=True, text=True, timeout=tmo)
+        except subprocess.TimeoutExpired:
+            last_err = "backend init hung >%ds" % tmo
+            continue
+        out = proc.stdout.strip().splitlines()
+        plat = next((l.split("=", 1)[1] for l in out
+                     if l.startswith("PLATFORM=")), None)
+        if proc.returncode == 0 and plat:
+            if plat == "cpu":
+                return "cpu", None  # no TPU plugin on this machine at all
+            log("TPU probe ok (%s, %.0fs timeout headroom)" % (plat, tmo))
+            return plat, None
+        last_err = (proc.stderr.strip().splitlines() or ["rc=%d" % proc.returncode])[-1]
+    log("TPU backend unavailable; falling back to CPU (last: %s)" % last_err[:300])
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+    return "cpu", "tpu-unavailable: %s" % last_err[:300]
+
+
+def run_bench(force_cpu_err: str | None = None) -> dict:
+    """Measure and return the result dict.  ``force_cpu_err`` non-None
+    means a prior attempt failed at dispatch time despite a good probe
+    (the BENCH_r01 fail-fast mode): skip the probe, pin CPU, and carry
+    the error note into the result."""
     import jax
     import jax.numpy as jnp
 
@@ -40,10 +92,23 @@ def main() -> None:
     from ingress_plus_tpu.ops.scan import pad_rows, scan_bytes
     from ingress_plus_tpu.serve.normalize import merge_rows, rows_for_requests
     from ingress_plus_tpu.utils.corpus import generate_corpus
+    from ingress_plus_tpu.utils.microbench import best_time, k_diff_time
 
     quick = "--quick" in sys.argv
     n_req = 256 if quick else 2048
     iters = 129 if quick else 65  # small batches need more reps for signal
+
+    global _PLATFORM_USED
+    if force_cpu_err is not None:
+        from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices(1)
+        platform, backend_err = "cpu", force_cpu_err
+    else:
+        platform, backend_err = probe_backend()
+    _PLATFORM_USED = platform
+    _arm_watchdog()  # probe can eat ~3min of the budget; restart the clock
+    log("platform: %s%s" % (platform, " (fallback: %s)" % backend_err if backend_err else ""))
 
     t0 = time.time()
     cr = compile_ruleset(load_bundled_rules())
@@ -116,13 +181,7 @@ def main() -> None:
         return acc
 
     def timed(k: int) -> float:
-        jax.block_until_ready(detect_k(k))
-        best = float("inf")
-        for _ in range(3):
-            t1 = time.perf_counter()
-            jax.block_until_ready(detect_k(k))
-            best = min(best, time.perf_counter() - t1)
-        return best
+        return best_time(lambda kk, rep: detect_k(kk), k, n=3)
 
     log("backend: %s, devices: %s" % (jax.default_backend(), jax.devices()))
     d_lo, d_hi = timed(1), timed(iters)
@@ -136,6 +195,54 @@ def main() -> None:
     log("per-batch %.2f ms -> %.0f req/s/chip, %.0f MB/s scanned"
         % (per_batch * 1e3, reqs_per_s, mb_per_s))
 
+    # Headline is measured: stash it so the watchdog emits THIS (not the
+    # zero fallback) if the remaining diagnostics overrun the deadline.
+    global _HEADLINE
+    result = {
+        "metric": "req/s/chip, full CRS-v3-shaped ruleset (%s detect step, %d-req corpus)"
+                  % (platform, n_req),
+        "value": round(reqs_per_s, 1),
+        "unit": "req/s/chip",
+        "vs_baseline": round(reqs_per_s / 100_000.0, 4),
+        "platform": platform,
+    }
+    if backend_err:
+        result["error"] = backend_err
+    _HEADLINE = result
+
+    # per-bucket MB/s diagnostics (stderr only; never fatal)
+    try:
+        k_diag = 33
+        for (tok, lens, rreq, rsv) in device_buckets:
+            nrows, edge = tok.shape
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def one_bucket_k(k, tok=tok, lens=lens, rreq=rreq, rsv=rsv):
+                W = cr.tables.n_words
+
+                def body(i, carry):
+                    acc, state, match = carry
+                    rh, ch, sc, match, state = detect_rows(
+                        tables, tok, lens, rreq, rsv,
+                        num_requests=n_req, state=state, match=match)
+                    return (acc + match.sum() + rh.sum().astype(jnp.uint32),
+                            state, match)
+
+                z = jnp.zeros((tok.shape[0], W), jnp.uint32)
+                acc, _, _ = jax.lax.fori_loop(
+                    0, k, body, (jnp.zeros((), jnp.uint32), z, z))
+                return acc
+
+            dt = k_diff_time(lambda k, rep: one_bucket_k(k), k_diag)
+            if dt <= 0:
+                log("bucket %5dB x %4d rows: no signal (K-diff <= 0,"
+                    " jitter > compute)" % (edge, nrows))
+            else:
+                log("bucket %5dB x %4d rows: %7.2f us/batch, %8.1f MB/s"
+                    % (edge, nrows, dt * 1e6, nrows * edge / dt / 1e6))
+    except Exception as e:
+        log("per-bucket diagnostics failed (non-fatal): %r" % (e,))
+
     # quality cross-check on a sample (full pipeline incl. confirm, CPU)
     sample = corpus[:128]
     verdicts = pipeline.detect([lr.request for lr in sample])
@@ -143,13 +250,99 @@ def main() -> None:
     fn = sum(1 for lr, v in zip(sample, verdicts) if lr.is_attack and not v.attack)
     fp = sum(1 for lr, v in zip(sample, verdicts) if not lr.is_attack and v.attack)
     log("quality sample (128 req): tp=%d fn=%d fp=%d" % (tp, fn, fp))
+    return result
 
-    print(json.dumps({
-        "metric": "req/s/chip, full CRS-v3-shaped ruleset (TPU detect step, %d-req corpus)" % n_req,
-        "value": round(reqs_per_s, 1),
+
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+_PLATFORM_USED = None
+_HEADLINE = None  # measured result stashed before the diagnostics tail
+_WATCHDOG_TIMER = None
+_WATCHDOG_BUDGET = float(os.environ.get("BENCH_WATCHDOG_S", "540"))
+
+
+def emit(result: dict) -> None:
+    """Print the ONE JSON line, exactly once (the watchdog thread and the
+    normal path can race at the deadline boundary)."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return
+        _EMITTED = True
+        print(json.dumps(result), flush=True)
+
+
+def _watchdog_fire() -> None:
+    if _HEADLINE is not None:
+        result = dict(_HEADLINE)
+        result["note"] = ("watchdog fired during post-measurement"
+                         " diagnostics; headline value is complete")
+        emit(result)
+    else:
+        emit(_fallback_result(
+            "watchdog: bench exceeded %.0fs (likely hung backend init/"
+            "dispatch after a successful probe)" % _WATCHDOG_BUDGET))
+    sys.stderr.flush()
+    os._exit(3)
+
+
+def _arm_watchdog() -> None:
+    """(Re)start the deadline clock.  Re-armed after the probe so its
+    worst case (~3min of subprocess timeouts) doesn't eat the budget of
+    a healthy fallback measurement."""
+    global _WATCHDOG_TIMER
+    if _WATCHDOG_TIMER is not None:
+        _WATCHDOG_TIMER.cancel()
+    _WATCHDOG_TIMER = threading.Timer(_WATCHDOG_BUDGET, _watchdog_fire)
+    _WATCHDOG_TIMER.daemon = True
+    _WATCHDOG_TIMER.start()
+
+
+def _fallback_result(err: str) -> dict:
+    return {
+        "metric": "req/s/chip, full CRS-v3-shaped ruleset",
+        "value": 0.0,
         "unit": "req/s/chip",
-        "vs_baseline": round(reqs_per_s / 100_000.0, 4),
-    }))
+        "vs_baseline": 0.0,
+        "error": err[:400],
+    }
+
+
+def main() -> None:
+    """Driver contract: stdout carries exactly ONE JSON line, always —
+    even if the TPU tunnel is down, the bench throws, or (the case
+    try/except can't catch) the parent's own backend init hangs after a
+    successful probe.  A watchdog thread covers the hang: at the deadline
+    it emits the fallback line and hard-exits.  A TPU run that passes the
+    probe but dies at dispatch (BENCH_r01's fail-fast mode) is retried
+    once on CPU so the bench still produces a real number."""
+    import traceback
+
+    _arm_watchdog()
+    try:
+        result = run_bench()
+    except BaseException as e:  # noqa: BLE001 — the JSON line must survive
+        traceback.print_exc(file=sys.stderr)
+        err = "%s: %s" % (type(e).__name__, str(e)[:300])
+        result = None
+        if _HEADLINE is not None:  # died in the diagnostics tail only
+            result = dict(_HEADLINE)
+            result["note"] = "post-measurement diagnostics failed: " + err
+        elif _PLATFORM_USED not in (None, "cpu") and isinstance(e, Exception):
+            log("TPU run failed at dispatch despite good probe; retrying on CPU")
+            try:
+                import jax.extend.backend
+
+                jax.extend.backend.clear_backends()
+                result = run_bench(force_cpu_err="tpu-dispatch-failed: " + err)
+            except BaseException as e2:  # noqa: BLE001
+                traceback.print_exc(file=sys.stderr)
+                err += " | cpu-retry: %s: %s" % (type(e2).__name__, str(e2)[:200])
+        if result is None:
+            result = _fallback_result(err)
+    if _WATCHDOG_TIMER is not None:
+        _WATCHDOG_TIMER.cancel()
+    emit(result)
 
 
 if __name__ == "__main__":

@@ -20,6 +20,31 @@ from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
 from ingress_plus_tpu.ops.scan import ScanTables, scan_bytes_jit
 
 
+def best_time(call, k: int, n: int = 2) -> float:
+    """Best-of-n wall time of ``call(k, rep)`` after warming its compile.
+
+    The canonical tunnel-aware timing primitive (bench.py and every
+    bench_* below share THIS copy).  ``rep`` increments per invocation so
+    callers can bust the relay's repeated-dispatch cache with fresh PRNG
+    keys; best-of because one jittery ~70ms RTT otherwise skews (or even
+    negates) a K-difference built from single samples."""
+    jax.block_until_ready(call(k, 0))  # warm the compile
+    best = float("inf")
+    for i in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(call(k, 1 + i))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def k_diff_time(call, k: int, n: int = 2) -> float:
+    """Per-iteration K-difference (t(K=k) - t(K=1)) / (k-1), built on
+    best_time.  May legitimately return <= 0 when RTT jitter swamps the
+    compute delta — callers must treat that as NO SIGNAL (widen K or skip
+    the report), never as a throughput."""
+    return (best_time(call, k, n) - best_time(call, 1, n)) / (k - 1)
+
+
 def bench_scan(tables: ScanTables, batch: int, length: int, gather: str,
                iters: int = 65, unroll: int = 16) -> float:
     """Returns MB/s, measured as the K-scan in-dispatch difference.
@@ -53,16 +78,8 @@ def bench_scan(tables: ScanTables, batch: int, length: int, gather: str,
         s, m = jax.lax.fori_loop(0, k, body, (s, jnp.zeros_like(s)))
         return m[0, 0]
 
-    def timed(k: int) -> float:
-        jax.block_until_ready(scan_k(jax.random.PRNGKey(k), k))  # compile
-        best = float("inf")
-        for i in range(2):
-            t0 = time.perf_counter()
-            jax.block_until_ready(scan_k(jax.random.PRNGKey(100 + i), k))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    per_scan = (timed(iters) - timed(1)) / (iters - 1)
+    per_scan = k_diff_time(
+        lambda k, rep: scan_k(jax.random.PRNGKey(100 * k + rep), k), iters)
     return batch * length / per_scan / 1e6
 
 
@@ -93,15 +110,8 @@ def bench_pairs(tables: ScanTables, batch: int, length: int,
         s, m = jax.lax.fori_loop(0, k, body, (s, m))
         return m.sum()
 
-    def timed(k: int) -> float:
-        key = jax.random.PRNGKey(k)
-        scan_k(key, k).block_until_ready()  # compile
-        t0 = time.time()
-        scan_k(key, k).block_until_ready()
-        return time.time() - t0
-
-    t1, tk = timed(1), timed(iters)
-    per = (tk - t1) / (iters - 1)
+    per = k_diff_time(
+        lambda k, rep: scan_k(jax.random.PRNGKey(100 * k + rep), k), iters)
     return batch * length / per / 1e6
 
 
@@ -139,16 +149,8 @@ def bench_pallas(tables: ScanTables, batch: int, length: int,
         s, m = jax.lax.fori_loop(0, k, body, (s, jnp.zeros_like(s)))
         return m[0, 0]
 
-    def timed(k: int) -> float:
-        jax.block_until_ready(scan_k(jax.random.PRNGKey(k), k))
-        best = float("inf")
-        for i in range(2):
-            t0 = time.perf_counter()
-            jax.block_until_ready(scan_k(jax.random.PRNGKey(100 + i), k))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    per_scan = (timed(iters) - timed(1)) / (iters - 1)
+    per_scan = k_diff_time(
+        lambda k, rep: scan_k(jax.random.PRNGKey(100 * k + rep), k), iters)
     return batch * length / per_scan / 1e6
 
 
@@ -161,7 +163,16 @@ def main() -> None:
                     choices=[None, "take", "onehot", "pallas", "pair"])
     ap.add_argument("--tb", type=int, default=8)
     ap.add_argument("--cl", type=int, default=128)
+    ap.add_argument("--platform", default=None, choices=[None, "cpu"],
+                    help="force CPU in-process (JAX_PLATFORMS env alone "
+                         "does not work on this machine — see "
+                         "utils/platform.py)")
     args = ap.parse_args()
+
+    if args.platform == "cpu":
+        from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices(1)
 
     cr = compile_ruleset(load_bundled_rules())
     tables = ScanTables.from_bitap(cr.tables)

@@ -1,0 +1,55 @@
+"""In-process platform forcing for virtual-device CPU runs.
+
+The canonical copy of the recipe that tests/conftest.py, dryrun_multichip
+and bench fallbacks all need (it was hand-rolled in three places in round
+1 and the un-shared copy missed the fix that mattered — MULTICHIP_r01).
+
+Why env vars alone fail on this machine: ``sitecustomize.py`` imports jax
+at interpreter startup (registering the remote-TPU 'axon' plugin), so
+``JAX_PLATFORMS`` is read long before user code runs.  Backends
+initialize lazily though, so rewriting ``XLA_FLAGS`` and updating
+``jax.config`` before the first backend touch still wins.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_devices(n_devices: int = 8) -> None:
+    """Force the CPU platform with ``n_devices`` virtual devices.
+
+    Must be called before any jax backend touch (jax.devices, device_put,
+    jit dispatch...).  Rewrites an existing device-count flag rather than
+    keeping a stale value, so a wrapper-exported XLA_FLAGS with a
+    different count can't silently win.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = "%s=%d" % (_COUNT_FLAG, n_devices)
+    if _COUNT_FLAG in flags:
+        flags = re.sub(re.escape(_COUNT_FLAG) + r"=\d+", want, flags)
+    else:
+        flags = (flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def assert_cpu_devices(n_devices: int) -> None:
+    """Fail loudly (instead of mysteriously later) if the virtual mesh
+    didn't materialize — e.g. a backend was already initialized with
+    different flags before force_cpu_devices ran."""
+    import jax
+
+    devs = jax.devices()
+    if len(devs) != n_devices or devs[0].platform != "cpu":
+        raise RuntimeError(
+            "expected %d virtual CPU devices, got %d x %s. A backend was"
+            " initialized before force_cpu_devices(); rerun in a fresh"
+            " process." % (n_devices, len(devs),
+                           devs[0].platform if devs else "none"))

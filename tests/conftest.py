@@ -2,22 +2,15 @@
 
 This is the kind-cluster analog from SURVEY.md §4: multi-chip sharding
 logic is exercised on a virtual 8-device CPU mesh so CI needs no TPU.
-
-NOTE: env vars alone are NOT enough here.  The machine's
-/root/.axon_site/sitecustomize.py imports jax at interpreter startup
-(registering the remote-TPU 'axon' plugin), so JAX_PLATFORMS is read long
-before pytest loads this file.  Backends initialize lazily though, so
-updating jax.config before the first computation still wins.
+The platform-forcing recipe (and why env vars alone don't work on this
+machine) lives in ingress_plus_tpu/utils/platform.py.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from ingress_plus_tpu.utils.platform import force_cpu_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8)
