@@ -19,6 +19,7 @@ the double-buffered on-device swap with no serve gap.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import urllib.request
@@ -79,7 +80,12 @@ class Exporter:
         delivered = False
         if self.spool_dir is not None:
             try:
-                path = self.spool_dir / "attacks.jsonl"
+                # one spool file per exporter process: the rendered
+                # Deployment mounts a single spool emptyDir into N serve
+                # containers, so a shared attacks.jsonl would interleave
+                # buffered appends and tear lines.  Keyed by pid there is
+                # exactly one writer per file.
+                path = self.spool_dir / ("attacks.%d.jsonl" % os.getpid())
                 with path.open("a") as f:
                     for r in records:
                         f.write(json.dumps(r) + "\n")
@@ -228,21 +234,32 @@ def consolidate_once(spool_dir: str | Path, url: Optional[str] = None,
     out = spool / "consolidated"
     out.mkdir(exist_ok=True)
     n = 0
-    # retry leftovers first, then claim the live spool
-    live = spool / "attacks.jsonl"
-    if live.exists():
-        claimed = spool / ("attacks.%d.sending" % int(time.time() * 1e6))
+    # retry leftovers first, then claim the live spool files (one per
+    # writer process, plus the legacy shared name)
+    seq = 0
+    for live in sorted(spool.glob("attacks*.jsonl")):
+        claimed = spool / ("attacks.%d_%d.sending"
+                           % (int(time.time() * 1e6), seq))
+        seq += 1
         try:
             live.rename(claimed)
         except OSError:
             pass
     for f in sorted(spool.glob("attacks.*.sending")):
         try:
-            records = [json.loads(line)
-                       for line in f.read_text().splitlines() if line]
-        except (OSError, json.JSONDecodeError):
-            f.rename(f.with_suffix(".corrupt"))
-            continue
+            text = f.read_text()
+        except OSError:
+            continue  # transient; retried next cycle
+        # salvage line-by-line: a torn line from a partial append must not
+        # discard the batch's valid records (at-least-once contract)
+        records = []
+        for line in text.splitlines():
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
         if not records:
             f.unlink()
             continue

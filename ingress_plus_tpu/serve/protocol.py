@@ -139,6 +139,10 @@ def encode_response(req_id: int, attack: bool, blocked: bool,
     flags = ((FLAG_ATTACK if attack else 0)
              | (FLAG_BLOCKED if blocked else 0)
              | (FLAG_FAIL_OPEN if fail_open else 0))
+    # wire caps: u8 class count, u16 rule count (clamped, matching the
+    # C++ twin, so the counts can never truncate and desync the decoder)
+    class_ids = class_ids[:255]
+    rule_ids = rule_ids[:65535]
     payload = _RESP_HEAD.pack(req_id, flags, score & 0xFFFFFFFF,
                               len(class_ids), len(rule_ids))
     payload += bytes(class_ids)

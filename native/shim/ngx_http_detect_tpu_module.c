@@ -412,6 +412,12 @@ ngx_http_detect_tpu_handler(ngx_http_request_t *r)
         if (rc >= NGX_HTTP_SPECIAL_RESPONSE) {
             return rc;
         }
+        /* ngx_http_read_client_request_body() did r->main->count++; balance
+         * it immediately (the mirror-module pattern) so the request is
+         * freed and keepalive connections recycle once the normal content
+         * path finalizes.  NGX_DONE alone would pin one refcount per
+         * request forever. */
+        ngx_http_finalize_request(r, NGX_DONE);
         return NGX_DONE;
     }
 
@@ -464,12 +470,21 @@ ngx_http_detect_tpu_handler(ngx_http_request_t *r)
     /* entry 3: verdict available — apply it (event-loop thread only) */
     if ((ctx->flags & DETECT_TPU_FLAG_BLOCKED) && conf->mode == 2) {
         if (conf->block_page.len) {
+            /* the read-body refcount was balanced at entry 1, so the
+             * redirect target's normal content path owns the remaining
+             * count — no extra finalize here */
             (void) ngx_http_internal_redirect(r, &conf->block_page, NULL);
             return NGX_DONE;
         }
         return NGX_HTTP_FORBIDDEN;
     }
     if (ctx->flags & DETECT_TPU_FLAG_FAIL_OPEN) {
+        /* the dominant failure path (sidecar down / deadline miss) arrives
+         * here as a synthesized pass+FAIL_OPEN verdict; an operator who
+         * configured fail-closed must NOT get unscanned traffic forwarded */
+        if (!conf->fail_open) {
+            return NGX_HTTP_SERVICE_UNAVAILABLE;
+        }
         (void) ngx_http_detect_tpu_add_fail_open_header(r);
     }
     return NGX_DECLINED;        /* pass (clean, monitoring, or fail-open) */

@@ -112,15 +112,22 @@ inline std::string EncodeChunk(uint64_t req_id, const std::string& data,
 // fail-open verdicts (deadline exceeded / upstream down — SURVEY.md §5
 // "fail-open contract is load-bearing").
 inline std::string EncodeResponse(const Response& r) {
+  // Wire format caps: u8 class count, u16 rule count.  Clamp (mirroring
+  // protocol.py encode_response) so an oversized vector can never
+  // truncate the counts and desynchronize the decoder's offsets.
+  const size_t n_cls = std::min<size_t>(r.class_ids.size(), 255);
+  const size_t n_rules = std::min<size_t>(r.rule_ids.size(), 65535);
   std::string payload;
-  payload.reserve(16 + r.class_ids.size() + 8 * r.rule_ids.size());
+  payload.reserve(16 + n_cls + 8 * n_rules);
   detail::put<uint64_t>(&payload, r.req_id);
   payload.push_back(static_cast<char>(r.flags));
   detail::put<uint32_t>(&payload, r.score);
-  payload.push_back(static_cast<char>(r.class_ids.size()));
-  detail::put<uint16_t>(&payload, static_cast<uint16_t>(r.rule_ids.size()));
-  for (uint8_t c : r.class_ids) payload.push_back(static_cast<char>(c));
-  for (uint64_t id : r.rule_ids) detail::put<uint64_t>(&payload, id);
+  payload.push_back(static_cast<char>(n_cls));
+  detail::put<uint16_t>(&payload, static_cast<uint16_t>(n_rules));
+  for (size_t i = 0; i < n_cls; ++i)
+    payload.push_back(static_cast<char>(r.class_ids[i]));
+  for (size_t i = 0; i < n_rules; ++i)
+    detail::put<uint64_t>(&payload, r.rule_ids[i]);
   std::string frame;
   frame.reserve(8 + payload.size());
   frame.append(kRespMagic, 4);

@@ -115,3 +115,23 @@ def test_consolidator_cli(tmp_path):
     assert consolidate_once(spool, url="http://127.0.0.1:1/x") == 0
     assert list(spool.glob("attacks.*.sending"))
     assert consolidate_once(spool) == 1                    # retried, kept
+
+
+def test_consolidator_salvages_torn_lines_and_multi_writer(tmp_path):
+    """A torn line from a concurrent partial append must not discard the
+    batch's valid records; per-pid spool files all get claimed."""
+    from ingress_plus_tpu.post.export import consolidate_once
+
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    good = {"first_ts": 1.0, "classes": ["sqli"], "count": 2}
+    with (spool / "attacks.101.jsonl").open("w") as f:
+        f.write(json.dumps(good) + "\n")
+        f.write('{"first_ts": 2.0, "classes": ["x')   # torn mid-append
+    with (spool / "attacks.202.jsonl").open("w") as f:
+        f.write(json.dumps(good) + "\n")
+    assert consolidate_once(spool) == 2                    # both good lines
+    assert not list(spool.glob("attacks*.jsonl"))          # all claimed
+    assert not list(spool.glob("*.sending"))               # all consumed
+    merged = (spool / "consolidated" / "attacks.jsonl").read_text()
+    assert len(merged.splitlines()) == 2

@@ -118,3 +118,34 @@ def test_jit_cache_stable_shapes(tables):
     tokens2, lengths2 = pad_rows([b"/etc/passwd", b"zz"])
     m2, _ = f(st, tokens2, lengths2)  # same shapes → cached executable
     assert np.asarray(m2)[0].any()
+
+
+def test_scan_pairs_match_parity(tables):
+    """scan_pairs is the default request hot path (detect_rows auto-selects
+    it when state is None): pin its match output to scan_bytes on random
+    tokens/lengths — zero/short/odd lengths and a seeded sticky match
+    accumulator included.  (state parity is NOT in the contract for short
+    rows; see the scan_pairs docstring.)"""
+    from ingress_plus_tpu.ops.scan import scan_pairs
+
+    st = ScanTables.from_bitap(tables)
+    rng = random.Random(11)
+    rows = corpus(rng, n=40)
+    # force the interesting length classes: empty, single byte, odd tails
+    rows += [b"", b"u", b"union select"[:11], b"../../etc/passwd"[:7]]
+    tokens, lengths = pad_rows(rows)
+    B, W = tokens.shape[0], st.n_words
+
+    m_bytes, _ = scan_bytes(st, tokens, lengths)
+    m_pairs, _ = scan_pairs(st, tokens, lengths)
+    assert (np.asarray(m_bytes) == np.asarray(m_pairs)).all()
+
+    # seeded sticky accumulator must be OR-preserved identically
+    seed = np.asarray(
+        [[rng.getrandbits(32) for _ in range(W)] for _ in range(B)],
+        dtype=np.uint32)
+    import jax.numpy as jnp
+    m_b2, _ = scan_bytes(st, tokens, lengths, match=jnp.asarray(seed))
+    m_p2, _ = scan_pairs(st, tokens, lengths, match=jnp.asarray(seed))
+    assert (np.asarray(m_b2) == np.asarray(m_p2)).all()
+    assert (np.asarray(m_b2) & seed == seed).all()  # sticky

@@ -147,7 +147,8 @@ def test_exporter_spools_attacks(tmp_path):
     ex = Exporter(q, spool_dir=str(tmp_path), brute=None)
     n = ex.flush_once()
     assert n == 1  # one (tenant, client, class) attack
-    lines = (tmp_path / "attacks.jsonl").read_text().splitlines()
+    [spool_file] = list(tmp_path.glob("attacks.*.jsonl"))  # per-pid file
+    lines = spool_file.read_text().splitlines()
     rec = json.loads(lines[0])
     assert rec["class"] == "sqli" and rec["count"] == 3
     assert ex.flush_once() == 0  # queue empty now
@@ -160,7 +161,8 @@ def test_exporter_brute_included(tmp_path):
     ex = Exporter(q, spool_dir=str(tmp_path),
                   brute=BruteDetector(BruteConfig(threshold=5)))
     assert ex.flush_once() == 1
-    rec = json.loads((tmp_path / "attacks.jsonl").read_text().splitlines()[0])
+    [spool_file] = list(tmp_path.glob("attacks.*.jsonl"))
+    rec = json.loads(spool_file.read_text().splitlines()[0])
     assert rec["class"] == "brute"
 
 

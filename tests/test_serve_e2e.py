@@ -129,12 +129,15 @@ def test_wallarm_status_and_spool(server):
     assert st["attacks"] > 0
     assert st["blocked"] == st["attacks"]
     assert "queue" in st and "export" in st
-    # exporter flushes every 0.5s; attacks.jsonl must appear with records
-    spool_file = server.spool / "attacks.jsonl"
+    # exporter flushes every 0.5s; a per-pid attacks.*.jsonl must appear
+    spool_file = None
     for _ in range(40):
-        if spool_file.exists() and spool_file.read_text().strip():
+        files = sorted(server.spool.glob("attacks*.jsonl"))
+        if files and files[0].read_text().strip():
+            spool_file = files[0]
             break
         time.sleep(0.25)
+    assert spool_file is not None, "spool file never appeared"
     recs = [json.loads(l) for l in spool_file.read_text().splitlines()]
     assert sum(r["count"] for r in recs) > 0
     assert all("class" in r and "client" in r for r in recs)
