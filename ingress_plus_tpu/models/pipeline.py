@@ -63,9 +63,10 @@ class PipelineStats:
 class DetectionPipeline:
     # Fixed length tiers; rows longer than the last tier are TRUNCATED at
     # 16KB in this batched path (stats.truncated_rows counts them).  The
-    # chunked streaming scan (ops/scan state carry + serve/streaming) is
-    # the intended route for giant bodies; until the serve loop routes
-    # them there automatically, the cap is an explicit detection bound.
+    # serve layer never lets an oversized body reach here: Batcher.submit
+    # auto-routes bodies whose (unpacked) size exceeds the last tier
+    # through the StreamEngine's state-carried chunk scan.  Direct
+    # library callers of detect() keep the explicit 16KB bound.
     L_BUCKETS = (64, 128, 256, 512, 2048, 16384)
 
     @staticmethod

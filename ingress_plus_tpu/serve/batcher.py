@@ -20,12 +20,13 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import List, Optional
 
 from ingress_plus_tpu.models.pipeline import DetectionPipeline, Verdict
 from ingress_plus_tpu.serve.normalize import Request
 from ingress_plus_tpu.serve.stream import StreamEngine, StreamState
+from ingress_plus_tpu.serve.unpack import GZIP_MAGIC, unpack_body
 from ingress_plus_tpu.utils.trace import BatchTrace, TraceRing
 
 
@@ -56,6 +57,9 @@ class BatcherStats:
     streams: int = 0
     stream_chunks: int = 0
     stream_bytes: int = 0
+    # non-streamed requests whose body exceeded the batched L tiers and
+    # was auto-routed through the stream engine
+    oversized_rerouted: int = 0
 
     def snapshot(self) -> dict:
         d = self.__dict__.copy()
@@ -68,6 +72,14 @@ class BatcherStats:
 
 
 class Batcher:
+    # bodies longer than the largest batched L tier are auto-routed
+    # through the StreamEngine (state-carried chunk scan): without this a
+    # non-streamed giant body would be scanned only in its first 16KB —
+    # an attacker could simply pad (the reference module scans the whole
+    # buffered body the same way†)
+    OVERSIZE_THRESHOLD = DetectionPipeline.L_BUCKETS[-1]
+    OVERSIZE_CHUNK = 64 << 10
+
     def __init__(
         self,
         pipeline: DetectionPipeline,
@@ -97,6 +109,66 @@ class Batcher:
         self.stats.submitted += 1
         self._q.put(("req", time.perf_counter(), request, fut))
         return fut
+
+    # ------------------------------------------- oversized-body reroute
+    # All probing/unpacking happens on the DISPATCH thread (in _run) —
+    # never on the caller, which is the server's event-loop thread: a
+    # 16MB inflate there would stall every other connection.
+
+    def _reroute_plan(self, request: Request):
+        """None → normal batched path; (body, headers) → feed these bytes
+        through the stream engine instead (no silent 16KB truncation)."""
+        body = request.body
+        if not body:
+            return None
+        if len(body) > self.OVERSIZE_THRESHOLD:
+            return body, request.headers
+        # a small compressed body can inflate past the tier cap (zip-pad
+        # evasion), and extraction segments can push a near-cap body
+        # over; probe the unpacked size only when that's possible — the
+        # probe is bounded just past the cap, so it never materializes a
+        # full 16MB inflate for an in-tier body
+        if (body[:2] == GZIP_MAGIC
+                or "content-encoding" in (k.lower()
+                                          for k in request.headers)
+                or 4 * len(body) + 64 > self.OVERSIZE_THRESHOLD):
+            probe = unpack_body(body, request.headers, request.parsers_off,
+                                max_out=self.OVERSIZE_THRESHOLD + 1)
+            if len(probe) > self.OVERSIZE_THRESHOLD:
+                # reroute the *fully unpacked* bytes (DoS-bounded inflate
+                # + extraction segments — the stream path itself does no
+                # JSON/XML extraction): Content-Encoding must go, or the
+                # stream's sniffer would re-inflate plaintext
+                unpacked = unpack_body(body, request.headers,
+                                       request.parsers_off)
+                plain_headers = {
+                    k: v for k, v in request.headers.items()
+                    if k.lower() != "content-encoding"}
+                return unpacked, plain_headers
+        return None
+
+    def _detect_oversized(self, request: Request, plan,
+                          fut: "Future[Verdict]") -> None:
+        """Run one oversized request through the stream engine inline
+        (dispatch thread, under the swap lock — same ownership as
+        _stream_step)."""
+        body, headers = plan
+        self.stats.oversized_rerouted += 1
+        try:
+            meta = replace(request, body=b"", headers=headers)
+            h = self.stream_engine.begin(meta, body_cap=len(body))
+            h.base_hits = self.pipeline.prefilter([meta])[0]
+            for i in range(0, len(body), self.OVERSIZE_CHUNK):
+                self.stream_engine.scan(
+                    h.feed(body[i:i + self.OVERSIZE_CHUNK]))
+            self.stream_engine.scan(h.flush())
+            v = self.stream_engine.finish(h)
+        except Exception:
+            self.pipeline.stats.fail_open += 1
+            v = Verdict(request_id=request.request_id, blocked=False,
+                        attack=False, classes=[], rule_ids=[], score=0,
+                        fail_open=True)
+        _safe_set(fut, v)
 
     # --------------------------------------------- streaming-body API
     # (config #5).  Queue FIFO guarantees begin ≤ chunks ≤ finish order;
@@ -217,7 +289,20 @@ class Batcher:
             engine_us0, confirm_us0 = ps.engine_us, ps.confirm_us
             with self._swap_lock:
                 self._stream_step(begins, chunks, finishes)
-                requests = [r for _, r, _ in reqs]
+                # partition: oversized bodies go through the stream
+                # engine inline; everything else batches as usual
+                normal = []
+                for item in reqs:
+                    _, r, fut = item
+                    try:
+                        plan = self._reroute_plan(r)
+                    except Exception:
+                        plan = None   # fall back to the batched path
+                    if plan is not None:
+                        self._detect_oversized(r, plan, fut)
+                    else:
+                        normal.append(item)
+                requests = [r for _, r, _ in normal]
                 if requests:
                     try:
                         verdicts = self.pipeline.detect(requests)
@@ -228,7 +313,7 @@ class Batcher:
                                     score=0, fail_open=True)
                             for r in requests
                         ]
-                    for (_, _, fut), v in zip(reqs, verdicts):
+                    for (_, _, fut), v in zip(normal, verdicts):
                         _safe_set(fut, v)
             took = time.perf_counter() - t0
             self.stats.batch_us_sum += int(took * 1e6)

@@ -24,6 +24,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from ingress_plus_tpu.compiler.ruleset import SQUASH_BYTES, VARIANTS
 from ingress_plus_tpu.compiler.seclang import STREAMS, STREAM_INDEX
+from ingress_plus_tpu.serve.unpack import unpack_body
 
 _HEX = {ord(c): i for i, c in enumerate("0123456789abcdef")}
 for i, c in enumerate("ABCDEF"):
@@ -150,6 +151,9 @@ class Request:
     request_id: str = ""
     mode: int = 2            # wallarm_mode: 0 off, 1 monitoring, 2 block
                              # (can only weaken the server's global mode)
+    parsers_off: frozenset = frozenset()   # wallarm-parser-disable analog;
+                             # per-location disables also ride the
+                             # x-detect-tpu-parser-disable header
 
     def streams(self) -> Dict[str, bytes]:
         """stream name → base bytes (the 4 scan streams).
@@ -170,7 +174,14 @@ class Request:
             ("%s: %s" % (k, v)).encode("utf-8", "surrogateescape")
             for k, v in self.headers.items()
         )
-        return {"uri": uri, "args": args, "headers": hdr, "body": self.body}
+        # body unpack (gzip/b64/json/xml — SURVEY.md §3.3): the scan AND
+        # the confirm stage both call streams(), so they see identical
+        # unpacked bytes — the prefilter∧confirm contract holds through
+        # every decode step
+        body = self.body
+        if body:
+            body = unpack_body(body, self.headers, self.parsers_off)
+        return {"uri": uri, "args": args, "headers": hdr, "body": body}
 
 
 @dataclass

@@ -12,7 +12,9 @@ Request frame (client → server):
     length  u32  — payload length after this field
     req_id  u64
     tenant  u32
-    mode    u8   — 0 off, 1 monitoring, 2 block
+    mode    u8   — bits 0-1: 0 off, 1 monitoring, 2 block; bit 7:
+                   MODE_STREAM; bits 3-6: parser-disable flags
+                   (PARSER_OFF_BITS — trusted config plane)
     m_len   u8   — method length
     uri_len u32
     hdr_len u32  — headers blob: "key: value\\x1f..." pairs
@@ -71,6 +73,14 @@ FLAG_FAIL_OPEN = 4
 MODE_STREAM = 0x80     # request-frame mode bit: body arrives chunked
 CHUNK_LAST = 1         # chunk-frame flag: final chunk of the stream
 
+# Mode-byte bits 3-6: per-location parser disables (wallarm-parser-disable
+# → detect_tpu_parser_disable).  These ride the TRUSTED config plane
+# (nginx conf → shim → frame), never a client-forwardable header — a
+# client-supplied header could otherwise switch the unpack stage off and
+# walk a gzip/base64-wrapped attack past the scanner.
+PARSER_OFF_BITS = {"gzip": 0x08, "base64": 0x10, "json": 0x20, "xml": 0x40}
+_PARSER_MASK = 0x78
+
 MAX_FRAME = 8 << 20  # 8MB: bounded memory per connection
 
 
@@ -92,6 +102,8 @@ def decode_chunk(payload: bytes) -> Tuple[int, bool, bytes]:
 
 
 def encode_request(req: Request, req_id: int, mode: int = 2) -> bytes:
+    for p in req.parsers_off:
+        mode |= PARSER_OFF_BITS.get(p, 0)
     method = req.method.encode()
     uri = req.uri.encode("utf-8", "surrogateescape")
     hdr = b"\x1f".join(
@@ -128,9 +140,11 @@ def decode_request(payload: bytes) -> Tuple[int, int, Request]:
                 headers[k.decode("utf-8", "surrogateescape")] = \
                     v.decode("utf-8", "surrogateescape")
     body = payload[off:off + body_len]
-    return req_id, mode, Request(method=method, uri=uri, headers=headers,
-                                 body=body, tenant=tenant,
-                                 request_id=str(req_id))
+    parsers_off = frozenset(
+        name for name, bit in PARSER_OFF_BITS.items() if mode & bit)
+    return req_id, mode & ~_PARSER_MASK, Request(
+        method=method, uri=uri, headers=headers, body=body, tenant=tenant,
+        request_id=str(req_id), parsers_off=parsers_off)
 
 
 def encode_response(req_id: int, attack: bool, blocked: bool,

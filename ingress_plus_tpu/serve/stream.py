@@ -57,6 +57,12 @@ from ingress_plus_tpu.serve.normalize import (
     squash,
     url_decode_uni,
 )
+from ingress_plus_tpu.serve.unpack import (
+    GZIP_MAGIC,
+    IncrementalBase64,
+    IncrementalInflate,
+    header_lookup,
+)
 
 # longest suffix that might be an incomplete %-escape: %, %X, %u, %uX..%uXXX
 _URL_TAIL = re.compile(rb"%(?:u[0-9a-fA-F]{0,3}|[0-9a-fA-F])?$")
@@ -118,12 +124,16 @@ class StreamState:
     """Carry for one streaming request.  Touched only by the batcher's
     dispatch thread — no locking."""
 
-    def __init__(self, request: Request, variants: Sequence[Tuple[int, int]],
+    def __init__(self, request: Request,
+                 variants: Sequence[Tuple[int, int, int]],
                  n_words: int, version: str, body_cap: int,
                  scan_cap: int = DEFAULT_SCAN_CAP):
         self.request = request          # body stays b"" (scanned separately)
-        self.variants = list(variants)  # [(variant_id, sv_id), ...]
-        self.norms = [IncrementalVariant(v) for v, _ in self.variants]
+        # [(variant_id, sv_id, src)] — src 0 scans the (inflated) body,
+        # src 1 scans its incremental base64 decode (same sv ids: decoded
+        # base64 is just another normalization of the body stream)
+        self.variants = list(variants)
+        self.norms = [IncrementalVariant(v) for v, _, _ in self.variants]
         self.match = np.zeros((len(self.variants), n_words), np.uint32)
         self.state = np.zeros((len(self.variants), n_words), np.uint32)
         self.version = version          # ruleset fingerprint at begin
@@ -132,35 +142,105 @@ class StreamState:
         self.body_cap = body_cap
         self.scan_cap = scan_cap
         self.body_len = 0
+        self.scanned_len = 0
         self.chunks = 0
         self.truncated = False
         self.aborted = False
         self.error = False
         self.t0 = time.perf_counter()
+        # unpack stage (SURVEY.md §3.3): gzip by Content-Encoding here,
+        # by magic-byte sniff on the first chunk in feed(); base64
+        # opportunistically (the decoder self-deactivates on the first
+        # non-base64 chunk, so non-b64 streams scan zero extra rows).
+        # JSON/XML field extraction is batch-path only — the decompressed
+        # byte stream is scanned as-is here (escape-hidden payloads in
+        # giant streamed JSON are a documented bound).
+        self._parsers_off = request.parsers_off
+        ce = header_lookup(request.headers, "content-encoding").lower()
+        self.inflater: Optional[IncrementalInflate] = None
+        # _sniff_buf holds the first byte(s) until the 2-byte gzip magic
+        # can be decided — attacker-chosen 1-byte chunking must not defeat
+        # the sniff; _sniff_done short-circuits it once decided
+        self._sniff_buf = b""
+        self._sniff_done = "gzip" in self._parsers_off
+        if "gzip" not in self._parsers_off and ce in (
+                "gzip", "x-gzip", "deflate"):
+            self.inflater = IncrementalInflate(
+                raw_deflate_ok=("deflate" in ce), max_total=scan_cap)
+            self._sniff_done = True
+        self.b64: Optional[IncrementalBase64] = (
+            IncrementalBase64() if any(s == 1 for _, _, s in self.variants)
+            else None)
+
+    def _unpack(self, data: bytes) -> bytes:
+        """Raw chunk → scannable base bytes (inflate stage)."""
+        if not self._sniff_done:
+            self._sniff_buf += data
+            if len(self._sniff_buf) < 2:
+                return b""          # hold until the magic is decidable
+            data, self._sniff_buf = self._sniff_buf, b""
+            self._sniff_done = True
+            if data[:2] == GZIP_MAGIC:
+                self.inflater = IncrementalInflate(max_total=self.scan_cap)
+        if self.inflater is None:
+            return data
+        out = self.inflater.feed(data)
+        if self.inflater.error:
+            # corrupt/overrun: scanned prefix stands, rest passes
+            # unscanned → surfaced as truncated/fail-open at finish
+            self.truncated = True
+        return out
 
     def feed(self, data: bytes) -> List[Tuple["StreamState", int, bytes]]:
         """Raw chunk → per-variant scan increments."""
         self.chunks += 1
-        scan_room = self.scan_cap - self.body_len
         self.body_len += len(data)
         room = self.body_cap - len(self.acc)
         if room > 0:
             self.acc += data[:room]
         if len(data) > max(room, 0):
             self.truncated = True
+        base = self._unpack(data)
+        scan_room = self.scan_cap - self.scanned_len
         if scan_room <= 0:
-            if data:
+            if base:
                 self.truncated = True
             return []  # scan bound hit: remaining bytes pass unscanned
-        if len(data) > scan_room:
+        if len(base) > scan_room:
             self.truncated = True
-            data = data[:scan_room]
-        return [(self, vi, inc) for vi in range(len(self.variants))
-                if (inc := self.norms[vi].feed(data))]
+            base = base[:scan_room]
+        self.scanned_len += len(base)
+        b64_inc = self.b64.feed(base) if (self.b64 and base) else b""
+        out = []
+        for vi, (_v, _sv, src) in enumerate(self.variants):
+            inp = base if src == 0 else b64_inc
+            if inp and (inc := self.norms[vi].feed(inp)):
+                out.append((self, vi, inc))
+        return out
 
     def flush(self) -> List[Tuple["StreamState", int, bytes]]:
-        return [(self, vi, inc) for vi in range(len(self.variants))
-                if (inc := self.norms[vi].flush())]
+        held = b""
+        if not self._sniff_done and self._sniff_buf:
+            # stream ended before the magic was decidable: the held
+            # byte(s) are plain body bytes
+            held, self._sniff_buf = self._sniff_buf, b""
+            self._sniff_done = True
+        if self.inflater is not None and not self.inflater.finished:
+            # compressed stream ended without its end marker (corrupt or
+            # cut): only a prefix was scanned — surface at finish
+            self.truncated = True
+        b64_tail = self.b64.flush() if self.b64 is not None else b""
+        out = []
+        for vi, (_v, _sv, src) in enumerate(self.variants):
+            inc = b""
+            if src == 0 and held:
+                inc += self.norms[vi].feed(held)
+            if src == 1 and b64_tail:
+                inc += self.norms[vi].feed(b64_tail)
+            inc += self.norms[vi].flush()
+            if inc:
+                out.append((self, vi, inc))
+        return out
 
 
 class StreamEngine:
@@ -174,13 +254,25 @@ class StreamEngine:
 
     # -------------------------------------------------------- lifecycle
 
-    def begin(self, request: Request) -> StreamState:
+    def begin(self, request: Request,
+              body_cap: Optional[int] = None) -> StreamState:
+        """``body_cap`` overrides the confirm-buffer bound — the batcher's
+        oversized-reroute path already holds the full body in memory, so
+        capping the confirm copy below it would only lose the tail."""
         p = self.pipeline
         si = STREAM_INDEX["body"]
-        variants = [(v, si * len(VARIANTS) + v) for v in range(len(VARIANTS))
-                    if si * len(VARIANTS) + v in p.needed_sv]
+        base = [(v, si * len(VARIANTS) + v, 0) for v in range(len(VARIANTS))
+                if si * len(VARIANTS) + v in p.needed_sv]
+        off = request.parsers_off
+        variants = list(base)
+        if "base64" not in off:
+            # a second row group scanning the incremental base64 decode
+            # of the body; costs nothing unless the body is base64-shaped
+            variants += [(v, sv, 1) for v, sv, _ in base]
         return StreamState(request, variants, p.ruleset.tables.n_words,
-                           p.ruleset.version, self.body_cap)
+                           p.ruleset.version,
+                           body_cap if body_cap is not None
+                           else self.body_cap)
 
     # ------------------------------------------------------------ scan
 
@@ -266,7 +358,7 @@ class StreamEngine:
         R = cr.n_rules
         body_hits = np.zeros((R,), dtype=bool)
         applies_any = np.zeros((R,), dtype=bool)
-        for vi, (v, sv) in enumerate(st.variants):
+        for vi, (_v, sv, _src) in enumerate(st.variants):
             rr = factors_to_rules(bt, matches_to_factors(bt, st.match[vi]))
             applies = cr.rule_sv_mask[:, sv]
             body_hits |= rr & applies
@@ -281,10 +373,14 @@ class StreamEngine:
         hits = p.mask_hits([req], hits[None])
 
         # confirm runs on the accumulated (capped) raw body
+        # parsers_off must carry over: the confirm stage re-unpacks the
+        # accumulated body and must not run a decoder the scan stage had
+        # disabled (the "both stages see identical bytes" contract)
         confirm_req = Request(
             method=req.method, uri=req.uri, headers=req.headers,
             body=bytes(st.acc), tenant=req.tenant,
-            request_id=req.request_id, mode=req.mode)
+            request_id=req.request_id, mode=req.mode,
+            parsers_off=req.parsers_off)
         v = p.finalize([confirm_req], hits, st.t0)[0]
         # scan/confirm caps were hit: the verdict is based on a prefix —
         # surface it the fail-open way (pass-and-flag, never silently)

@@ -1,0 +1,354 @@
+"""Request-body unpacking: gzip/deflate, base64, JSON/XML extraction.
+
+The reference's wallarm module decodes/unpacks bodies in its hot path
+before signature matching (SURVEY.md §3.3 "parse request → decode/unpack
+(url/json/xml/b64/gzip)").  TPU-native equivalent: unpacking is a host
+(CPU) normalize stage — the PP "normalize" stage of SURVEY.md §2.4 — that
+runs BEFORE rows are bucketed for the TPU scan, so the scanner only ever
+sees plaintext.  The same function runs in the confirm stage (via
+``Request.streams()``), keeping the prefilter∧confirm soundness contract:
+both stages look at identical bytes.
+
+Composition rule (bounded, in order):
+
+    raw body ──inflate (gzip/zlib/deflate)──▶ base
+    base     ──JSON field extraction──▶ extra segment (keys + string
+             values, unescaped by the JSON parser — catches \\u003c-style
+             escape hiding)
+    base     ──XML text/attr extraction──▶ extra segment
+    base     ──whole-body base64 decode──▶ extra segment
+
+The scan bytes are ``base`` plus the extra segments joined with 0x1f (the
+unit separator already used for header match units: survives every
+transform chain, matched by no rule, prevents false adjacency).  Segments
+identical to ``base`` are dropped.
+
+Every step is bounded (``max_out``) and failure-tolerant: a truncated
+gzip stream yields its decodable prefix; invalid JSON/XML/base64 yields
+no segment.  Per-location parser disables (the reference's
+``wallarm-parser-disable`` annotation → ``detect_tpu_parser_disable``
+directive) arrive ONLY as the explicit ``parsers_off`` set — on the wire
+they ride trusted mode-byte flag bits (protocol.PARSER_OFF_BITS), never
+a request header, which a client could forge to switch the unpack stage
+off and walk an encoded attack past the scanner.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import json
+import re
+import zlib
+import xml.etree.ElementTree as ET
+from typing import Dict, FrozenSet, Optional
+
+SEP = b"\x1f"
+PARSERS = ("gzip", "base64", "json", "xml")
+
+GZIP_MAGIC = b"\x1f\x8b"
+# matches stream.DEFAULT_SCAN_CAP: the confirm stage must be able to see
+# every byte the scanner saw, so the unpack bound and the scan bound are
+# the same DoS limit (a 16KB zip bomb expands to at most this)
+DEFAULT_MAX_OUT = 16 << 20
+
+
+def header_lookup(headers: Dict[str, str], name: str) -> str:
+    """Case-insensitive single-header lookup (the neutral Request model
+    stores headers as received)."""
+    name = name.lower()
+    for k, v in headers.items():
+        if k.lower() == name:
+            return v
+    return ""
+
+
+def inflate(data: bytes, max_out: int = DEFAULT_MAX_OUT,
+            raw_deflate_ok: bool = False) -> Optional[bytes]:
+    """Bounded gzip/zlib (and optionally raw-deflate) decompression.
+
+    Returns the decodable prefix on truncated/corrupt-tail input (a
+    streamed body capped mid-gzip must still yield its prefix for the
+    confirm stage), or None when the input isn't a compressed stream at
+    all.  ``max_out`` is the zip-bomb guard: output is hard-capped.
+    """
+    wbits_options = [47]          # 32+15: auto-detect gzip or zlib header
+    if raw_deflate_ok:
+        wbits_options.append(-15)  # raw deflate (Content-Encoding: deflate
+                                   # from some servers omits the zlib header)
+    for wbits in wbits_options:
+        out = bytearray()
+        src = data
+        ok = False
+        # multi-member loop: gzip permits concatenated members and
+        # zlib.decompressobj stops at the first end marker — scanning
+        # only member 1 would let gzip(benign)+gzip(attack) through while
+        # the backend's gunzip sees both
+        while src and len(out) < max_out:
+            d = zlib.decompressobj(wbits)
+            try:
+                out += d.decompress(src, max_out - len(out))
+            except zlib.error:
+                break
+            ok = True
+            if not d.eof:
+                break
+            nxt = d.unused_data
+            if len(nxt) >= len(src):   # no progress: corrupt trailer
+                break
+            src = nxt
+        if ok and out:
+            return bytes(out)
+    return None
+
+
+def extract_json(data: bytes, max_out: int = DEFAULT_MAX_OUT
+                 ) -> Optional[bytes]:
+    """All object keys + string values, depth-first, joined with 0x1f.
+
+    The JSON parser unescapes \\uXXXX/\\n/... — this is the step that
+    catches attacks hidden behind JSON string escaping, which no substring
+    scan of the raw body can see."""
+    try:
+        obj = json.loads(data.decode("utf-8", "surrogateescape"))
+    except Exception:
+        return None
+    segs = []
+    total = 0
+    stack = [obj]
+    while stack and total < max_out:
+        o = stack.pop()
+        if isinstance(o, dict):
+            for k, v in o.items():
+                if isinstance(k, str) and k:
+                    segs.append(k)
+                    total += len(k) + 1
+                stack.append(v)
+        elif isinstance(o, list):
+            stack.extend(o)
+        elif isinstance(o, str) and o:
+            segs.append(o)
+            total += len(o) + 1
+    if not segs:
+        return None
+    out = SEP.join(s.encode("utf-8", "surrogateescape") for s in segs)
+    return out[:max_out]
+
+
+def extract_xml(data: bytes, max_out: int = DEFAULT_MAX_OUT
+                ) -> Optional[bytes]:
+    """Text nodes + attribute values of a parseable XML document.
+
+    ElementTree/expat refuses custom entity expansion (and modern expat
+    rate-limits amplification), so this is billion-laughs-safe; input is
+    additionally size-capped by the caller's row bound."""
+    try:
+        root = ET.fromstring(data.decode("utf-8", "surrogateescape"))
+    except Exception:
+        return None
+    segs = []
+    total = 0
+    for el in root.iter():
+        parts = list(el.attrib.values())
+        if el.text:
+            parts.append(el.text)
+        if el.tail:
+            parts.append(el.tail)
+        for p in parts:
+            p = p.strip()
+            if p:
+                segs.append(p)
+                total += len(p) + 1
+        if total >= max_out:
+            break
+    if not segs:
+        return None
+    out = SEP.join(s.encode("utf-8", "surrogateescape") for s in segs)
+    return out[:max_out]
+
+
+# strict base64 shape: charset (std + urlsafe), optional padding, optional
+# interior whitespace; minimum length keeps short plain words from
+# decoding to noise rows
+_B64_RE = re.compile(rb"\A[A-Za-z0-9+/\-_\s]+={0,2}\s*\Z")
+B64_MIN_LEN = 16
+
+
+def decode_base64_like(data: bytes, max_out: int = DEFAULT_MAX_OUT
+                       ) -> Optional[bytes]:
+    """Decode a body that *looks like* one base64 token (the reference
+    module does the same opportunistic unpack†).  None when the shape or
+    decode fails — never raises."""
+    s = data.strip()
+    if len(s) < B64_MIN_LEN or not _B64_RE.match(s):
+        return None
+    compact = re.sub(rb"\s+", b"", s)
+    compact = compact.replace(b"-", b"+").replace(b"_", b"/")
+    compact += b"=" * (-len(compact) % 4)
+    try:
+        dec = base64.b64decode(compact, validate=True)
+    except (binascii.Error, ValueError):
+        return None
+    return dec[:max_out] if dec else None
+
+
+def unpack_body(body: bytes, headers: Dict[str, str],
+                parsers_off: FrozenSet[str] = frozenset(),
+                max_out: int = DEFAULT_MAX_OUT) -> bytes:
+    """The full unpack chain; returns the bytes the body stream scans.
+
+    Identity for plain bodies (no compression, nothing extractable) —
+    benign traffic pays one header lookup and two sniffs."""
+    if not body:
+        return body
+    off = parsers_off
+    ct = header_lookup(headers, "content-type").lower()
+    ce = header_lookup(headers, "content-encoding").lower()
+
+    base = body
+    if "gzip" not in off and (
+            ce in ("gzip", "x-gzip", "deflate") or body[:2] == GZIP_MAGIC):
+        dec = inflate(body, max_out, raw_deflate_ok=("deflate" in ce))
+        if dec is not None:
+            base = dec
+
+    segs = [base]
+    sniff = base.lstrip()[:5]
+    if "json" not in off and ("json" in ct or sniff[:1] in (b"{", b"[")):
+        ext = extract_json(base, max_out)
+        if ext is not None and ext != base:
+            segs.append(ext)
+    if "xml" not in off and ("xml" in ct or sniff == b"<?xml"):
+        ext = extract_xml(base, max_out)
+        if ext is not None and ext != base:
+            segs.append(ext)
+    if "base64" not in off and len(base) <= 4 * max_out:
+        dec = decode_base64_like(base, max_out)
+        if dec is not None:
+            segs.append(dec)
+
+    if len(segs) == 1:
+        return base
+    return SEP.join(segs)
+
+
+class IncrementalInflate:
+    """Streaming gzip/deflate for the chunked-body path: feed() returns
+    the next decompressed increment, bounded by ``max_total``.
+
+    On corrupt input or bound overrun it goes dead (``error`` set) and
+    returns b"" from then on — the stream engine surfaces that via the
+    truncated/fail-open flag, never an exception."""
+
+    def __init__(self, raw_deflate_ok: bool = False,
+                 max_total: int = 16 << 20):
+        self._d = zlib.decompressobj(47)
+        self._raw_fallback = raw_deflate_ok
+        self._first = True
+        self.max_total = max_total
+        self.total = 0
+        self.error = False
+
+    def feed(self, data: bytes) -> bytes:
+        if self.error or not data:
+            return b""
+        out = bytearray()
+        src = data
+        # inner loop handles concatenated gzip members: on eof with bytes
+        # left, start a fresh decompressobj on the remainder (a member
+        # header split across chunks is fine — zlib buffers partial
+        # headers internally)
+        while src:
+            room = self.max_total - self.total
+            if room <= 0:
+                self.error = True
+                break
+            try:
+                chunk = self._d.decompress(src, room)
+            except zlib.error:
+                if self._first and self._raw_fallback:
+                    # some proxies send Content-Encoding: deflate as raw
+                    # deflate (no zlib header): retry the first chunk raw
+                    self._d = zlib.decompressobj(-15)
+                    self._raw_fallback = False
+                    continue
+                self.error = True
+                break
+            self._first = False
+            out += chunk
+            self.total += len(chunk)
+            if self._d.unconsumed_tail:
+                self.error = True   # bound hit mid-chunk
+                break
+            if self._d.eof:
+                nxt = self._d.unused_data
+                if not nxt:
+                    break
+                if len(nxt) >= len(src) and not chunk:
+                    self.error = True   # no progress: corrupt trailer
+                    break
+                self._d = zlib.decompressobj(47)
+                src = nxt
+                continue
+            break
+        return bytes(out)
+
+    @property
+    def finished(self) -> bool:
+        """True iff the compressed stream reached its end marker — an
+        unfinished stream at body end means the scan saw only a prefix."""
+        return self._d.eof and not self.error
+
+
+class IncrementalBase64:
+    """Streaming base64 decode with 4-byte alignment carry.
+
+    Opportunistic like the one-shot path: the first chunk must pass the
+    charset sniff to activate; any later charset violation kills the
+    decoder (``dead``) — its already-scanned output can only ever produce
+    prefilter hits, which the confirm stage (whole-body decode) rejects.
+    """
+
+    _CHARSET = re.compile(rb"\A[A-Za-z0-9+/\-_=\s]*\Z")
+
+    def __init__(self):
+        self._buf = b""
+        self._sniff = b""
+        self.started = False
+        self.dead = False
+
+    def feed(self, data: bytes) -> bytes:
+        if self.dead or not data:
+            return b""
+        if not self._CHARSET.match(data):
+            self.dead = True
+            return b""
+        if not self.started:
+            # accumulate until the sniff threshold — bodies arriving a few
+            # bytes per chunk must still activate
+            self._sniff += data
+            if len(self._sniff.strip()) < B64_MIN_LEN:
+                return b""
+            data, self._sniff = self._sniff, b""
+            self.started = True
+        buf = self._buf + re.sub(
+            rb"\s+", b"", data).replace(b"-", b"+").replace(b"_", b"/")
+        take = len(buf) // 4 * 4
+        self._buf = buf[take:]
+        if not take:
+            return b""
+        try:
+            return base64.b64decode(buf[:take], validate=True)
+        except (binascii.Error, ValueError):
+            self.dead = True
+            return b""
+
+    def flush(self) -> bytes:
+        if self.dead or not self._buf:
+            return b""
+        buf = self._buf + b"=" * (-len(self._buf) % 4)
+        self._buf = b""
+        try:
+            return base64.b64decode(buf, validate=True)
+        except (binascii.Error, ValueError):
+            return b""

@@ -269,6 +269,41 @@ ngx_http_detect_tpu_headers_blob(ngx_http_request_t *r, ngx_str_t *out)
     return NGX_OK;
 }
 
+/* detect_tpu_parser_disable values → request-frame mode-byte flag bits
+ * (protocol.py PARSER_OFF_BITS).  The disables ride the TRUSTED config
+ * plane inside the mode byte — never a request header, which a client
+ * could forge to switch the serve loop's unpack stage off. */
+static uint8_t
+ngx_http_detect_tpu_parser_bits(ngx_array_t *parser_disable)
+{
+    static const struct { const char *name; size_t len; uint8_t bit; }
+    map[] = {
+        { "gzip",   4, 0x08 },
+        { "base64", 6, 0x10 },
+        { "json",   4, 0x20 },
+        { "xml",    3, 0x40 },
+    };
+    uint8_t     bits = 0;
+    ngx_uint_t  i, j;
+    ngx_str_t  *v;
+
+    if (parser_disable == NULL) {
+        return 0;
+    }
+    v = parser_disable->elts;
+    for (i = 0; i < parser_disable->nelts; i++) {
+        for (j = 0; j < sizeof(map) / sizeof(map[0]); j++) {
+            if (v[i].len == map[j].len
+                && ngx_strncasecmp(v[i].data, (u_char *) map[j].name,
+                                   map[j].len) == 0)
+            {
+                bits |= map[j].bit;
+            }
+        }
+    }
+    return bits;
+}
+
 /* flatten the read body chain (memory and file buffers both) into one
  * contiguous capture for the wire frame */
 static ngx_int_t
@@ -444,7 +479,8 @@ ngx_http_detect_tpu_handler(ngx_http_request_t *r)
         ctx->socket_path = conf->socket_path;
         ctx->timeout_ms = (double) conf->timeout_ms;
         ctx->tenant = (uint32_t) conf->tenant;
-        ctx->mode = (uint8_t) conf->mode;
+        ctx->mode = (uint8_t) conf->mode
+                    | ngx_http_detect_tpu_parser_bits(conf->parser_disable);
 
         task = ngx_thread_task_alloc(r->pool, 0);
         if (task == NULL) {

@@ -26,6 +26,13 @@ enum Flags : uint8_t {
 
 // Request-frame mode bit: body arrives as chunk frames (config #5).
 constexpr uint8_t kModeStream = 0x80;
+
+// Mode-byte bits 3-6: per-location parser disables (twin of protocol.py
+// PARSER_OFF_BITS) — trusted config plane, never a client header.
+constexpr uint8_t kParserOffGzip = 0x08;
+constexpr uint8_t kParserOffBase64 = 0x10;
+constexpr uint8_t kParserOffJson = 0x20;
+constexpr uint8_t kParserOffXml = 0x40;
 constexpr uint8_t kChunkLast = 1;
 
 struct Request {

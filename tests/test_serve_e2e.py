@@ -199,6 +199,73 @@ def test_streaming_body_over_wire(server):
     assert not got[6002]["attack"]
 
 
+def test_wrapped_bodies_over_wire(server):
+    """SURVEY.md §3.3 decode/unpack parity on the wire: a gzipped and a
+    base64-wrapped SQLi body must be detected end-to-end; streamed gzip
+    chunks too."""
+    import base64
+    import gzip
+
+    from ingress_plus_tpu.serve.normalize import Request
+    from ingress_plus_tpu.serve.protocol import (
+        MODE_STREAM, RESP_MAGIC, FrameReader, decode_response,
+        encode_chunk, encode_request)
+
+    sqli = b"q=1' UNION SELECT password FROM users--"
+    s = socket.socket(socket.AF_UNIX)
+    s.connect(server)
+    s.settimeout(120)
+    s.sendall(encode_request(
+        Request(method="POST", uri="/api",
+                headers={"Content-Encoding": "gzip"},
+                body=gzip.compress(sqli)), req_id=8001))
+    s.sendall(encode_request(
+        Request(method="POST", uri="/api",
+                body=base64.b64encode(sqli)), req_id=8002))
+    # streamed gzip: the same compressed body split into chunk frames
+    comp = gzip.compress(b"x" * 30000 + sqli + b"y" * 30000)
+    s.sendall(encode_request(
+        Request(method="POST", uri="/up",
+                headers={"Content-Encoding": "gzip"}, body=comp[:1000]),
+        req_id=8003, mode=2 | MODE_STREAM))
+    for i in range(1000, len(comp), 4096):
+        s.sendall(encode_chunk(8003, comp[i:i + 4096]))
+    s.sendall(encode_chunk(8003, b"", last=True))
+    reader, got = FrameReader(RESP_MAGIC), {}
+    while len(got) < 3:
+        for f in reader.feed(s.recv(65536)):
+            r = decode_response(f)
+            got[r["req_id"]] = r
+    s.close()
+    for rid in (8001, 8002, 8003):
+        assert got[rid]["attack"] and got[rid]["blocked"], (rid, got[rid])
+        assert "sqli" in got[rid]["classes"], (rid, got[rid])
+
+
+def test_oversized_body_over_wire(server):
+    """BASELINE config #5 corner: a 1MB padded-prefix attack sent as ONE
+    non-streamed frame must be caught (the serve loop reroutes it through
+    the stream engine internally)."""
+    from ingress_plus_tpu.serve.normalize import Request
+    from ingress_plus_tpu.serve.protocol import (
+        RESP_MAGIC, FrameReader, decode_response, encode_request)
+
+    body = b"P" * (1 << 20) + b" 1' union select password from users --"
+    s = socket.socket(socket.AF_UNIX)
+    s.connect(server)
+    s.settimeout(120)
+    s.sendall(encode_request(
+        Request(method="POST", uri="/upload", body=body), req_id=9001))
+    reader, got = FrameReader(RESP_MAGIC), {}
+    while len(got) < 1:
+        for f in reader.feed(s.recv(65536)):
+            r = decode_response(f)
+            got[r["req_id"]] = r
+    s.close()
+    assert got[9001]["attack"] and got[9001]["blocked"]
+    assert 942100 in got[9001]["rule_ids"]
+
+
 def test_configuration_endpoints_and_dbg(server, tmp_path):
     """Dynamic-config plane: tenant push, ruleset hot-swap (sync-node
     analog), inspection — all through the dbg CLI code path."""

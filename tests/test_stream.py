@@ -199,3 +199,29 @@ def test_batcher_stream_abort_resolves_nothing(batcher):
     # no finish — state must simply be skipped without error
     fut = batcher.submit(Request(uri="/after", request_id="b5"))
     assert not fut.result(timeout=60).attack
+
+
+def test_oversized_body_auto_routed_to_stream(batcher):
+    """A 1MB padded-prefix attack body submitted on the NON-streaming API
+    must be caught (no silent 16KB truncation): Batcher.submit reroutes
+    it through the StreamEngine."""
+    body = b"A" * (1 << 20) + b" 1' union select password from users --"
+    v = batcher.submit(Request(method="POST", uri="/upload", body=body,
+                               request_id="big")).result(timeout=120)
+    assert v.attack and v.blocked and 942100 in v.rule_ids
+    assert batcher.stats.oversized_rerouted == 1
+
+
+def test_small_gzip_bomb_pad_auto_routed(batcher):
+    """A <16KB gzip body inflating to ~1MB with the attack at the end —
+    the zip-pad evasion — must also reroute and be caught."""
+    import gzip
+
+    raw = b"B" * (1 << 20) + b" 1' union select password from users --"
+    comp = gzip.compress(raw)
+    assert len(comp) < 16384
+    v = batcher.submit(Request(method="POST", uri="/upload", body=comp,
+                               headers={"Content-Encoding": "gzip"},
+                               request_id="zip")).result(timeout=120)
+    assert v.attack and v.blocked and 942100 in v.rule_ids
+    assert batcher.stats.oversized_rerouted == 1

@@ -1,0 +1,283 @@
+"""Body unpacking: gzip/deflate, base64, JSON/XML extraction.
+
+Reference parity (SURVEY.md §3.3 "decode/unpack (url/json/xml/b64/gzip)"):
+a wrapped attack body must be detected end-to-end, in both the batched
+and the streaming path, and the incremental decoders must be equivalent
+to their one-shot twins on any chunking.
+"""
+
+import base64
+import gzip
+import json
+import zlib
+
+import pytest
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.normalize import Request
+from ingress_plus_tpu.serve.stream import StreamEngine
+from ingress_plus_tpu.serve.unpack import (
+    IncrementalBase64,
+    IncrementalInflate,
+    decode_base64_like,
+    extract_json,
+    extract_xml,
+    inflate,
+    unpack_body,
+)
+
+SQLI = b"x=1' UNION SELECT password FROM users--"
+XSS = b"<script>alert(document.cookie)</script>"
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    return DetectionPipeline(compile_ruleset(load_bundled_rules()),
+                             mode="block")
+
+
+# ----------------------------------------------------------- unit: codecs
+
+def test_inflate_gzip_and_zlib_and_truncated():
+    data = SQLI * 20
+    assert inflate(gzip.compress(data)) == data
+    assert inflate(zlib.compress(data)) == data
+    # truncated stream yields the decodable prefix, never raises
+    trunc = gzip.compress(data)[:40]
+    out = inflate(trunc)
+    assert out is None or data.startswith(out)
+    assert inflate(b"plain text body") is None
+
+
+def test_inflate_bomb_bounded():
+    bomb = gzip.compress(b"\x00" * (64 << 20))  # 64MB of zeros, ~64KB packed
+    out = inflate(bomb, max_out=1 << 20)
+    assert out is not None and len(out) <= 1 << 20
+
+
+def test_extract_json_unescapes():
+    body = (b'{"comment": "\\u003cscript\\u003ealert(1)\\u003c/script'
+            b'\\u003e", "nested": {"k": ["v1", {"deep": "1\' OR 1=1"}]}}')
+    assert b"<script" not in body   # escape-hidden in the raw bytes
+    out = extract_json(body)
+    assert b"<script>alert(1)" in out
+    assert b"1' OR 1=1" in out
+    assert b"comment" in out and b"deep" in out
+    assert extract_json(b"not json") is None
+
+
+def test_extract_xml():
+    body = (b"<?xml version='1.0'?><root attr=\"' OR 1=1\">"
+            b"<item>&lt;script&gt;</item><item>../../etc/passwd</item>"
+            b"</root>")
+    out = extract_xml(body)
+    assert b"' OR 1=1" in out
+    assert b"../../etc/passwd" in out
+    assert extract_xml(b"<unclosed") is None
+
+
+def test_decode_base64_like():
+    assert decode_base64_like(base64.b64encode(SQLI)) == SQLI
+    # urlsafe + unpadded + whitespace still decode
+    tok = base64.urlsafe_b64encode(SQLI).rstrip(b"=")
+    tok = tok[:10] + b"\n" + tok[10:]
+    assert decode_base64_like(tok) == SQLI
+    assert decode_base64_like(b"short") is None
+    assert decode_base64_like(b"hello world this is text!") is None
+
+
+# ------------------------------------------------------ unit: unpack_body
+
+def test_unpack_body_plain_is_identity():
+    assert unpack_body(b"a=1&b=2", {}) == b"a=1&b=2"
+
+
+def test_unpack_body_gzip_then_json():
+    obj = json.dumps({"q": SQLI.decode()}).encode()
+    out = unpack_body(gzip.compress(obj), {"Content-Encoding": "gzip"})
+    assert SQLI in out          # extracted JSON value
+    assert obj in out           # decompressed base
+
+
+def test_unpack_body_parser_disable():
+    body = base64.b64encode(SQLI)
+    assert SQLI in unpack_body(body, {})
+    assert SQLI not in unpack_body(body, {}, parsers_off=frozenset(["base64"]))
+    # a client-supplied header must NOT be able to disable parsers (that
+    # would be a WAF bypass): disables ride only the explicit set
+    assert SQLI in unpack_body(
+        body, {"x-detect-tpu-parser-disable": "base64 json"})
+
+
+def test_parser_disable_rides_wire_mode_bits_not_headers():
+    """The trusted plumbing: parsers_off survives an encode/decode
+    roundtrip via mode-byte flag bits, and the decoded mode byte is
+    clean of them."""
+    from ingress_plus_tpu.serve.protocol import (
+        decode_request, encode_request)
+    from ingress_plus_tpu.serve.normalize import Request
+
+    frame = encode_request(
+        Request(method="POST", uri="/x", body=b"e30=",
+                parsers_off=frozenset(["base64", "json"])),
+        req_id=5, mode=2)
+    req_id, mode, req = decode_request(frame[8:])
+    assert req_id == 5 and mode == 2
+    assert req.parsers_off == frozenset(["base64", "json"])
+
+
+def test_multi_member_gzip_scanned_past_first_member():
+    """gzip permits concatenated members; scanning only member 1 would
+    let gzip(benign)+gzip(attack) through."""
+    body = gzip.compress(b"benign text") + gzip.compress(SQLI)
+    out = inflate(body)
+    assert b"benign text" in out and SQLI in out
+    # incremental twin, attacker-chosen chunking
+    inc = IncrementalInflate()
+    got = b"".join(inc.feed(body[i:i + 7]) for i in range(0, len(body), 7))
+    assert SQLI in got and not inc.error and inc.finished
+
+
+# -------------------------------------------- incremental ≡ one-shot
+
+@pytest.mark.parametrize("chunk", [1, 3, 7, 64, 1000])
+def test_incremental_inflate_equivalence(chunk):
+    data = (SQLI + b" pad ") * 200
+    comp = gzip.compress(data)
+    inc = IncrementalInflate()
+    got = b"".join(inc.feed(comp[i:i + chunk])
+                   for i in range(0, len(comp), chunk))
+    assert got == data and not inc.error
+
+
+@pytest.mark.parametrize("chunk", [1, 2, 5, 64])
+def test_incremental_base64_equivalence(chunk):
+    data = XSS * 30
+    enc = base64.b64encode(data)
+    inc = IncrementalBase64()
+    got = b"".join(inc.feed(enc[i:i + chunk])
+                   for i in range(0, len(enc), chunk))
+    got += inc.flush()
+    assert got == data
+
+
+def test_incremental_base64_rejects_plain_text():
+    inc = IncrementalBase64()
+    assert inc.feed(b"name=alice&city=berlin paris") == b""
+    assert inc.dead
+
+
+# --------------------------------------------------- detection end-to-end
+
+def test_gzip_wrapped_sqli_detected(pipeline):
+    req = Request(method="POST", uri="/api",
+                  headers={"Content-Encoding": "gzip"},
+                  body=gzip.compress(SQLI))
+    v = pipeline.detect([req])[0]
+    assert v.attack and "sqli" in v.classes
+
+
+def test_gzip_sniffed_without_header(pipeline):
+    v = pipeline.detect([Request(method="POST", uri="/api",
+                                 body=gzip.compress(SQLI))])[0]
+    assert v.attack and "sqli" in v.classes
+
+
+def test_base64_wrapped_sqli_detected(pipeline):
+    v = pipeline.detect([Request(method="POST", uri="/api",
+                                 body=base64.b64encode(SQLI))])[0]
+    assert v.attack and "sqli" in v.classes
+
+
+def test_json_escaped_xss_detected(pipeline):
+    body = (b'{"comment": "\\u003cscript\\u003ealert(document.cookie)'
+            b'\\u003c/script\\u003e"}')
+    assert b"<script" not in body   # raw bytes hide the payload
+    v = pipeline.detect([Request(method="POST", uri="/api", body=body)])[0]
+    assert v.attack and "xss" in v.classes
+
+
+def test_xml_attr_sqli_detected(pipeline):
+    body = (b"<?xml version='1.0'?><q term=\"1' UNION SELECT password "
+            b"FROM users--\"/>")
+    v = pipeline.detect([Request(
+        method="POST", uri="/api",
+        headers={"Content-Type": "application/xml"}, body=body)])[0]
+    assert v.attack and "sqli" in v.classes
+
+
+def test_parser_disable_suppresses_detection(pipeline):
+    req = Request(method="POST", uri="/api", body=base64.b64encode(SQLI),
+                  parsers_off=frozenset(["base64"]))
+    assert not pipeline.detect([req])[0].attack
+
+
+def test_benign_json_still_passes(pipeline):
+    v = pipeline.detect([Request(
+        method="POST", uri="/api/v1/users",
+        headers={"Content-Type": "application/json"},
+        body=json.dumps({"name": "Alice", "bio": "likes SQL courses"})
+        .encode())])[0]
+    assert not v.blocked
+
+
+# ------------------------------------------------------ streaming path
+
+def _stream_verdict(pipeline, req, payload, chunk=1024):
+    eng = StreamEngine(pipeline)
+    st = eng.begin(req)
+    st.base_hits = pipeline.prefilter([req])[0]
+    for i in range(0, len(payload), chunk):
+        eng.scan(st.feed(payload[i:i + chunk]))
+    eng.scan(st.flush())
+    return eng.finish(st)
+
+
+def test_streaming_gzip_body_detected(pipeline):
+    payload = gzip.compress(b"x" * 60000 + SQLI + b"y" * 60000)
+    req = Request(method="POST", uri="/up", body=b"",
+                  headers={"Content-Encoding": "gzip"})
+    v = _stream_verdict(pipeline, req, payload)
+    assert v.attack and "sqli" in v.classes
+
+
+def test_streaming_gzip_sniffed_one_byte_chunks(pipeline):
+    """No Content-Encoding header + 1-byte chunk frames: the magic sniff
+    must still trigger (attacker-chosen chunking must not defeat it)."""
+    payload = gzip.compress(b"x" * 2000 + SQLI + b"y" * 2000)
+    req = Request(method="POST", uri="/up", body=b"")
+    v = _stream_verdict(pipeline, req, payload, chunk=1)
+    assert v.attack and "sqli" in v.classes
+
+
+def test_streaming_base64_body_detected(pipeline):
+    payload = base64.b64encode(b"A" * 30000 + SQLI + b"B" * 30000)
+    req = Request(method="POST", uri="/up", body=b"")
+    v = _stream_verdict(pipeline, req, payload, chunk=777)
+    assert v.attack and "sqli" in v.classes
+
+
+def test_streaming_corrupt_gzip_fails_open(pipeline):
+    import random
+    rng = random.Random(7)
+    # printable (no null-byte rule hits), high-entropy enough that 100
+    # compressed bytes are a genuine truncation
+    blob = bytes(rng.randrange(0x20, 0x7f) for _ in range(20000))
+    payload = gzip.compress(blob)[:100] + b"\xff" * 200
+    req = Request(method="POST", uri="/up", body=b"",
+                  headers={"Content-Encoding": "gzip"})
+    v = _stream_verdict(pipeline, req, payload)
+    assert not v.attack and v.fail_open   # truncated scan is surfaced
+
+
+def test_streaming_parser_disable_carries_to_confirm(pipeline):
+    """parsers_off must reach BOTH stream scan and the confirm re-unpack:
+    with base64 disabled, a base64-wrapped attack is (by operator choice)
+    not decoded anywhere — no verdict."""
+    payload = base64.b64encode(b"A" * 3000 + SQLI + b"B" * 3000)
+    req = Request(method="POST", uri="/up", body=b"",
+                  parsers_off=frozenset(["base64"]))
+    v = _stream_verdict(pipeline, req, payload)
+    assert not v.attack
