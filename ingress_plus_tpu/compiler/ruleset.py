@@ -74,11 +74,13 @@ SEVERITY_SCORE = {
     "CRITICAL": 5, "ERROR": 4, "WARNING": 3, "NOTICE": 2, "INFO": 1, "DEBUG": 1,
 }
 
-# Operators that carry scannable content.
-_SCAN_OPERATORS = {
-    "rx", "pm", "pmf", "pmFromFile", "contains", "containsWord", "streq",
-    "beginsWith", "endsWith", "within", "detectSQLi", "detectXSS",
-}
+# NOTE on operator coverage: the per-operator branches in
+# _factor_group_for decide which operators contribute prefilter factors
+# (rx/pm/contains/... families).  Rules with any OTHER operator (@eq,
+# @validateByteRange, ... — the CRS 920 protocol family) and negated
+# operators are NOT dropped: they compile with an empty factor group, so
+# the rule_nfactors==0 always-confirm path evaluates them exactly on CPU
+# (models/confirm.py) for every applicable request.
 
 # Heuristic trigger factors for the strict-grammar detectors (libdetection
 # analog).  These gate the CPU confirm stage; soundness vs our own
@@ -272,7 +274,6 @@ def _factor_group_for(rule: Rule) -> Tuple[F.Group, Dict]:
         "op": op, "arg": rule.argument, "transforms": rule.transforms,
         "fold": fold, "variant": _rule_variant(rule),
     }
-
     if op == "rx":
         try:
             ast = parse_regex(rule.argument, ignorecase=fold)
@@ -296,6 +297,14 @@ def _factor_group_for(rule: Rule) -> Tuple[F.Group, Dict]:
         group = [F.best_window(_lit_seq(w, True)) for w in _XSS_TRIGGERS]
     else:
         group = []
+
+    if rule.negate:
+        # inverted match: absence of a pattern has no scannable factors —
+        # always-confirm, evaluated exactly (and inverted) on CPU.  The
+        # op-specific confirm fields (words etc.) above are still needed:
+        # the confirm stage evaluates the op, THEN inverts.
+        confirm["negate"] = True
+        return [], confirm
 
     # Soundness fix-ups for destructive transforms (see module docstring).
     t = set(rule.transforms)
@@ -327,8 +336,13 @@ def compile_ruleset(
 
     ``base_path`` is accepted for compatibility but unused: @pmFromFile is
     resolved at SecLang parse time (seclang.parse_seclang).
+
+    EVERY rule compiles — non-scan operators (@eq, @validateByteRange,
+    ...) and negated operators get an empty factor group and ride the
+    always-confirm path; nothing is silently dropped (a dropped CRS 920
+    rule would be a silent protocol-check hole).
     """
-    scannable = [r for r in rules if r.operator in _SCAN_OPERATORS]
+    scannable = list(rules)
 
     metas: List[RuleMeta] = []
     groups: List[F.Group] = []

@@ -78,7 +78,10 @@ class Rule:
 
     rule_id: int
     operator: str                     # rx | pm | contains | streq | beginsWith |
-                                      # endsWith | within | detectSQLi | detectXSS
+                                      # endsWith | within | detectSQLi |
+                                      # detectXSS | eq/ge/gt/le/lt |
+                                      # validateByteRange | ... (non-scan
+                                      # operators compile confirm-only)
     argument: str                     # regex text / word list / literal
     targets: List[str] = field(default_factory=lambda: ["args"])  # stream names
     transforms: List[str] = field(default_factory=list)
@@ -89,6 +92,9 @@ class Rule:
     chain: Optional["Rule"] = None    # AND-linked next rule
     paranoia: int = 1
     phase: int = 2
+    negate: bool = False              # "!@op": match inverted (confirm-only
+                                      # by construction — absence cannot be
+                                      # prefiltered by factors)
 
     @property
     def attack_class(self) -> str:
@@ -219,12 +225,20 @@ def parse_seclang(
         targets_txt, op_txt = tokens[1], tokens[2]
         actions_txt = tokens[3] if len(tokens) > 3 else ""
 
+        negate = False
+        if op_txt.startswith("!@"):
+            # "!@eq 1"-style inverted operators (CRS uses them heavily in
+            # the 920 protocol family and chain links): compile with the
+            # match inverted — confirm-only, since absence has no factors
+            negate = True
+            op_txt = op_txt[1:]
         if op_txt.startswith("@"):
             parts = op_txt.split(None, 1)
             operator = parts[0][1:]
             argument = parts[1] if len(parts) > 1 else ""
-        elif op_txt.startswith("!@"):
-            continue  # negated operators are control rules; skip
+        elif op_txt.startswith("!"):
+            negate = True
+            operator, argument = "rx", op_txt[1:]
         else:
             operator, argument = "rx", op_txt
 
@@ -279,6 +293,7 @@ def parse_seclang(
             tags=tags,
             paranoia=paranoia,
             phase=phase,
+            negate=negate,
         )
 
         if pending_chain is not None:

@@ -156,8 +156,39 @@ def apply_transforms(data: bytes, transforms: List[str]) -> bytes:
     return data
 
 
+def _atoi(text: bytes) -> int:
+    """C atoi semantics (what ModSecurity's numeric operators use):
+    optional sign + leading digits, anything else → 0."""
+    m = re.match(rb"\s*([+-]?\d+)", text)
+    return int(m.group(1)) if m else 0
+
+
+def _parse_byte_ranges(arg: bytes) -> List[tuple]:
+    """@validateByteRange argument: "32-126,9,10,13" → [(lo, hi), ...]."""
+    ranges: List[tuple] = []
+    for part in arg.split(b","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            if b"-" in part:
+                lo, hi = part.split(b"-", 1)
+                ranges.append((int(lo), int(hi)))
+            else:
+                v = int(part)
+                ranges.append((v, v))
+        except ValueError:
+            continue
+    return ranges
+
+
 class ConfirmRule:
-    """Compiled exact-evaluation closure for one rule (+ chain links)."""
+    """Compiled exact-evaluation closure for one rule (+ chain links).
+
+    Non-scan operators (@eq family, @validateByteRange, ... — the CRS 920
+    protocol-check shapes) are evaluated here exactly; such rules reach
+    confirm on every applicable request via the rule_nfactors==0 path
+    (compiler/ruleset.py), so nothing about them is approximate."""
 
     def __init__(self, confirm: Dict):
         self.desc = confirm
@@ -165,6 +196,7 @@ class ConfirmRule:
         self.transforms: List[str] = confirm.get("transforms", [])
         self.targets: List[str] = confirm.get("targets", ["args"])
         self.fold: bool = confirm.get("fold", False)
+        self.negate: bool = confirm.get("negate", False)
         self.rx: Optional["re.Pattern[bytes]"] = None
         self.words: List[bytes] = [
             w.encode() for w in confirm.get("words", [])]
@@ -177,12 +209,19 @@ class ConfirmRule:
                 self.rx = re.compile(self.arg, flags)
             except re.error as e:
                 self.compile_error = str(e)
+        self.byte_ranges: Optional[List[tuple]] = None
+        if self.op == "validateByteRange":
+            self.byte_ranges = _parse_byte_ranges(self.arg)
         self.chain = [ConfirmRule(c) for c in confirm.get("chain", [])]
 
-    def _op_match(self, text: bytes) -> bool:
+    def _op_match(self, text: bytes) -> Optional[bool]:
+        """Tri-state: True/False = evaluated; None = ABSTAIN (cannot
+        evaluate: macro argument, unsupported operator, broken regex).
+        The distinction is load-bearing for negation — a blind boolean
+        would turn every abstain into an always-fire under "!@op"."""
         if self.op == "rx":
             if self.rx is None:
-                return False  # unmatchable pattern: never confirm
+                return None   # unmatchable pattern: abstain
             return self.rx.search(text) is not None
         if self.op == "pm":
             low = text.lower()
@@ -205,16 +244,64 @@ class ConfirmRule:
         if self.op == "detectXSS":
             from ingress_plus_tpu.models.libdetect import detect_xss
             return detect_xss(text)
-        return False
+        if self.op in ("eq", "ge", "gt", "le", "lt"):
+            # ModSecurity numeric compare with atoi semantics (leading
+            # integer, else 0) on both sides; macro arguments (%{...})
+            # can't resolve here → abstain
+            if self.arg[:2] == b"%{":
+                return None
+            val, ref = _atoi(text), _atoi(self.arg)
+            return {"eq": val == ref, "ge": val >= ref, "gt": val > ref,
+                    "le": val <= ref, "lt": val < ref}[self.op]
+        if self.op == "validateByteRange":
+            # fires when any byte falls OUTSIDE the allowed ranges
+            if not self.byte_ranges:
+                return None
+            # set(text) keeps the scan in C — this runs on the
+            # always-confirm path for every request with a body
+            return bool(set(text) - self._allowed_bytes())
+        if self.op == "validateUrlEncoding":
+            # fires on '%' not followed by two hex digits
+            return re.search(rb"%(?![0-9a-fA-F]{2})", text) is not None
+        if self.op == "validateUtf8Encoding":
+            try:
+                text.decode("utf-8")
+                return False
+            except UnicodeDecodeError:
+                return True
+        if self.op == "unconditionalMatch":
+            return True
+        if self.op == "noMatch":
+            return False
+        # unsupported operator (@rbl, @ipMatch, @geoLookup, ... — need
+        # external state we don't model): abstain — never match, never
+        # block, regardless of negation
+        return None
+
+    def _allowed_bytes(self) -> frozenset:
+        cached = getattr(self, "_allowed_cache", None)
+        if cached is None:
+            allowed = set()
+            for lo, hi in self.byte_ranges or ():
+                allowed.update(range(lo, hi + 1))
+            cached = self._allowed_cache = frozenset(allowed)
+        return cached
 
     def matches_streams(self, streams: Dict[str, bytes]) -> bool:
-        """Evaluate against raw streams (applies own transforms)."""
+        """Evaluate against raw streams (applies own transforms).
+
+        Negated operators ("!@op") invert per target value, mirroring
+        ModSecurity: a variable matches when the operator does NOT; absent
+        streams still don't evaluate at all."""
         hit = False
         for target in self.targets:
             raw = streams.get(target, b"")
             if not raw:
                 continue
-            if self._op_match(apply_transforms(raw, self.transforms)):
+            m = self._op_match(apply_transforms(raw, self.transforms))
+            if m is None:
+                continue   # abstain survives negation: never a hit
+            if m != self.negate:
                 hit = True
                 break
         if not hit:

@@ -71,10 +71,15 @@ class EngineTables:
         onehot = np.zeros((max(R, 1), len(CLASSES)), dtype=np.float32)
         if R:
             onehot[np.arange(R), cr.rule_class] = 1.0
+        # F == 0 (every rule confirm-only, e.g. a pure 920-protocol pack):
+        # factor_word/bit must pad like factor_rule's dummy row — the
+        # dummy maps to no rule (all-zero fr row), so it can never fire
+        factor_word = t.factor_word if F else np.zeros((1,), np.int32)
+        factor_bit = (t.factor_bit if F else np.zeros((1,), np.int32))
         return cls(
             scan=ScanTables.from_bitap(t),
-            factor_word=jnp.asarray(t.factor_word, jnp.int32),
-            factor_bit=jnp.asarray(t.factor_bit.astype(np.uint32)),
+            factor_word=jnp.asarray(factor_word, jnp.int32),
+            factor_bit=jnp.asarray(factor_bit.astype(np.uint32)),
             factor_rule=jnp.asarray(fr),
             rule_sv=jnp.asarray(cr.rule_sv_mask.astype(np.float32)),
             rule_score=jnp.asarray(cr.rule_score, jnp.int32),

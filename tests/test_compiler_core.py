@@ -242,3 +242,114 @@ def test_rule_without_factor_marked():
     tables = pack_factors([[], best_factor_group(parse_regex("abc"))])
     assert tables.rule_nfactors[0] == 0
     assert tables.rule_nfactors[1] >= 1
+
+
+# ------------------------- non-scan operators compile confirm-only (920)
+
+CRS_920_SHAPE = r"""
+SecRule REQUEST_BODY "@validateByteRange 32-126,9,10,13" \
+    "id:920270,phase:2,block,severity:CRITICAL,tag:'attack-protocol'"
+SecRule ARGS "@validateUrlEncoding" \
+    "id:920220,phase:2,block,severity:WARNING,tag:'attack-protocol'"
+SecRule REQUEST_BODY "@validateUtf8Encoding" \
+    "id:920250,phase:2,block,severity:WARNING,tag:'attack-protocol'"
+SecRule ARGS "@eq 0" \
+    "id:920170,phase:2,block,severity:WARNING,tag:'attack-protocol'"
+SecRule ARGS "!@rx ^[\w=&.]+$" \
+    "id:920260,phase:1,block,severity:WARNING,tag:'attack-protocol'"
+SecRule REQUEST_URI "@rx (?i)union\s+select" \
+    "id:942100,phase:2,block,severity:CRITICAL,tag:'attack-sqli'"
+"""
+
+
+def test_non_scan_operators_compile_confirm_only():
+    """A CRS-920-shaped file loses ZERO rules: non-scan and negated
+    operators compile with empty factor groups onto the always-confirm
+    path (VERDICT: silently-dropped 920 rules were a protocol hole)."""
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+
+    rules = parse_seclang(CRS_920_SHAPE)
+    assert len(rules) == 6
+    cr = compile_ruleset(rules)
+    assert cr.n_rules == 6, "rules were dropped at compile"
+    ids = set(cr.rule_ids.tolist())
+    assert {920270, 920220, 920250, 920170, 920260, 942100} <= ids
+    # the non-scan rules have no prefilter factors -> always-confirm
+    import numpy as np
+    no_factors = {int(cr.rule_ids[i]) for i in range(cr.n_rules)
+                  if cr.tables.rule_nfactors[i] == 0}
+    assert {920270, 920220, 920250, 920170, 920260} <= no_factors
+
+
+def test_protocol_operator_semantics():
+    """Exact CPU evaluation of the 920-family operators end-to-end."""
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.normalize import Request
+
+    p = DetectionPipeline(compile_ruleset(parse_seclang(CRS_920_SHAPE)),
+                          mode="block", anomaly_threshold=3)
+
+    def hits(req):
+        return set(p.detect([req])[0].rule_ids)
+
+    # null byte in body is outside 32-126,9,10,13
+    assert 920270 in hits(Request(method="POST", uri="/a?x=1",
+                                  body=b"field=ab\x00cd"))
+    # invalid %-encoding in args
+    assert 920220 in hits(Request(uri="/a?q=abc%zzdef"))
+    # invalid utf-8 in body
+    assert 920250 in hits(Request(method="POST", uri="/a?x=1",
+                                  body=b"data=\xff\xfe\xfd"))
+    # args value with atoi() == 0
+    assert 920170 in hits(Request(uri="/a?x=zero"))
+    # negated rx (query charset allowlist): a forbidden byte fires,
+    # an in-charset query does not
+    assert 920260 in hits(Request(uri="/a?x=evil|host"))
+    assert 920260 not in hits(Request(uri="/a?x=10.0.0.1"))
+    # clean numeric request: none of the above
+    clean = hits(Request(uri="/a?x=42"))
+    assert not {920270, 920220, 920250, 920260} & clean
+
+
+def test_negation_never_inverts_abstain():
+    """'Cannot evaluate' (macro args, unsupported ops, broken regex) must
+    abstain — not flip to always-fire under negation (review finding:
+    '!@eq %{tx.foo}' would otherwise block every request)."""
+    from ingress_plus_tpu.models.confirm import ConfirmRule
+
+    streams = {"args": b"x=anything"}
+    # macro argument: abstain, negated or not
+    for neg in (False, True):
+        cr = ConfirmRule({"op": "eq", "arg": "%{tx.foo}", "negate": neg,
+                          "targets": ["args"]})
+        assert not cr.matches_streams(streams)
+        # unsupported operator
+        cr = ConfirmRule({"op": "ipMatch", "arg": "127.0.0.1",
+                          "negate": neg, "targets": ["args"]})
+        assert not cr.matches_streams(streams)
+        # broken regex
+        cr = ConfirmRule({"op": "rx", "arg": "(unclosed", "negate": neg,
+                          "targets": ["args"]})
+        assert not cr.matches_streams(streams)
+
+
+def test_negated_pm_keeps_word_list():
+    """'!@pm GET POST' must evaluate the word list then invert — the
+    compile path must populate confirm['words'] before the negate
+    early-return (review finding: empty words made it fire on GET)."""
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.normalize import Request
+
+    rules = parse_seclang(
+        'SecRule REQUEST_URI "!@pm /api /web" '
+        '"id:911100,phase:1,block,severity:CRITICAL,tag:\'attack-protocol\'"')
+    assert rules[0].negate and rules[0].operator == "pm"
+    p = DetectionPipeline(compile_ruleset(rules), mode="block",
+                          anomaly_threshold=3)
+    assert not p.detect([Request(uri="/api/users")])[0].attack
+    assert p.detect([Request(uri="/secret/path")])[0].attack
