@@ -177,18 +177,33 @@ def _parse_actions(text: str) -> Dict[str, List[str]]:
 
 
 def _parse_targets(text: str) -> List[str]:
+    """Target expression → stream names.
+
+    Counting-form targets (&ARGS — the variable's COUNT, not its text)
+    are unsupported: a rule whose targets are ALL count-form gets an
+    EMPTY target list, so the confirm stage abstains.  Falling back to
+    ['args'] instead would evaluate e.g. "@eq 0" against the args TEXT
+    (atoi → 0) and block essentially every request."""
     streams: List[str] = []
+    saw_any = False
     for t in text.split("|"):
         t = t.strip()
         if not t or t.startswith("!"):
             continue  # exclusions narrow the target set; superset is sound
         if t.startswith("&"):
-            continue  # counting form (&ARGS) — control rule, not scannable
+            saw_any = True   # counting form: recognized but unevaluable
+            continue
         base = t.split(":", 1)[0].upper()
         stream = KNOWN_TARGETS.get(base)
         if stream and stream not in streams:
             streams.append(stream)
-    return streams or ["args"]
+        saw_any = saw_any or stream is not None
+    if streams:
+        return streams
+    # nothing usable: only fall back to args when the expression named
+    # NO target we recognize at all (legacy lenient behavior); an
+    # all-count-form rule must abstain, not rebind to args text
+    return [] if saw_any else ["args"]
 
 
 def parse_seclang(

@@ -209,9 +209,12 @@ class ConfirmRule:
                 self.rx = re.compile(self.arg, flags)
             except re.error as e:
                 self.compile_error = str(e)
-        self.byte_ranges: Optional[List[tuple]] = None
+        self.allowed_bytes: Optional[frozenset] = None
         if self.op == "validateByteRange":
-            self.byte_ranges = _parse_byte_ranges(self.arg)
+            allowed = set()
+            for lo, hi in _parse_byte_ranges(self.arg):
+                allowed.update(range(lo, hi + 1))
+            self.allowed_bytes = frozenset(allowed) if allowed else None
         self.chain = [ConfirmRule(c) for c in confirm.get("chain", [])]
 
     def _op_match(self, text: bytes) -> Optional[bool]:
@@ -254,12 +257,12 @@ class ConfirmRule:
             return {"eq": val == ref, "ge": val >= ref, "gt": val > ref,
                     "le": val <= ref, "lt": val < ref}[self.op]
         if self.op == "validateByteRange":
-            # fires when any byte falls OUTSIDE the allowed ranges
-            if not self.byte_ranges:
-                return None
+            # fires when any byte falls OUTSIDE the allowed ranges;
             # set(text) keeps the scan in C — this runs on the
             # always-confirm path for every request with a body
-            return bool(set(text) - self._allowed_bytes())
+            if self.allowed_bytes is None:
+                return None
+            return bool(set(text) - self.allowed_bytes)
         if self.op == "validateUrlEncoding":
             # fires on '%' not followed by two hex digits
             return re.search(rb"%(?![0-9a-fA-F]{2})", text) is not None
@@ -278,14 +281,6 @@ class ConfirmRule:
         # block, regardless of negation
         return None
 
-    def _allowed_bytes(self) -> frozenset:
-        cached = getattr(self, "_allowed_cache", None)
-        if cached is None:
-            allowed = set()
-            for lo, hi in self.byte_ranges or ():
-                allowed.update(range(lo, hi + 1))
-            cached = self._allowed_cache = frozenset(allowed)
-        return cached
 
     def matches_streams(self, streams: Dict[str, bytes]) -> bool:
         """Evaluate against raw streams (applies own transforms).

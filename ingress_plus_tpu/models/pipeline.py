@@ -84,8 +84,9 @@ class DetectionPipeline:
         fail_open: bool = True,
         paranoia_level: int = 2,
         tenant_rule_mask: Optional[np.ndarray] = None,  # (T, R) bool
+        scan_impl: str = "pair",
     ):
-        self.engine = DetectionEngine(ruleset)
+        self.engine = DetectionEngine(ruleset, scan_impl=scan_impl)
         self.mode = mode
         self.anomaly_threshold = anomaly_threshold
         self.fail_open = fail_open

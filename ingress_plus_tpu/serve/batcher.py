@@ -212,7 +212,9 @@ class Batcher:
         new = DetectionPipeline(
             ruleset, mode=old.mode,
             anomaly_threshold=old.anomaly_threshold,
-            fail_open=old.fail_open, paranoia_level=paranoia_level)
+            fail_open=old.fail_open, paranoia_level=paranoia_level,
+            scan_impl=old.engine.scan_impl)
+        new.engine.pallas_interpret = old.engine.pallas_interpret
         for shape in sorted(getattr(old, "seen_shapes", ())):
             new.warm_shape(*shape)
         new.stats = old.stats  # counters span swaps (Prometheus contract)

@@ -375,6 +375,7 @@ class ServeLoop:
                 "ruleset": pipeline.ruleset.version,
                 "rules": pipeline.ruleset.n_rules,
                 "mode": pipeline.mode,
+                "scan_impl": pipeline.engine.scan_impl,
                 "anomaly_threshold": pipeline.anomaly_threshold,
                 "tenants": 1 if tm is None else int(tm.shape[0]),
                 "batch": {"max": self.batcher.max_batch,
@@ -415,7 +416,8 @@ class ServeLoop:
 def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
                           max_batch: int = 256,
                           max_delay_s: float = 0.0005,
-                          warmup: bool = True) -> Batcher:
+                          warmup: bool = True,
+                          scan_impl: str = "auto") -> Batcher:
     from ingress_plus_tpu.compiler.ruleset import compile_ruleset
     from ingress_plus_tpu.compiler.seclang import load_seclang_dir
     from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
@@ -424,6 +426,17 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
     rules = (load_seclang_dir(rules_dir) if rules_dir
              else load_bundled_rules())
     pipeline = DetectionPipeline(compile_ruleset(rules), mode=mode)
+    if scan_impl == "auto":
+        # startup microbench on the LIVE backend picks the serving scan
+        # implementation (pair/take/pallas) by measurement
+        timings = pipeline.engine.autoselect_scan_impl()
+        print("scan impl auto-select: %s  (%s)" % (
+            pipeline.engine.scan_impl,
+            ", ".join("%s=%.2fms" % (k, v * 1e3)
+                      for k, v in sorted(timings.items()))),
+            file=sys.stderr)
+    else:
+        pipeline.engine.scan_impl = scan_impl
     if warmup:
         warmup_pipeline(pipeline, max_batch)
     return Batcher(pipeline, max_batch=max_batch, max_delay_s=max_delay_s)
@@ -467,6 +480,11 @@ def main(argv=None) -> None:
                          "box's TPU sits behind a ~70ms tunnel, so "
                          "latency-sensitive serving may prefer cpu")
     ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--scan-impl", default="auto",
+                    choices=["auto", "pair", "take", "pallas"],
+                    help="TPU scan implementation; auto = startup "
+                         "microbench on the live backend picks the "
+                         "fastest (pallas excluded on cpu)")
     ap.add_argument("--spool-dir", default=None,
                     help="postanalytics spool dir (attacks.jsonl); "
                          "enables the exporter loop")
@@ -488,7 +506,8 @@ def main(argv=None) -> None:
 
     batcher = build_default_batcher(
         mode=args.mode, rules_dir=args.rules_dir, max_batch=args.max_batch,
-        max_delay_s=args.max_delay_us / 1e6, warmup=not args.no_warmup)
+        max_delay_s=args.max_delay_us / 1e6, warmup=not args.no_warmup,
+        scan_impl=args.scan_impl)
 
     post = None
     if args.spool_dir or args.export_url:

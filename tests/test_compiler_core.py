@@ -353,3 +353,30 @@ def test_negated_pm_keeps_word_list():
                           anomaly_threshold=3)
     assert not p.detect([Request(uri="/api/users")])[0].attack
     assert p.detect([Request(uri="/secret/path")])[0].attack
+
+
+def test_count_form_targets_abstain_not_rebind():
+    """'&REQUEST_HEADERS:Host' is the variable COUNT, which we don't
+    model: the rule must abstain (empty targets), NOT rebind to the args
+    text — '@eq 0' on args text (atoi 0) would block everything (review
+    finding)."""
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.normalize import Request
+
+    rules = parse_seclang(
+        'SecRule &REQUEST_HEADERS:Host "@eq 0" '
+        '"id:920280,phase:1,block,severity:CRITICAL,tag:\'attack-protocol\'"')
+    assert rules[0].targets == []
+    p = DetectionPipeline(compile_ruleset(rules), mode="block",
+                          anomaly_threshold=3)
+    for uri in ("/q?x=hello", "/q?x=42", "/plain"):
+        v = p.detect([Request(uri=uri,
+                              headers={"Host": "example.com"})])[0]
+        assert not v.attack, uri
+    # mixed targets keep the evaluable part
+    rules = parse_seclang(
+        'SecRule &ARGS|REQUEST_URI "@rx (?i)union\\s+select" '
+        '"id:942999,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"')
+    assert rules[0].targets == ["uri"]
