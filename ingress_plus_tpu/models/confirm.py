@@ -282,18 +282,33 @@ class ConfirmRule:
         return None
 
 
-    def matches_streams(self, streams: Dict[str, bytes]) -> bool:
+    def matches_streams(self, streams: Dict[str, bytes],
+                        cache: Optional[Dict] = None) -> bool:
         """Evaluate against raw streams (applies own transforms).
 
         Negated operators ("!@op") invert per target value, mirroring
         ModSecurity: a variable matches when the operator does NOT; absent
-        streams still don't evaluate at all."""
+        streams still don't evaluate at all.
+
+        ``cache`` (per-request dict) memoizes transformed stream text
+        across rules — many rules share a transform chain, and the
+        prefilter-loss gate evaluates EVERY rule per request, where the
+        cache turns O(rules × transforms) into O(distinct chains)."""
         hit = False
+        tkey = tuple(self.transforms)
         for target in self.targets:
             raw = streams.get(target, b"")
             if not raw:
                 continue
-            m = self._op_match(apply_transforms(raw, self.transforms))
+            if cache is None:
+                text = apply_transforms(raw, self.transforms)
+            else:
+                key = (target, tkey)
+                text = cache.get(key)
+                if text is None:
+                    text = apply_transforms(raw, self.transforms)
+                    cache[key] = text
+            m = self._op_match(text)
             if m is None:
                 continue   # abstain survives negation: never a hit
             if m != self.negate:
@@ -302,4 +317,5 @@ class ConfirmRule:
         if not hit:
             return False
         # chain: every link must also match (on its own targets/transforms)
-        return all(link.matches_streams(streams) for link in self.chain)
+        return all(link.matches_streams(streams, cache)
+                   for link in self.chain)

@@ -231,8 +231,9 @@ class DetectionPipeline:
             hit_rules = np.nonzero(rule_hits[qi])[0]
             confirmed: List[int] = []
             streams = req.streams() if len(hit_rules) else {}
+            cache: Dict = {}   # per-request transform memo across rules
             for r in hit_rules:
-                if self.confirms[r].matches_streams(streams):
+                if self.confirms[r].matches_streams(streams, cache):
                     confirmed.append(int(r))
             score = int(rs.rule_score[confirmed].sum()) if confirmed else 0
             classes = sorted(
