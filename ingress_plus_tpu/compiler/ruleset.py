@@ -239,6 +239,7 @@ class CompiledRuleset:
                 operator=confirm["op"],
                 argument=confirm.get("arg", ""),
                 targets=list(confirm.get("targets", ["args"])),
+                raw_targets=list(confirm.get("raw_targets", [])),
                 transforms=confirm.get("transforms", []),
                 action=action_names[int(z["rule_action"][i])],
                 tags=list(all_tags[i]),
@@ -361,10 +362,12 @@ def compile_ruleset(
             while link is not None:
                 _, link_confirm = _factor_group_for(link)
                 link_confirm["targets"] = link.targets
+                link_confirm["raw_targets"] = link.raw_targets
                 links.append(link_confirm)
                 link = link.chain
             confirm["chain"] = links
         confirm["targets"] = rule.targets
+        confirm["raw_targets"] = rule.raw_targets
         variant = confirm["variant"]
 
         groups.append(group)

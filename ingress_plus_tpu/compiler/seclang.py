@@ -84,6 +84,12 @@ class Rule:
                                       # operators compile confirm-only)
     argument: str                     # regex text / word list / literal
     targets: List[str] = field(default_factory=lambda: ["args"])  # stream names
+    #: original pipe-split variable tokens ("REQUEST_HEADERS:Content-Length",
+    #: "&ARGS", "!ARGS:z", ...) — the confirm stage resolves subfield
+    #: selectors / counts / exclusions from these EXACTLY, instead of
+    #: evaluating against the whole coarse stream (round-2 advisor: a
+    #: negated op on a discarded selector fired on every request)
+    raw_targets: List[str] = field(default_factory=list)
     transforms: List[str] = field(default_factory=list)
     action: str = "block"             # block | deny | pass (monitoring)
     severity: str = "WARNING"
@@ -177,13 +183,16 @@ def _parse_actions(text: str) -> Dict[str, List[str]]:
 
 
 def _parse_targets(text: str) -> List[str]:
-    """Target expression → stream names.
+    """Target expression → stream names (prefilter sv-mask granularity).
 
     Counting-form targets (&ARGS — the variable's COUNT, not its text)
-    are unsupported: a rule whose targets are ALL count-form gets an
-    EMPTY target list, so the confirm stage abstains.  Falling back to
-    ['args'] instead would evaluate e.g. "@eq 0" against the args TEXT
-    (atoi → 0) and block essentially every request."""
+    map to their base stream so the rule reaches the confirm stage,
+    which evaluates the count EXACTLY from the raw target token
+    (models/confirm.py _values_for).  Before round 3 they were dropped
+    entirely; the confirm stage could only abstain.  Note the remaining
+    gap, documented there: a count rule fires only for requests with at
+    least one row of the base stream (an absent-stream "@eq 0" abstains).
+    """
     streams: List[str] = []
     saw_any = False
     for t in text.split("|"):
@@ -191,8 +200,7 @@ def _parse_targets(text: str) -> List[str]:
         if not t or t.startswith("!"):
             continue  # exclusions narrow the target set; superset is sound
         if t.startswith("&"):
-            saw_any = True   # counting form: recognized but unevaluable
-            continue
+            t = t[1:].strip()   # counting form: same base stream
         base = t.split(":", 1)[0].upper()
         stream = KNOWN_TARGETS.get(base)
         if stream and stream not in streams:
@@ -201,8 +209,7 @@ def _parse_targets(text: str) -> List[str]:
     if streams:
         return streams
     # nothing usable: only fall back to args when the expression named
-    # NO target we recognize at all (legacy lenient behavior); an
-    # all-count-form rule must abstain, not rebind to args text
+    # NO target we recognize at all (legacy lenient behavior)
     return [] if saw_any else ["args"]
 
 
@@ -301,6 +308,8 @@ def parse_seclang(
             operator=operator,
             argument=argument,
             targets=_parse_targets(targets_txt),
+            raw_targets=[t.strip() for t in targets_txt.split("|")
+                         if t.strip()],
             transforms=transforms,
             action=action,
             severity=severity,

@@ -182,19 +182,162 @@ def _parse_byte_ranges(arg: bytes) -> List[tuple]:
     return ranges
 
 
+#: operators that compare a number (atoi both sides) — these and negated
+#: operators may only consume EXACT per-variable values, never a whole
+#: coarse stream blob (round-2 advisor findings 1+2: atoi of a headers
+#: blob is 0, and "!@rx" on a blob fires on every request)
+NUMERIC_OPS = frozenset(("eq", "ge", "gt", "le", "lt"))
+
+#: scalar pseudo-streams the confirm stage can consume beyond the 4 scan
+#: streams (Request.confirm_streams supplies them; absent keys degrade
+#: per _values_for rules)
+_SCALAR_BASES = {
+    "REQUEST_URI": "uri",
+    "REQUEST_URI_RAW": "uri",
+    "REQUEST_LINE": "uri",
+    "REQUEST_BODY": "body",
+    "XML": "body",
+    "JSON": "body",
+    "REQUEST_METHOD": "method",
+    "REQUEST_PROTOCOL": "protocol",
+    "REQUEST_FILENAME": "filename",
+    "REQUEST_BASENAME": "basename",
+    "QUERY_STRING": "query",
+}
+
+#: collection bases → (parser kind, which part of the k/v pair)
+_COLLECTION_BASES = {
+    "REQUEST_HEADERS": ("headers", "values"),
+    "REQUEST_HEADERS_NAMES": ("headers", "names"),
+    "REQUEST_COOKIES": ("cookies", "values"),
+    "REQUEST_COOKIES_NAMES": ("cookies", "names"),
+    "ARGS": ("args", "values"),
+    "ARGS_NAMES": ("args", "names"),
+    "ARGS_GET": ("args", "values"),
+    "ARGS_GET_NAMES": ("args", "names"),
+    "ARGS_POST": ("bodyargs", "values"),
+    "ARGS_POST_NAMES": ("bodyargs", "names"),
+    "FILES": ("bodyargs", "values"),
+    "FILES_NAMES": ("bodyargs", "names"),
+}
+
+
+def _looks_like_form(body: bytes) -> bool:
+    """Heuristic for ARGS_POST without a content-type at hand: a
+    form-urlencoded body is k=v pairs with no raw control bytes.  A
+    JSON/XML/binary body must NOT be k/v-split (mis-parsed pairs would
+    feed wrong values to negated ops)."""
+    if len(body) > 1 << 16 or b"=" not in body:
+        return False
+    head = body[:256]
+    if head[:1] in (b"{", b"[", b"<"):
+        return False
+    return not any(c < 9 or (13 < c < 32) for c in head)
+
+
+def _split_form(raw: bytes, decode: bool) -> List[tuple]:
+    """Split k=v&k2=v2 into (name_lower, name, value).  Pair splitting
+    happens on the RAW bytes FIRST, decoding each component after
+    (ModSecurity order) — splitting an already-decoded blob would let a
+    percent-encoded '&'/'=' inside a value fabricate variables that the
+    evaluator then trusts as exact (review finding).  A valueless
+    parameter ('?flag') is (flag, b'') like ModSecurity, not dropped."""
+    out: List[tuple] = []
+    for part in raw.split(b"&"):
+        if not part:
+            continue
+        k, _sep, v = part.partition(b"=")
+        if decode:
+            k, v = url_decode_uni(k), url_decode_uni(v)
+        k = k.strip()
+        if k:
+            out.append((k.lower(), k, v))
+    return out
+
+
+def _parse_collection(kind: str, streams: Dict[str, bytes],
+                      cache: Optional[Dict]) -> Optional[List[tuple]]:
+    """(name_lower, name, value) triples for one collection kind.
+
+    Returns [] when the backing stream is ABSENT/EMPTY (a faithful empty
+    collection — counts are exactly 0) and None when a PRESENT stream
+    cannot be faithfully parsed (counts/negation must abstain, not
+    report a fabricated 0 — review finding).  Header units are
+    "name: value" joined by \\x1f (serve/normalize.py streams())."""
+    ck = ("#coll", kind)
+    if cache is not None and ck in cache:
+        return cache[ck]
+    out: Optional[List[tuple]]
+    if kind == "headers":
+        blob = streams.get("headers")
+        out = []
+        for unit in (blob.split(b"\x1f") if blob else ()):
+            name, sep, val = unit.partition(b":")
+            if not sep:
+                continue
+            name = name.strip()
+            out.append((name.lower(), name, val.strip()))
+    elif kind == "cookies":
+        hdrs = _parse_collection("headers", streams, cache) or []
+        out = []
+        for lo, _name, val in hdrs:
+            if lo != b"cookie":
+                continue
+            for part in val.split(b";"):
+                k, _sep, v = part.partition(b"=")
+                k = k.strip()
+                if k:
+                    out.append((k.lower(), k, v.strip()))
+    elif kind == "args":
+        # prefer the RAW query (confirm_streams provides it); the
+        # decoded args blob is a legacy fallback where encoded '&'/'='
+        # can't be distinguished — still split-then-nothing, since the
+        # blob is already decoded
+        raw = streams.get("query")
+        if raw is not None:
+            out = _split_form(raw, decode=True)
+        else:
+            blob = streams.get("args")
+            out = _split_form(blob, decode=False) if blob else []
+    elif kind == "bodyargs":
+        blob = streams.get("body")
+        if not blob:
+            out = []
+        elif _looks_like_form(blob):
+            out = _split_form(blob, decode=True)
+        else:
+            out = None   # present but not a form: abstain, don't report 0
+    else:
+        out = None
+    if cache is not None:
+        cache[ck] = out
+    return out
+
+
 class ConfirmRule:
     """Compiled exact-evaluation closure for one rule (+ chain links).
 
     Non-scan operators (@eq family, @validateByteRange, ... — the CRS 920
     protocol-check shapes) are evaluated here exactly; such rules reach
     confirm on every applicable request via the rule_nfactors==0 path
-    (compiler/ruleset.py), so nothing about them is approximate."""
+    (compiler/ruleset.py), so nothing about them is approximate.
+
+    Evaluation is PER VARIABLE (round-3, advisor findings 1+2):
+    ``raw_targets`` carries the original SecLang variable tokens
+    ("REQUEST_HEADERS:Content-Length", "&ARGS", "!ARGS:passwd"), and
+    ``_values_for`` resolves each to the exact value list ModSecurity
+    would build — subfield selection, counting form, exclusions.
+    Negated and numeric operators only ever consume exact per-variable
+    values; positive pattern operators may additionally fall back to the
+    whole coarse stream (a sound superset — the same bytes the TPU
+    scanner saw)."""
 
     def __init__(self, confirm: Dict):
         self.desc = confirm
         self.op: str = confirm["op"]
         self.transforms: List[str] = confirm.get("transforms", [])
         self.targets: List[str] = confirm.get("targets", ["args"])
+        self.raw_targets: List[str] = confirm.get("raw_targets", [])
         self.fold: bool = confirm.get("fold", False)
         self.negate: bool = confirm.get("negate", False)
         self.rx: Optional["re.Pattern[bytes]"] = None
@@ -216,6 +359,116 @@ class ConfirmRule:
                 allowed.update(range(lo, hi + 1))
             self.allowed_bytes = frozenset(allowed) if allowed else None
         self.chain = [ConfirmRule(c) for c in confirm.get("chain", [])]
+        self._plan, self._exclusions = self._compile_targets()
+
+    def _compile_targets(self):
+        """raw_targets → ([(count, BASE, selector_or_None)], exclusions).
+
+        Falls back to a synthesized plan from the coarse stream names
+        when raw_targets is absent (legacy serialized rulesets, sigpack
+        rules): uri/body are true scalars (exact), args/headers yield
+        only the blob (exact=False) — so legacy negated/numeric rules on
+        collections ABSTAIN instead of mass-firing."""
+        excl: Dict[str, set] = {}
+        plan: List[tuple] = []
+        for tok in self.raw_targets:
+            t = tok.strip()
+            if not t:
+                continue
+            if t.startswith("!"):
+                base, sep, sel = t[1:].partition(":")
+                cb = _COLLECTION_BASES.get(base.strip().upper())
+                if cb and sep:
+                    excl.setdefault(cb[0], set()).add(
+                        sel.strip().lower().encode())
+                continue
+            count = t.startswith("&")
+            if count:
+                t = t[1:].strip()
+            base, sep, sel = t.partition(":")
+            plan.append((count, base.strip().upper(),
+                         sel.strip().lower().encode() if sep else None))
+        if not plan:
+            # Legacy descriptors lost any subfield selector, so the
+            # collection streams may NOT be per-value iterated (a rule
+            # originally written against one header would fire on all of
+            # them): collections yield only the blob (exact=False);
+            # uri/body are true scalars.
+            legacy = {"uri": (False, "REQUEST_URI", None),
+                      "body": (False, "REQUEST_BODY", None),
+                      "args": (False, "#BLOB", b"args"),
+                      "headers": (False, "#BLOB", b"headers")}
+            plan = [legacy[s] for s in self.targets if s in legacy]
+        return plan, excl
+
+    def _iter_entry(self, entry, streams: Dict[str, bytes],
+                    cache: Optional[Dict]):
+        """Yield (text, exact, is_count) for one plan entry.
+
+        exact=True: the text is one variable's value, exactly as
+        ModSecurity would expose it (negation/numerics may consume it).
+        exact=False: the text is the whole coarse stream blob — a sound
+        superset for positive pattern operators only."""
+        count, base, sel = entry
+        if base == "#BLOB":   # legacy collection: whole stream, non-exact
+            blob = streams.get(sel.decode())
+            if blob:
+                yield blob, False, False
+            return
+        cb = _COLLECTION_BASES.get(base)
+        if cb is not None:
+            kind, part = cb
+            coll = _parse_collection(kind, streams, cache)
+            if coll is None:
+                # present but unparseable (e.g. a non-form body for
+                # ARGS_POST): counts/negation abstain — a fabricated
+                # exact 0 would false-fire "@eq 0" rules (review
+                # finding); positive pattern ops keep the blob superset
+                if not count and sel is None:
+                    coarse = {"headers": "headers", "cookies": "headers",
+                              "args": "args", "bodyargs": "body"}[kind]
+                    blob = streams.get(coarse)
+                    if blob:
+                        yield blob, False, False
+                return
+            exd = self._exclusions.get(kind, ())
+            if sel is not None:
+                vals = [(n if part == "names" else v)
+                        for lo, n, v in coll if lo == sel]
+            else:
+                vals = [(n if part == "names" else v)
+                        for lo, n, v in coll if lo not in exd]
+            if count:
+                yield str(len(vals)).encode(), True, True
+            else:
+                for v in vals:
+                    yield v, True, False
+            return
+        stream = _SCALAR_BASES.get(base)
+        if stream is None:
+            return  # unknown base: abstain
+        val = streams.get(stream)
+        if val is None and stream in ("query", "filename", "basename"):
+            # derivable from the raw uri when the caller passed only the
+            # 4 scan streams (legacy callers / tests)
+            uri = streams.get("uri", b"")
+            q = uri.find(b"?")
+            path = uri if q < 0 else uri[:q]
+            val = {"query": b"" if q < 0 else uri[q + 1:],
+                   "filename": path,
+                   "basename": path.rsplit(b"/", 1)[-1]}[stream]
+        if val is None:
+            if stream in ("method", "protocol") and not count:
+                # not derivable from the scan streams: positive ops keep
+                # the historical whole-uri superset, negation abstains
+                blob = streams.get("uri")
+                if blob:
+                    yield blob, False, False
+            return
+        if count:
+            yield (b"1" if val else b"0"), True, True
+        elif val:
+            yield val, True, False
 
     def _op_match(self, text: bytes) -> Optional[bool]:
         """Tri-state: True/False = evaluated; None = ABSTAIN (cannot
@@ -286,33 +539,43 @@ class ConfirmRule:
                         cache: Optional[Dict] = None) -> bool:
         """Evaluate against raw streams (applies own transforms).
 
-        Negated operators ("!@op") invert per target value, mirroring
-        ModSecurity: a variable matches when the operator does NOT; absent
-        streams still don't evaluate at all.
+        Negated operators ("!@op") invert per VARIABLE VALUE, mirroring
+        ModSecurity: a variable matches when the operator does not;
+        absent variables don't evaluate at all.  Negated and numeric
+        operators refuse non-exact (whole-blob) values — they abstain
+        rather than invert/atoi a concatenated stream (round-2 advisor
+        findings 1+2).
 
-        ``cache`` (per-request dict) memoizes transformed stream text
-        across rules — many rules share a transform chain, and the
-        prefilter-loss gate evaluates EVERY rule per request, where the
-        cache turns O(rules × transforms) into O(distinct chains)."""
+        ``cache`` (per-request dict) memoizes parsed collections and
+        transformed text across rules — many rules share a transform
+        chain, and the prefilter-loss gate evaluates EVERY rule per
+        request, where the cache turns O(rules × transforms) into
+        O(distinct chains × distinct values)."""
         hit = False
+        restrict = self.negate or self.op in NUMERIC_OPS
         tkey = tuple(self.transforms)
-        for target in self.targets:
-            raw = streams.get(target, b"")
-            if not raw:
-                continue
-            if cache is None:
-                text = apply_transforms(raw, self.transforms)
-            else:
-                key = (target, tkey)
-                text = cache.get(key)
-                if text is None:
-                    text = apply_transforms(raw, self.transforms)
-                    cache[key] = text
-            m = self._op_match(text)
-            if m is None:
-                continue   # abstain survives negation: never a hit
-            if m != self.negate:
-                hit = True
+        for entry in self._plan:
+            for text, exact, is_count in self._iter_entry(
+                    entry, streams, cache):
+                if restrict and not exact:
+                    continue  # abstain: blob values can't drive negation
+                if is_count:
+                    val = text  # counts are numbers; transforms don't apply
+                elif cache is None:
+                    val = apply_transforms(text, self.transforms)
+                else:
+                    key = (tkey, text)
+                    val = cache.get(key)
+                    if val is None:
+                        val = apply_transforms(text, self.transforms)
+                        cache[key] = val
+                m = self._op_match(val)
+                if m is None:
+                    continue   # abstain survives negation: never a hit
+                if m != self.negate:
+                    hit = True
+                    break
+            if hit:
                 break
         if not hit:
             return False

@@ -230,7 +230,7 @@ class DetectionPipeline:
         for qi, req in enumerate(requests):
             hit_rules = np.nonzero(rule_hits[qi])[0]
             confirmed: List[int] = []
-            streams = req.streams() if len(hit_rules) else {}
+            streams = req.confirm_streams() if len(hit_rules) else {}
             cache: Dict = {}   # per-request transform memo across rules
             for r in hit_rules:
                 if self.confirms[r].matches_streams(streams, cache):

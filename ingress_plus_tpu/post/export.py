@@ -245,11 +245,44 @@ def consolidate_once(spool_dir: str | Path, url: Optional[str] = None,
             live.rename(claimed)
         except OSError:
             pass
+    def _unlink_claimed(f: Path, nread: int) -> None:
+        """Unlink a processed .sending file WITHOUT dropping bytes a
+        still-in-flight writer appended after our read (round-2 advisor:
+        the claim-rename can land mid-append; the writer's completed
+        tail would die with the unlink).  Only ONE burst can race — the
+        writer re-opens by name each cycle and the name now points to a
+        fresh live file — so: wait for the size to go stable (bounded),
+        then requeue any appended tail as a new .sending."""
+        try:
+            size = f.stat().st_size
+            # wait for STABILITY (size stops changing), not equality
+            # with nread — once a tail exists the size can never re-equal
+            # nread, and an in-flight flush straddling the window would
+            # still be torn (review finding); no tail costs zero sleeps
+            for _ in range(5):
+                if size == nread:
+                    break
+                time.sleep(0.01)
+                prev, size = size, f.stat().st_size
+                if size == prev:
+                    break
+            if size > nread:
+                with f.open("rb") as fh:
+                    fh.seek(nread)
+                    tail = fh.read()
+                requeued = spool / ("attacks.%d_tail.sending"
+                                    % int(time.time() * 1e6))
+                requeued.write_bytes(tail)
+            f.unlink()
+        except OSError:
+            pass  # transient; the whole file is retried next cycle
+
     for f in sorted(spool.glob("attacks.*.sending")):
         try:
-            text = f.read_text()
+            raw = f.read_bytes()
         except OSError:
             continue  # transient; retried next cycle
+        text = raw.decode("utf-8", "replace")
         # salvage line-by-line: a torn line from a partial append must not
         # discard the batch's valid records (at-least-once contract)
         records = []
@@ -261,7 +294,7 @@ def consolidate_once(spool_dir: str | Path, url: Optional[str] = None,
             except json.JSONDecodeError:
                 pass
         if not records:
-            f.unlink()
+            _unlink_claimed(f, len(raw))
             continue
         if url:
             try:
@@ -275,7 +308,7 @@ def consolidate_once(spool_dir: str | Path, url: Optional[str] = None,
             with (out / "attacks.jsonl").open("a") as fh:
                 for r in records:
                     fh.write(json.dumps(r) + "\n")
-        f.unlink()
+        _unlink_claimed(f, len(raw))
         n += len(records)
     return n
 

@@ -98,6 +98,14 @@ class Batcher:
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._swap_lock = threading.Lock()
+        # oversized-body side lane (round-2 advisor: a 16MB inflate+scan
+        # inline under the swap lock head-of-line-blocked every queued
+        # request in that batch cycle).  Bounded: a flood of oversized
+        # bodies fails open instead of queueing unbounded inflate work.
+        self._oversized_q: "queue.Queue" = queue.Queue(maxsize=8)
+        self._oversized_thread = threading.Thread(
+            target=self._run_oversized, daemon=True, name="ipt-oversized")
+        self._oversized_thread.start()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ipt-batcher")
         self._thread.start()
@@ -116,13 +124,17 @@ class Batcher:
     # 16MB inflate there would stall every other connection.
 
     def _reroute_plan(self, request: Request):
-        """None → normal batched path; (body, headers) → feed these bytes
-        through the stream engine instead (no silent 16KB truncation)."""
+        """None → normal batched path; ("raw"|"unpack", body, headers) →
+        feed through the stream engine instead (no silent 16KB
+        truncation).  Runs on the dispatch thread: only the size check
+        and the BOUNDED inflate probe (cut just past the tier cap)
+        happen here — the full inflate is deferred to the oversized
+        worker, off the batch-critical path."""
         body = request.body
         if not body:
             return None
         if len(body) > self.OVERSIZE_THRESHOLD:
-            return body, request.headers
+            return "raw", body, request.headers
         # a small compressed body can inflate past the tier cap (zip-pad
         # evasion), and extraction segments can push a near-cap body
         # over; probe the unpacked size only when that's possible — the
@@ -135,34 +147,61 @@ class Batcher:
             probe = unpack_body(body, request.headers, request.parsers_off,
                                 max_out=self.OVERSIZE_THRESHOLD + 1)
             if len(probe) > self.OVERSIZE_THRESHOLD:
-                # reroute the *fully unpacked* bytes (DoS-bounded inflate
-                # + extraction segments — the stream path itself does no
-                # JSON/XML extraction): Content-Encoding must go, or the
-                # stream's sniffer would re-inflate plaintext
-                unpacked = unpack_body(body, request.headers,
-                                       request.parsers_off)
-                plain_headers = {
-                    k: v for k, v in request.headers.items()
-                    if k.lower() != "content-encoding"}
-                return unpacked, plain_headers
+                return "unpack", body, request.headers
         return None
+
+    def _submit_oversized(self, request: Request, plan,
+                          fut: "Future[Verdict]") -> None:
+        """Hand one oversized request to the side worker; a full side
+        queue fails open immediately (bounded memory under a flood of
+        maximum-size bodies)."""
+        try:
+            self._oversized_q.put_nowait((request, plan, fut))
+        except queue.Full:
+            self.pipeline.stats.fail_open += 1
+            _safe_set(fut, Verdict(
+                request_id=request.request_id, blocked=False, attack=False,
+                classes=[], rule_ids=[], score=0, fail_open=True))
+
+    def _run_oversized(self) -> None:
+        while not self._stop.is_set():
+            try:
+                request, plan, fut = self._oversized_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._detect_oversized(request, plan, fut)
 
     def _detect_oversized(self, request: Request, plan,
                           fut: "Future[Verdict]") -> None:
-        """Run one oversized request through the stream engine inline
-        (dispatch thread, under the swap lock — same ownership as
-        _stream_step)."""
-        body, headers = plan
+        """Run one oversized request through the stream engine (the
+        oversized worker thread).  The swap lock is taken per STEP, not
+        for the whole body — batches interleave between chunks, so a
+        16MB body adds at most one chunk-scan of latency to any cycle
+        (round-2 advisor head-of-line fix).  The inflate runs entirely
+        off-lock.  A ruleset hot-swap mid-body is detected by the stream
+        engine's version check at finish and fails open, same as
+        in-flight wire streams."""
+        kind, body, headers = plan
         self.stats.oversized_rerouted += 1
         try:
+            if kind == "unpack":
+                # full DoS-bounded inflate + extraction, OFF the lock;
+                # Content-Encoding must go, or the stream's sniffer
+                # would re-inflate plaintext
+                body = unpack_body(body, headers, request.parsers_off)
+                headers = {k: v for k, v in headers.items()
+                           if k.lower() != "content-encoding"}
             meta = replace(request, body=b"", headers=headers)
-            h = self.stream_engine.begin(meta, body_cap=len(body))
-            h.base_hits = self.pipeline.prefilter([meta])[0]
+            with self._swap_lock:
+                h = self.stream_engine.begin(meta, body_cap=len(body))
+                h.base_hits = self.pipeline.prefilter([meta])[0]
             for i in range(0, len(body), self.OVERSIZE_CHUNK):
-                self.stream_engine.scan(
-                    h.feed(body[i:i + self.OVERSIZE_CHUNK]))
-            self.stream_engine.scan(h.flush())
-            v = self.stream_engine.finish(h)
+                inc = h.feed(body[i:i + self.OVERSIZE_CHUNK])
+                with self._swap_lock:
+                    self.stream_engine.scan(inc)
+            with self._swap_lock:
+                self.stream_engine.scan(h.flush())
+                v = self.stream_engine.finish(h)
         except Exception:
             self.pipeline.stats.fail_open += 1
             v = Verdict(request_id=request.request_id, blocked=False,
@@ -243,6 +282,7 @@ class Batcher:
     def close(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
+        self._oversized_thread.join(timeout=5)
 
     # ------------------------------------------------------------ loop
 
@@ -301,7 +341,7 @@ class Batcher:
                     except Exception:
                         plan = None   # fall back to the batched path
                     if plan is not None:
-                        self._detect_oversized(r, plan, fut)
+                        self._submit_oversized(r, plan, fut)
                     else:
                         normal.append(item)
                 requests = [r for _, r, _ in normal]

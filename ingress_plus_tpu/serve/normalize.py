@@ -145,6 +145,10 @@ class Request:
 
     method: str = "GET"
     uri: str = "/"
+    #: "" = unknown (the sidecar wire doesn't carry it yet): confirm
+    #: rules on REQUEST_PROTOCOL then abstain instead of evaluating a
+    #: fabricated default (review finding)
+    protocol: str = ""
     headers: Dict[str, str] = field(default_factory=dict)
     body: bytes = b""
     tenant: int = 0          # EP routing: Ingress/namespace index
@@ -182,6 +186,25 @@ class Request:
         if body:
             body = unpack_body(body, self.headers, self.parsers_off)
         return {"uri": uri, "args": args, "headers": hdr, "body": body}
+
+    def confirm_streams(self) -> Dict[str, bytes]:
+        """streams() plus the scalar pseudo-streams the confirm stage's
+        per-variable evaluator resolves (models/confirm.py
+        _SCALAR_BASES): REQUEST_METHOD/PROTOCOL/FILENAME/BASENAME and
+        the RAW query string (ModSecurity's QUERY_STRING is undecoded,
+        unlike the scanner's decoded args stream).  The scanner contract
+        is untouched — rows_for_requests iterates streams()."""
+        s = self.streams()
+        uri = s["uri"]
+        q = uri.find(b"?")
+        path = uri if q < 0 else uri[:q]
+        s["query"] = b"" if q < 0 else uri[q + 1:]
+        s["filename"] = path
+        s["basename"] = path.rsplit(b"/", 1)[-1]
+        s["method"] = self.method.encode("utf-8", "surrogateescape")
+        if self.protocol:   # unknown protocol stays absent → abstain
+            s["protocol"] = self.protocol.encode("utf-8", "surrogateescape")
+        return s
 
 
 @dataclass

@@ -209,8 +209,12 @@ class StreamState:
         if len(base) > scan_room:
             self.truncated = True
             base = base[:scan_room]
-        self.scanned_len += len(base)
         b64_inc = self.b64.feed(base) if (self.b64 and base) else b""
+        # scan_cap bounds TOTAL scanned bytes — the base64-decoded
+        # duplicate rows (src=1) are scanned too, so they consume budget
+        # (round-2 advisor: counting only base understated the per-stream
+        # DoS scan bound by up to 1.75x)
+        self.scanned_len += len(base) + len(b64_inc)
         out = []
         for vi, (_v, _sv, src) in enumerate(self.variants):
             inp = base if src == 0 else b64_inc
@@ -377,8 +381,8 @@ class StreamEngine:
         # accumulated body and must not run a decoder the scan stage had
         # disabled (the "both stages see identical bytes" contract)
         confirm_req = Request(
-            method=req.method, uri=req.uri, headers=req.headers,
-            body=bytes(st.acc), tenant=req.tenant,
+            method=req.method, uri=req.uri, protocol=req.protocol,
+            headers=req.headers, body=bytes(st.acc), tenant=req.tenant,
             request_id=req.request_id, mode=req.mode,
             parsers_off=req.parsers_off)
         v = p.finalize([confirm_req], hits, st.t0)[0]

@@ -139,7 +139,7 @@ def run_gate(n: int = 10_000, fuzz_per_attack: int = 2,
         pre = p.prefilter(chunk)                    # (Q, R) masked bool
         all_rules = p.mask_hits(chunk, np.ones((len(chunk), R), bool))
         for qi, req in enumerate(chunk):
-            streams = req.streams()
+            streams = req.confirm_streams()
             cache: Dict = {}
             confirmed_normal = {
                 int(r) for r in np.nonzero(pre[qi])[0]

@@ -355,11 +355,11 @@ def test_negated_pm_keeps_word_list():
     assert p.detect([Request(uri="/secret/path")])[0].attack
 
 
-def test_count_form_targets_abstain_not_rebind():
-    """'&REQUEST_HEADERS:Host' is the variable COUNT, which we don't
-    model: the rule must abstain (empty targets), NOT rebind to the args
-    text — '@eq 0' on args text (atoi 0) would block everything (review
-    finding)."""
+def test_count_form_targets_evaluated_exactly():
+    """'&REQUEST_HEADERS:Host' is the variable COUNT.  Round 2 could only
+    abstain (the selector was discarded and '@eq 0' on a text blob would
+    atoi to 0 and block everything); round 3 resolves the count exactly
+    from raw_targets in the confirm stage."""
     from ingress_plus_tpu.compiler.ruleset import compile_ruleset
     from ingress_plus_tpu.compiler.seclang import parse_seclang
     from ingress_plus_tpu.models.pipeline import DetectionPipeline
@@ -368,15 +368,20 @@ def test_count_form_targets_abstain_not_rebind():
     rules = parse_seclang(
         'SecRule &REQUEST_HEADERS:Host "@eq 0" '
         '"id:920280,phase:1,block,severity:CRITICAL,tag:\'attack-protocol\'"')
-    assert rules[0].targets == []
+    assert rules[0].targets == ["headers"]
+    assert rules[0].raw_targets == ["&REQUEST_HEADERS:Host"]
     p = DetectionPipeline(compile_ruleset(rules), mode="block",
                           anomaly_threshold=3)
+    # Host present -> count 1 -> @eq 0 false -> never fires
     for uri in ("/q?x=hello", "/q?x=42", "/plain"):
         v = p.detect([Request(uri=uri,
                               headers={"Host": "example.com"})])[0]
         assert not v.attack, uri
-    # mixed targets keep the evaluable part
+    # Host missing (but other headers present) -> count 0 -> fires
+    v = p.detect([Request(uri="/q", headers={"Accept": "*/*"})])[0]
+    assert v.attack and v.rule_ids == [920280]
+    # mixed targets: count form now keeps its base stream too
     rules = parse_seclang(
         'SecRule &ARGS|REQUEST_URI "@rx (?i)union\\s+select" '
         '"id:942999,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"')
-    assert rules[0].targets == ["uri"]
+    assert sorted(rules[0].targets) == ["args", "uri"]

@@ -1,0 +1,234 @@
+"""Per-variable confirm evaluation (round-3, advisor findings 1+2).
+
+The round-2 advisor verified two mass-false-positive generators:
+
+  1. (high) negated operators evaluated the WHOLE coarse stream — a
+     920160-shaped `REQUEST_HEADERS:Content-Length "!@rx ^\\d+$"` fired
+     on every request because the headers blob never matches ^\\d+$.
+  2. (medium) numeric operators atoi'd the whole stream text — a
+     `REQUEST_HEADERS:Content-Length "@eq 0"` blocked a request with
+     Content-Length: 500 because atoi("Host: ...") == 0.
+
+Round 3 carries the original SecLang variable tokens through the
+compiler (Rule.raw_targets -> confirm descriptor) and resolves
+subfield selectors / counts / exclusions exactly in the confirm stage
+(models/confirm.py _values_for).  These tests pin the advisor's own
+repro cases plus the surrounding semantics.
+"""
+
+from __future__ import annotations
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.models.confirm import ConfirmRule
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.normalize import Request
+
+
+def _pipeline(conf: str) -> DetectionPipeline:
+    return DetectionPipeline(compile_ruleset(parse_seclang(conf)),
+                             mode="block", anomaly_threshold=3)
+
+
+CL_NEGATED = ('SecRule REQUEST_HEADERS:Content-Length "!@rx ^\\d+$" '
+              '"id:920160,phase:1,block,severity:CRITICAL,'
+              'tag:\'attack-protocol\'"')
+
+
+def test_negated_rx_on_header_subfield_advisor_repro():
+    """The advisor's verified repro: Content-Length: 0 is benign and
+    must NOT be blocked by a !@rx ^\\d+$ rule on that header."""
+    p = _pipeline(CL_NEGATED)
+    benign = Request(uri="/upload", headers={
+        "Host": "example.com", "Content-Length": "0"})
+    assert not p.detect([benign])[0].attack
+    ok = Request(uri="/upload", headers={
+        "Host": "example.com", "Content-Length": "512"})
+    assert not p.detect([ok])[0].attack
+
+
+def test_negated_rx_on_header_subfield_still_detects():
+    """...and a genuinely malformed Content-Length still fires."""
+    p = _pipeline(CL_NEGATED)
+    bad = Request(uri="/upload", headers={
+        "Host": "example.com", "Content-Length": "13, 13"})
+    v = p.detect([bad])[0]
+    assert v.attack and v.rule_ids == [920160]
+
+
+def test_negated_rx_absent_variable_does_not_fire():
+    """ModSecurity: an absent variable is not evaluated at all — a
+    negated operator on a missing header must not fire."""
+    p = _pipeline(CL_NEGATED)
+    req = Request(uri="/q", headers={"Host": "example.com"})
+    assert not p.detect([req])[0].attack
+
+
+def test_numeric_eq_on_header_subfield_advisor_repro():
+    """The advisor's verified repro: '@eq 0' on Content-Length must not
+    block a request with Content-Length: 500."""
+    p = _pipeline('SecRule REQUEST_HEADERS:Content-Length "@eq 0" '
+                  '"id:920999,phase:1,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    ok = Request(uri="/q", headers={
+        "Host": "example.com", "Content-Length": "500"})
+    assert not p.detect([ok])[0].attack
+    zero = Request(uri="/q", headers={
+        "Host": "example.com", "Content-Length": "0"})
+    assert p.detect([zero])[0].attack
+
+
+def test_numeric_on_bare_collection_is_per_value():
+    """'ARGS "@gt 100"' compares each arg VALUE numerically (ModSec
+    semantics), not atoi of the whole query text."""
+    p = _pipeline('SecRule ARGS "@gt 100" '
+                  '"id:920998,phase:2,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    assert not p.detect([Request(uri="/q?a=5&b=weasel")])[0].attack
+    assert p.detect([Request(uri="/q?a=5&b=200")])[0].attack
+
+
+def test_target_exclusion_removes_variable():
+    """'ARGS|!ARGS:skip' must not evaluate the excluded member."""
+    p = _pipeline('SecRule ARGS|!ARGS:skip "@gt 100" '
+                  '"id:920997,phase:2,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    assert not p.detect([Request(uri="/q?skip=500&keep=5")])[0].attack
+    assert p.detect([Request(uri="/q?skip=5&keep=500")])[0].attack
+
+
+def test_headers_names_target():
+    p = _pipeline('SecRule REQUEST_HEADERS_NAMES "@rx ^x-evil" '
+                  '"id:920996,phase:1,block,severity:CRITICAL,'
+                  't:lowercase,tag:\'attack-protocol\'"')
+    assert p.detect([Request(uri="/", headers={"X-Evil-H": "1"})])[0].attack
+    assert not p.detect([Request(
+        uri="/", headers={"X-Good": "x-evil"})])[0].attack
+
+
+def test_request_method_negated_within():
+    """920100-shaped method allow-list: only fires on odd methods, and
+    only when the confirm streams carry the real method scalar."""
+    p = _pipeline('SecRule REQUEST_METHOD "!@within GET POST HEAD" '
+                  '"id:920995,phase:1,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    assert not p.detect([Request(method="GET", uri="/q?x=1")])[0].attack
+    assert p.detect([Request(method="TRACK", uri="/q?x=1")])[0].attack
+
+
+def test_cookie_subfield_extraction():
+    p = _pipeline('SecRule REQUEST_COOKIES:session "@rx \\.\\./" '
+                  '"id:930995,phase:1,block,severity:CRITICAL,'
+                  'tag:\'attack-lfi\'"')
+    bad = Request(uri="/", headers={"Cookie": "a=1; session=../../etc"})
+    assert p.detect([bad])[0].attack
+    ok = Request(uri="/", headers={"Cookie": "a=../x; session=fine"})
+    assert not p.detect([ok])[0].attack
+
+
+def test_legacy_descriptor_without_raw_targets_abstains_on_negation():
+    """Serialized round-2 rulesets have no raw_targets: negated/numeric
+    rules on collection streams must ABSTAIN (the advisor's minimal
+    guard), not mass-fire on the blob."""
+    legacy = ConfirmRule({
+        "op": "rx", "arg": "^\\d+$", "transforms": [], "fold": False,
+        "negate": True, "targets": ["headers"]})
+    streams = Request(uri="/", headers={"Host": "h"}).confirm_streams()
+    assert legacy.matches_streams(streams) is False
+    # ...while a scalar legacy stream (uri) still evaluates
+    legacy_uri = ConfirmRule({
+        "op": "rx", "arg": "^/app", "transforms": [], "fold": False,
+        "negate": True, "targets": ["uri"]})
+    assert legacy_uri.matches_streams(
+        Request(uri="/elsewhere").confirm_streams()) is True
+    assert legacy_uri.matches_streams(
+        Request(uri="/app/x").confirm_streams()) is False
+
+
+def test_positive_rx_keeps_whole_stream_superset():
+    """Positive pattern ops still see the whole coarse stream when the
+    selector can't narrow — the scanner/confirm byte-identity contract
+    (prefilter soundness) is unchanged for them."""
+    p = _pipeline('SecRule REQUEST_HEADERS "@rx union\\s+select" '
+                  '"id:942995,phase:1,block,severity:CRITICAL,'
+                  't:lowercase,tag:\'attack-sqli\'"')
+    bad = Request(uri="/", headers={"Referer": "x UNION  SELECT y"})
+    assert p.detect([bad])[0].attack
+
+
+def test_encoded_separator_does_not_fabricate_args():
+    """Pair splitting must happen on RAW query bytes before decoding:
+    '?q=a%26admin%3D1' is ONE arg q='a&admin=1', not a fabricated
+    admin=1 (review finding)."""
+    p = _pipeline('SecRule ARGS_NAMES "@streq admin" '
+                  '"id:920993,phase:2,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    assert not p.detect([Request(uri="/q?q=a%26admin%3D1")])[0].attack
+    assert p.detect([Request(uri="/q?admin=1")])[0].attack
+    # counts see one variable, not two
+    p2 = _pipeline('SecRule &ARGS "@gt 1" '
+                   '"id:920992,phase:2,block,severity:CRITICAL,'
+                   'tag:\'attack-protocol\'"')
+    assert not p2.detect([Request(uri="/q?q=a%26b%3D1")])[0].attack
+    assert p2.detect([Request(uri="/q?a=1&b=2")])[0].attack
+
+
+def test_unparseable_body_count_abstains_not_zero():
+    """A present-but-unparseable body must not report an exact count of
+    0 — '&ARGS_POST "@eq 0"' would block every large/JSON POST (review
+    finding).  An absent body IS a faithful 0."""
+    p = _pipeline('SecRule &ARGS_POST "@eq 0" '
+                  '"id:920991,phase:2,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    big_form = ("k=" + "v" * (1 << 17)).encode()   # too big to k/v-split
+    assert not p.detect([Request(method="POST", uri="/f",
+                                 body=big_form)])[0].attack
+    json_body = b'{"a": 1, "b=c": 2}'
+    assert not p.detect([Request(method="POST", uri="/f",
+                                 body=json_body)])[0].attack
+    # genuinely form-shaped with args present -> count > 0 -> no fire
+    assert not p.detect([Request(method="POST", uri="/f",
+                                 body=b"a=1&b=2")])[0].attack
+
+
+def test_valueless_parameter_is_a_variable():
+    """'?debug' exposes ARGS_NAMES 'debug' with an empty value, like
+    ModSecurity — not a dropped variable (review finding)."""
+    p = _pipeline('SecRule ARGS_NAMES "@streq debug" '
+                  '"id:920990,phase:2,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    assert p.detect([Request(uri="/q?debug")])[0].attack
+    assert not p.detect([Request(uri="/q?verbose")])[0].attack
+    p2 = _pipeline('SecRule &ARGS "@gt 0" '
+                   '"id:920989,phase:2,block,severity:CRITICAL,'
+                   'tag:\'attack-protocol\'"')
+    assert p2.detect([Request(uri="/q?debug")])[0].attack
+
+
+def test_unknown_protocol_abstains():
+    """The wire doesn't carry the HTTP protocol (yet): a negated
+    REQUEST_PROTOCOL rule must abstain on unknown, not evaluate a
+    fabricated HTTP/1.1 (review finding)."""
+    p = _pipeline('SecRule REQUEST_PROTOCOL "!@within HTTP/1.1 HTTP/2" '
+                  '"id:920988,phase:1,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    assert not p.detect([Request(uri="/q?x=1")])[0].attack       # unknown
+    assert not p.detect([Request(uri="/q?x=1",
+                                 protocol="HTTP/1.1")])[0].attack
+    assert p.detect([Request(uri="/q?x=1",
+                             protocol="HTTP/0.9")])[0].attack
+
+
+def test_chain_links_resolve_their_own_raw_targets():
+    conf = ('SecRule REQUEST_URI "@beginsWith /admin" '
+            '"id:920994,phase:1,block,severity:CRITICAL,chain,'
+            'tag:\'attack-protocol\'"\n'
+            'SecRule &REQUEST_HEADERS:Authorization "@eq 0" ""')
+    p = _pipeline(conf)
+    noauth = Request(uri="/admin/panel", headers={"Host": "h"})
+    assert p.detect([noauth])[0].attack
+    auth = Request(uri="/admin/panel",
+                   headers={"Host": "h", "Authorization": "Bearer t"})
+    assert not p.detect([auth])[0].attack
+    other = Request(uri="/public", headers={"Host": "h"})
+    assert not p.detect([other])[0].attack
