@@ -117,6 +117,13 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
 
         force_cpu_devices(1)
         platform, backend_err = "cpu", force_cpu_err
+    elif os.environ.get("BENCH_PLATFORM") == "cpu":
+        # explicit CPU run (smoke tests / CI): skip the ~8min TPU probe
+        # ladder entirely
+        from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices(1)
+        platform, backend_err = "cpu", None
     else:
         platform, backend_err = probe_backend()
     _PLATFORM_USED = platform
@@ -334,7 +341,150 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
     fn = sum(1 for lr, v in zip(sample, verdicts) if lr.is_attack and not v.attack)
     fp = sum(1 for lr, v in zip(sample, verdicts) if not lr.is_attack and v.attack)
     log("quality sample (128 req): tp=%d fn=%d fp=%d" % (tp, fn, fp))
+
+    # added-latency leg (BASELINE.md north star row 2: <2ms p99 added):
+    # C++ loadgen -> C++ sidecar -> in-process serve loop on the LIVE
+    # backend — the full production boundary chain.  Never fatal; the
+    # throughput headline above is already stashed.
+    try:
+        lat = run_latency_leg(cr, result.get("scan_impl", "pair"), platform)
+        if lat:
+            result.update(lat)
+            _HEADLINE = dict(result)
+    except Exception as e:
+        log("latency leg failed (non-fatal): %r" % (e,))
     return result
+
+
+def run_latency_leg(cr, scan_impl: str, platform: str,
+                    n_requests: int = 1024) -> dict:
+    """p50/p99 verdict latency through loadgen -> sidecar -> serve loop.
+
+    "Added latency" because the proxy (nginx module) waits exactly this
+    round-trip before forwarding; everything else in the request path is
+    untouched.  Measured at LOW concurrency (2 conns x 2 inflight) —
+    the 2ms budget is per-request added cost at sane load, not the
+    queueing delay of a saturated box (this rig is 1 vCPU; saturation
+    p99 is the throughput leg's business).  On this rig a TPU verdict
+    additionally crosses the ~70ms tunnel per dispatch, so the tpu
+    number measures the tunnel, not the design — the note field says
+    so; the CPU path is the deployable local bound.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+    import socket as socketmod
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sidecar_dir = os.path.join(repo, "native", "sidecar")
+    if shutil.which("g++") is None and not os.path.exists(
+            os.path.join(sidecar_dir, "loadgen")):
+        log("latency leg skipped: no g++/loadgen")
+        return {}
+    subprocess.run(["make", "-s", "-C", sidecar_dir],
+                   capture_output=True, timeout=180, check=True)
+
+    import asyncio
+
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.batcher import Batcher
+    from ingress_plus_tpu.serve.server import ServeLoop
+    from ingress_plus_tpu.utils.export_corpus import export
+
+    tmp = tempfile.mkdtemp(prefix="ipt_lat_")
+    srv_sock = os.path.join(tmp, "srv.sock")
+    side_sock = os.path.join(tmp, "side.sock")
+    pipeline = DetectionPipeline(cr, mode="block", scan_impl=scan_impl)
+    batcher = Batcher(pipeline)
+    serve = ServeLoop(batcher, srv_sock)
+    loop = asyncio.new_event_loop()
+
+    def runner():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(serve.start())
+        loop.run_forever()
+
+    t = threading.Thread(target=runner, daemon=True, name="ipt-lat-serve")
+    t.start()
+
+    def wait_sock(path, timeout_s=60):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if os.path.exists(path):
+                try:
+                    s = socketmod.socket(socketmod.AF_UNIX)
+                    s.connect(path)
+                    s.close()
+                    return True
+                except OSError:
+                    pass
+            time.sleep(0.05)
+        return False
+
+    sidecar = None
+    try:
+        if not wait_sock(srv_sock):
+            raise RuntimeError("serve loop socket never appeared")
+        sidecar = subprocess.Popen(
+            [os.path.join(sidecar_dir, "sidecar"), "--listen", side_sock,
+             "--upstream", srv_sock, "--deadline-ms", "30000"],
+            stderr=subprocess.DEVNULL)
+        if not wait_sock(side_sock):
+            raise RuntimeError("sidecar socket never appeared")
+        corpus_path = os.path.join(tmp, "c.bin")
+        export(corpus_path, n=512, seed=9, attack_fraction=0.2)
+        loadgen = os.path.join(sidecar_dir, "loadgen")
+        # warmup pass compiles the serving shapes (first-dispatch XLA
+        # compile would otherwise land in p99); same concurrency profile
+        # as the measurement so the same batch geometries are hit
+        subprocess.run(
+            [loadgen, "--socket", side_sock, "--corpus", corpus_path,
+             "--connections", "2", "--inflight", "2",
+             "--requests", "384"],
+            capture_output=True, timeout=300)
+        out = subprocess.run(
+            [loadgen, "--socket", side_sock, "--corpus", corpus_path,
+             "--connections", "2", "--inflight", "2",
+             "--requests", str(n_requests)],
+            capture_output=True, text=True, timeout=300)
+        if out.returncode != 0:
+            raise RuntimeError("loadgen rc=%d: %s"
+                               % (out.returncode, out.stderr[-300:]))
+        r = json.loads(out.stdout)
+        log("latency leg: p50=%dus p99=%dus rps=%.0f fail_open=%d (%s)"
+            % (r["p50_us"], r["p99_us"], r["rps"], r["fail_open"],
+               "loadgen->sidecar->serve"))
+        lat = {
+            "added_latency_p50_us": r["p50_us"],
+            "added_latency_p99_us": r["p99_us"],
+            "latency_leg": {
+                "path": "loadgen->sidecar->serve(%s)" % platform,
+                "requests": r["requests"], "rps": r["rps"],
+                "p90_us": r["p90_us"], "p999_us": r["p999_us"],
+                "fail_open": r["fail_open"],
+                "vs_2ms_budget": round(r["p99_us"] / 2000.0, 3),
+            },
+        }
+        if platform != "cpu":
+            lat["latency_leg"]["note"] = (
+                "per-dispatch verdicts cross the remote-TPU tunnel "
+                "(~70ms RTT) on this rig; deployed chips are host-local")
+        return lat
+    finally:
+        if sidecar is not None:
+            sidecar.terminate()
+
+        async def _shutdown():
+            for s in serve._servers:
+                s.close()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_shutdown(), loop).result(5)
+        except Exception:
+            pass
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        batcher.close()
 
 
 _EMIT_LOCK = threading.Lock()

@@ -7,14 +7,17 @@ metadata arrays + confirm descriptors.  The artifact serializes to disk
 "Checkpoint/resume": versioned compiled-NFA tables, atomically hot-swapped
 on device like the reference's proton.db sync-node flow).
 
-Scan-variant model: each request stream (uri/args/headers/body) is scanned
-in up to five normalization variants:
+Scan-variant model: each stream (uri/args/headers/body/resp_*) is scanned
+in up to six normalization variants:
 
-    0 raw         — bytes as received
-    1 urldec      — urlDecodeUni + removeNulls
-    2 urldec_html — urldec + htmlEntityDecode
-    3 squash_raw  — raw with all SQUASH_BYTES deleted (whitespace \\ ' " ^)
-    4 squash_dec  — urldec_html with all SQUASH_BYTES deleted
+    0 raw           — bytes as received
+    1 urldec        — urlDecodeUni + removeNulls
+    2 urldec_html   — urldec + htmlEntityDecode
+    3 squash_raw    — raw with all SQUASH_BYTES deleted (whitespace \\ ' " ^)
+    4 squash_dec    — urldec_html with all SQUASH_BYTES deleted
+    5 squash_urldec — urldec with all SQUASH_BYTES deleted (no html decode
+                      — that decode can DELETE factor bytes of rules whose
+                      chain doesn't include it)
 
 A rule is assigned the variant matching its transform chain, so factor
 matching stays *sound* (never misses) while the CPU confirm stage applies
@@ -37,6 +40,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import re
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -49,12 +53,20 @@ from ingress_plus_tpu.compiler.regex_ast import RegexUnsupported, parse_regex
 from ingress_plus_tpu.compiler.seclang import (
     CLASSES,
     CLASS_INDEX,
+    NON_SCANNED_SCALAR_BASES as F_NON_SCANNED,
     Rule,
     STREAMS,
     STREAM_INDEX,
 )
 
-VARIANTS = ("raw", "urldec", "urldec_html", "squash_raw", "squash_dec")
+#: scan-row normalization variants (serve/normalize.py variant_chain).
+#: "squash_urldec" (5) exists because htmlEntityDecode can DELETE factor
+#: bytes ("&#x61;" → "a" removes '#'): a ws-collapse+urlDecode rule whose
+#: own chain has NO html transform must be scanned on squash(urldec), not
+#: squash(html(urldec)) — the round-3 prefilter gate caught rule 942170
+#: losing its '#' factor to the html decode of the scanned row.
+VARIANTS = ("raw", "urldec", "urldec_html", "squash_raw", "squash_dec",
+            "squash_urldec")
 N_SV = len(STREAMS) * len(VARIANTS)  # stream-variant row space
 
 _DECODE_TRANSFORMS = {
@@ -73,6 +85,111 @@ _PATH_SEP_BYTES = frozenset([0x2F, 0x5C])  # / and \\
 SEVERITY_SCORE = {
     "CRITICAL": 5, "ERROR": 4, "WARNING": 3, "NOTICE": 2, "INFO": 1, "DEBUG": 1,
 }
+
+# ------------------------------------------------------- CRS anomaly mode
+# Real CRS v3 blocks via tx.anomaly_score accumulation: crs-setup.conf's
+# SecAction initializes the weights (tx.critical_anomaly_score=5, ...),
+# each rule does setvar:'tx.anomaly_score_pl1=+%{tx.critical_anomaly_
+# score}', and a 949-family rule blocks when TX:ANOMALY_SCORE >= the
+# threshold.  We resolve that WHOLE protocol AT COMPILE TIME: setvar
+# increments become the rule_score vector (anomaly accumulation is the
+# engine's score matmul — nothing per-request), and the 949 rule becomes
+# the pipeline's anomaly_threshold.  SURVEY.md §2.2 libmodsecurity row.
+
+#: CRS-standard weights, used when no SecAction overrides them — a bare
+#: CRS rules file without crs-setup.conf still scores canonically
+_TX_DEFAULTS = {
+    "critical_anomaly_score": "5",
+    "error_anomaly_score": "4",
+    "warning_anomaly_score": "3",
+    "notice_anomaly_score": "2",
+}
+
+_MACRO_RE = re.compile(r"%\{([^}]+)\}")
+
+
+def resolve_macros(text: str, env: Dict[str, str],
+                   max_depth: int = 5) -> Optional[str]:
+    """Expand %{tx.NAME} macros from the static TX env.  Returns None if
+    any macro stays unresolved (caller abstains / keeps the raw text)."""
+    for _ in range(max_depth):
+        if "%{" not in text:
+            return text
+
+        unresolved = False
+
+        def sub(m: "re.Match[str]") -> str:
+            nonlocal unresolved
+            name = m.group(1).strip().lower()
+            if name.startswith("tx."):
+                val = env.get(name[3:])
+                if val is not None:
+                    return val
+            unresolved = True
+            return m.group(0)
+
+        new = _MACRO_RE.sub(sub, text)
+        if unresolved:
+            return None
+        text = new
+    return None  # cyclic definitions
+
+
+def _apply_setvars(env: Dict[str, str], setvars: List[str]) -> None:
+    """Fold setvar actions into the static env (assignment form only —
+    '+='-style increments are per-request state, handled as rule
+    scores, not env mutations)."""
+    for sv in setvars:
+        name, sep, val = sv.partition("=")
+        if not sep:
+            continue
+        name = name.strip().lower()
+        if not name.startswith("tx."):
+            continue
+        val = val.strip()
+        if val.startswith("+") or val.startswith("-"):
+            continue   # per-request increment, not a config assignment
+        resolved = resolve_macros(val, env)
+        if resolved is not None:
+            env[name[3:]] = resolved
+
+
+def _anomaly_increment(rule: Rule, env: Dict[str, str]) -> Optional[int]:
+    """The rule's anomaly-score contribution from its setvar actions
+    ('tx.<x>anomaly_score<y>=+%{...}'), resolved statically; None when
+    the rule doesn't participate in anomaly scoring."""
+    for sv in rule.setvars:
+        name, sep, val = sv.partition("=")
+        if not sep or "anomaly_score" not in name.lower():
+            continue
+        val = val.strip()
+        if not val.startswith("+"):
+            continue
+        resolved = resolve_macros(val[1:].strip(), env)
+        if resolved is None:
+            continue
+        m = re.match(r"\s*(\d+)", resolved)
+        if m:
+            return int(m.group(1))
+    return None
+
+
+def _threshold_from_rule(rule: Rule, env: Dict[str, str]) -> Optional[int]:
+    """Detect the 949-style blocking rule: TX:...ANOMALY_SCORE '@ge N'
+    (N possibly a %{tx.*} macro).  Returns the resolved threshold."""
+    if rule.operator not in ("ge", "gt"):
+        return None
+    if not any("anomaly_score" in t.lower() and t.upper().startswith("TX")
+               for t in rule.raw_targets):
+        return None
+    resolved = resolve_macros(rule.argument.strip(), env)
+    if resolved is None:
+        return None
+    m = re.match(r"\s*(\d+)", resolved)
+    if not m:
+        return None
+    n = int(m.group(1))
+    return n + 1 if rule.operator == "gt" else n
 
 # NOTE on operator coverage: the per-operator branches in
 # _factor_group_for decide which operators contribute prefilter factors
@@ -171,6 +288,11 @@ class CompiledRuleset:
     rule_paranoia: np.ndarray   # (n_rules,) int32
     rule_ids: np.ndarray        # (n_rules,) int64 CRS ids
     version: str = ""
+    #: CRS anomaly-mode config resolved at compile time from SecAction
+    #: setvars + the 949-style threshold rule (None = pack doesn't use
+    #: anomaly mode; the pipeline then keeps its default threshold)
+    anomaly_threshold: Optional[int] = None
+    paranoia_hint: Optional[int] = None
 
     @property
     def n_rules(self) -> int:
@@ -214,6 +336,8 @@ class CompiledRuleset:
             # tags drive tenant (EP) rule-subset masks — must survive the
             # checkpoint roundtrip (control/tenants.py)
             "tags": [list(m.rule.tags) for m in self.rules],
+            "anomaly_threshold": self.anomaly_threshold,
+            "paranoia_hint": self.paranoia_hint,
         }
         path.with_suffix(".json").write_text(json.dumps(meta))
 
@@ -253,13 +377,21 @@ class CompiledRuleset:
             rule_class=z["rule_class"], rule_score=z["rule_score"],
             rule_action=z["rule_action"], rule_paranoia=z["rule_paranoia"],
             rule_ids=z["rule_ids"], version=meta["version"],
+            anomaly_threshold=meta.get("anomaly_threshold"),
+            paranoia_hint=meta.get("paranoia_hint"),
         )
 
 
 def _rule_variant(rule: Rule) -> int:
     t = set(rule.transforms)
     if t & _WS_COLLAPSE:
-        return 4 if t & (_DECODE_TRANSFORMS | _HTML_TRANSFORMS) else 3
+        if t & _HTML_TRANSFORMS:
+            return 4          # squash(html(urldec))
+        if t & _DECODE_TRANSFORMS:
+            return 5          # squash(urldec) — html decode would be
+                              # UNSOUND here (can delete factor bytes
+                              # the rule's own chain keeps)
+        return 3              # squash(raw)
     if t & _HTML_TRANSFORMS:
         return 2
     if t & _DECODE_TRANSFORMS:
@@ -307,6 +439,17 @@ def _factor_group_for(rule: Rule) -> Tuple[F.Group, Dict]:
         confirm["negate"] = True
         return [], confirm
 
+    # Targets whose text never appears in a scanned stream (the HTTP
+    # status/protocol/method scalars): a prefilter factor could never
+    # fire there, silently killing the rule — always-confirm instead
+    # (round-3 review: RESPONSE_STATUS "@rx ^5\d\d$" compiled a dead
+    # prefilter against resp_headers bytes).
+    if rule.raw_targets:
+        bases = {t.strip().lstrip("&!").split(":", 1)[0].upper()
+                 for t in rule.raw_targets if t.strip()}
+        if bases and bases <= F_NON_SCANNED:
+            return [], confirm
+
     # Soundness fix-ups for destructive transforms (see module docstring).
     t = set(rule.transforms)
     if t & _PATH_TRANSFORMS and group:
@@ -342,8 +485,55 @@ def compile_ruleset(
     ...) and negated operators get an empty factor group and ride the
     always-confirm path; nothing is silently dropped (a dropped CRS 920
     rule would be a silent protocol-check hole).
+
+    CRS anomaly mode resolves statically (see the "CRS anomaly mode"
+    block above): SecAction config rules fold into a TX env and are
+    dropped from the pack; per-rule setvar increments become
+    rule_score; the 949-style rule becomes ``anomaly_threshold``;
+    resolvable %{tx.*} macros in operator arguments are expanded so the
+    confirm stage sees literal values.
     """
-    scannable = list(rules)
+    # ---- pass 0: static TX environment + config-rule partition
+    env: Dict[str, str] = dict(_TX_DEFAULTS)
+    scannable = []
+    anomaly_threshold: Optional[int] = None
+    for rule in rules:
+        if (rule.operator == "unconditionalMatch" and not rule.raw_targets
+                and rule.setvars):
+            _apply_setvars(env, rule.setvars)   # SecAction config rule
+            continue
+        scannable.append(rule)
+    if "detection_paranoia_level" in env or "paranoia_level" in env:
+        try:
+            paranoia_hint: Optional[int] = int(
+                env.get("detection_paranoia_level",
+                        env.get("paranoia_level", "1")))
+        except ValueError:
+            paranoia_hint = None
+    else:
+        paranoia_hint = None
+    thr = env.get("inbound_anomaly_score_threshold")
+    if thr is not None and re.match(r"\s*\d+", thr):
+        anomaly_threshold = int(re.match(r"\s*(\d+)", thr).group(1))
+    for rule in scannable:
+        t = _threshold_from_rule(rule, env)
+        if t is not None:
+            anomaly_threshold = t
+        links = rule.chain
+        while links is not None:
+            t = _threshold_from_rule(links, env)
+            if t is not None:
+                anomaly_threshold = t
+            links = links.chain
+        # expand resolvable %{tx.*} macros in operator arguments so the
+        # confirm stage evaluates literals instead of abstaining
+        link: Optional[Rule] = rule
+        while link is not None:
+            if "%{" in link.argument:
+                resolved = resolve_macros(link.argument, env)
+                if resolved is not None:
+                    link.argument = resolved
+            link = link.chain
 
     metas: List[RuleMeta] = []
     groups: List[F.Group] = []
@@ -377,7 +567,15 @@ def compile_ruleset(
             sv = STREAM_INDEX[stream] * len(VARIANTS) + variant
             sv_mask[i, sv] = True
         rule_class[i] = CLASS_INDEX[rule.attack_class]
-        rule_score[i] = SEVERITY_SCORE.get(rule.severity.upper(), 3)
+        inc = _anomaly_increment(rule, env)
+        if inc is None and rule.chain is not None:
+            # CRS puts the setvar on the LAST chain link sometimes
+            link = rule.chain
+            while link is not None and inc is None:
+                inc = _anomaly_increment(link, env)
+                link = link.chain
+        rule_score[i] = (inc if inc is not None
+                         else SEVERITY_SCORE.get(rule.severity.upper(), 3))
         rule_action[i] = {"pass": 0, "block": 1, "deny": 2}[rule.action]
         rule_paranoia[i] = rule.paranoia
         rule_ids[i] = rule.rule_id
@@ -387,7 +585,8 @@ def compile_ruleset(
         tables=tables, rules=metas, rule_sv_mask=sv_mask,
         rule_class=rule_class, rule_score=rule_score,
         rule_action=rule_action, rule_paranoia=rule_paranoia,
-        rule_ids=rule_ids,
+        rule_ids=rule_ids, anomaly_threshold=anomaly_threshold,
+        paranoia_hint=paranoia_hint,
     )
     cr.version = cr.fingerprint()
     return cr

@@ -31,11 +31,16 @@ CLASS_RANGES = [
     (942000, 942999, "sqli"),
     (943000, 943999, "session"),
     (944000, 944999, "java"),
+    # response-side data-leakage families (CRS RESPONSE-95x): fired by
+    # the response scan path (serve-side PTPI frames), phase 4
+    (950000, 954999, "leak"),
 ]
 
+# "leak" is appended LAST: class ids ride the wire as u8 indexes
+# (protocol.py / protocol.hpp) — existing ids must stay stable.
 CLASSES = [
     "protocol", "scanner", "lfi", "rfi", "rce", "php", "nodejs",
-    "xss", "sqli", "session", "java", "generic",
+    "xss", "sqli", "session", "java", "generic", "leak",
 ]
 CLASS_INDEX = {c: i for i, c in enumerate(CLASSES)}
 
@@ -48,10 +53,13 @@ KNOWN_TARGETS = {
     "REQUEST_BASENAME": "uri",
     "REQUEST_FILENAME": "uri",
     "QUERY_STRING": "args",
-    "ARGS": "args",
+    # ModSecurity's ARGS is ARGS_GET ∪ ARGS_POST: both the query-args
+    # stream AND the body stream apply (a numeric/negated ARGS rule on a
+    # query-less POST must still reach confirm via a body row)
+    "ARGS": ("args", "body"),
     "ARGS_GET": "args",
     "ARGS_POST": "body",
-    "ARGS_NAMES": "args",
+    "ARGS_NAMES": ("args", "body"),
     "ARGS_GET_NAMES": "args",
     "ARGS_POST_NAMES": "body",
     "REQUEST_BODY": "body",
@@ -66,9 +74,37 @@ KNOWN_TARGETS = {
     "REQUEST_LINE": "uri",
     "REQUEST_METHOD": "uri",
     "REQUEST_PROTOCOL": "uri",
+    # ---- response side (phase 3/4 rules; wallarm_parse_response /
+    # wallarm-unpack-response analog — scanned from PTPI frames)
+    "RESPONSE_BODY": "resp_body",
+    "RESPONSE_HEADERS": "resp_headers",
+    "RESPONSE_HEADERS_NAMES": "resp_headers",
+    "RESPONSE_STATUS": "resp_headers",   # scalar resolved in confirm
+    "RESPONSE_PROTOCOL": "resp_headers",
 }
 
-STREAMS = ("uri", "args", "headers", "body")
+STREAMS = ("uri", "args", "headers", "body", "resp_headers", "resp_body")
+
+#: variable bases the engine recognizes but cannot scan (no byte stream):
+#: collections/scalars that exist only at confirm time (TX anomaly vars)
+#: or that we don't model (IP/SESSION persistence, env).  A rule whose
+#: every target is unscannable must ABSTAIN (empty targets), not rebind
+#: to args text.
+UNSCANNABLE_BASES = {
+    "TX", "IP", "GLOBAL", "SESSION", "USER", "ENV", "GEO", "TIME",
+    "DURATION", "REMOTE_ADDR", "REMOTE_HOST", "REMOTE_PORT", "AUTH_TYPE",
+    "MATCHED_VAR", "MATCHED_VARS", "MATCHED_VAR_NAME", "MATCHED_VARS_NAMES",
+    "UNIQUE_ID", "WEBSERVER_ERROR_LOG",
+}
+
+#: scalar bases whose text is NOT present in any scanned stream: their
+#: rules must compile with an empty factor group (always-confirm) — a
+#: prefilter factor could never fire, silently killing the rule (round-3
+#: review: RESPONSE_STATUS "^5\\d\\d$" factors can't match header bytes)
+NON_SCANNED_SCALAR_BASES = {
+    "RESPONSE_STATUS", "RESPONSE_PROTOCOL", "REQUEST_METHOD",
+    "REQUEST_PROTOCOL",
+}
 STREAM_INDEX = {s: i for i, s in enumerate(STREAMS)}
 
 
@@ -101,6 +137,12 @@ class Rule:
     negate: bool = False              # "!@op": match inverted (confirm-only
                                       # by construction — absence cannot be
                                       # prefiltered by factors)
+    #: raw setvar action values ("tx.anomaly_score_pl1=+%{tx.critical_
+    #: anomaly_score}") — the compiler resolves the CRS anomaly-scoring
+    #: pattern from these statically (compile-time macro resolution keeps
+    #: the runtime fully batched: anomaly accumulation IS the engine's
+    #: score matmul)
+    setvars: List[str] = field(default_factory=list)
 
     @property
     def attack_class(self) -> str:
@@ -202,14 +244,20 @@ def _parse_targets(text: str) -> List[str]:
         if t.startswith("&"):
             t = t[1:].strip()   # counting form: same base stream
         base = t.split(":", 1)[0].upper()
-        stream = KNOWN_TARGETS.get(base)
-        if stream and stream not in streams:
-            streams.append(stream)
-        saw_any = saw_any or stream is not None
+        if base in UNSCANNABLE_BASES:
+            saw_any = True      # recognized, but no stream to bind
+            continue
+        mapped = KNOWN_TARGETS.get(base)
+        for stream in ((mapped,) if isinstance(mapped, str)
+                       else (mapped or ())):
+            if stream not in streams:
+                streams.append(stream)
+        saw_any = saw_any or mapped is not None
     if streams:
         return streams
     # nothing usable: only fall back to args when the expression named
-    # NO target we recognize at all (legacy lenient behavior)
+    # NO target we recognize at all (legacy lenient behavior); an
+    # all-TX/-IP rule must abstain, not rebind to args text
     return [] if saw_any else ["args"]
 
 
@@ -236,7 +284,25 @@ def parse_seclang(
         if not tokens:
             continue
         directive = tokens[0]
-        if directive in ("SecMarker", "SecAction", "SecComponentSignature",
+        if directive == "SecAction":
+            # config-plane rule (CRS crs-setup.conf shape): no scan
+            # content, but its setvar actions initialize the TX
+            # environment (anomaly score weights, thresholds, paranoia
+            # level).  Emitted as an inert config Rule the compiler
+            # folds into the static TX env and drops from the pack.
+            actions = _parse_actions(tokens[1] if len(tokens) > 1 else "")
+            sv = [v.strip("'\"") for v in actions.get("setvar", []) if v]
+            if sv:
+                try:
+                    rid = int(actions.get("id", ["0"])[0] or 0)
+                except ValueError:
+                    rid = 0
+                rules.append(Rule(
+                    rule_id=rid, operator="unconditionalMatch",
+                    argument="", targets=[], raw_targets=[],
+                    action="pass", setvars=sv))
+            continue
+        if directive in ("SecMarker", "SecComponentSignature",
                          "SecRuleEngine", "SecRequestBodyAccess",
                          "SecDefaultAction", "SecCollectionTimeout"):
             continue  # engine-control directives: no scan content
@@ -318,6 +384,8 @@ def parse_seclang(
             paranoia=paranoia,
             phase=phase,
             negate=negate,
+            setvars=[v.strip("'\"") for v in actions.get("setvar", [])
+                     if v],
         )
 
         if pending_chain is not None:

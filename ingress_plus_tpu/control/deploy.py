@@ -60,6 +60,64 @@ class DeployValues:
     tenants: Dict[int, List[str]] = field(default_factory=dict)
 
 
+    @classmethod
+    def from_yaml(cls, text: str) -> "DeployValues":
+        """Parse the values file (deploy/values.yaml analog of the
+        chart's values.yaml†).  A deliberately tiny YAML subset — flat
+        ``key: value`` pairs plus one ``tenants:`` block mapping tenant
+        id to a tag list — because no YAML library is available in the
+        serve image and the operator surface is exactly DeployValues.
+        Unknown keys are a hard error (a typo'd key silently keeping
+        its default is how bad deploys ship)."""
+        v = cls()
+        fields = {f: type(getattr(v, f)) for f in v.__dataclass_fields__}
+        in_tenants = False
+        for ln, raw in enumerate(text.splitlines(), 1):
+            line = raw.split("#", 1)[0].rstrip()
+            if not line.strip():
+                continue
+            if in_tenants and (line.startswith("  ") or
+                               line.startswith("\t")):
+                key, _, val = line.strip().partition(":")
+                try:
+                    tid = int(key.strip())
+                except ValueError:
+                    raise ValueError("values.yaml:%d: tenant id %r is "
+                                     "not an integer" % (ln, key))
+                tags = [t.strip().strip("'\"")
+                        for t in val.strip().strip("[]").split(",")
+                        if t.strip()]
+                v.tenants[tid] = tags
+                continue
+            in_tenants = False
+            if line != line.lstrip():
+                raise ValueError("values.yaml:%d: unexpected indent %r"
+                                 % (ln, raw))
+            key, sep, val = line.partition(":")
+            key = key.strip().replace("-", "_")
+            if not sep:
+                raise ValueError("values.yaml:%d: expected key: value, "
+                                 "got %r" % (ln, raw))
+            if key == "tenants":
+                in_tenants = True
+                continue
+            if key not in fields:
+                raise ValueError("values.yaml:%d: unknown key %r "
+                                 "(valid: %s)" % (ln, key,
+                                                  ", ".join(sorted(fields))))
+            val = val.strip().strip("'\"")
+            ftype = fields[key]
+            if ftype is bool:
+                setattr(v, key, val.lower() in ("true", "1", "yes", "on"))
+            elif ftype is int:
+                setattr(v, key, int(val))
+            elif ftype is float:
+                setattr(v, key, float(val))
+            else:
+                setattr(v, key, val)
+        return v
+
+
 def _serve_socket(i: int) -> str:
     return "/run/ipt/serve-%d.sock" % i
 
@@ -237,9 +295,19 @@ def write_static(outdir: str | Path,
 
 
 if __name__ == "__main__":
-    import sys
+    import argparse
 
-    target = sys.argv[1] if len(sys.argv) > 1 else \
-        Path(__file__).resolve().parents[2] / "deploy" / "static"
-    for f in write_static(target):
+    repo = Path(__file__).resolve().parents[2]
+    ap = argparse.ArgumentParser(prog="ingress_plus_tpu.control.deploy")
+    ap.add_argument("outdir", nargs="?",
+                    default=str(repo / "deploy" / "static"))
+    ap.add_argument("--values", default=str(repo / "deploy" / "values.yaml"),
+                    help="values file driving the render (the chart's "
+                         "values.yaml analog)")
+    args = ap.parse_args()
+    values = None
+    if Path(args.values).exists():
+        values = DeployValues.from_yaml(Path(args.values).read_text())
+        print("values: %s" % args.values)
+    for f in write_static(args.outdir, values):
         print("wrote %s" % f)

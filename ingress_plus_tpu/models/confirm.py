@@ -194,15 +194,25 @@ NUMERIC_OPS = frozenset(("eq", "ge", "gt", "le", "lt"))
 _SCALAR_BASES = {
     "REQUEST_URI": "uri",
     "REQUEST_URI_RAW": "uri",
-    "REQUEST_LINE": "uri",
     "REQUEST_BODY": "body",
-    "XML": "body",
-    "JSON": "body",
     "REQUEST_METHOD": "method",
     "REQUEST_PROTOCOL": "protocol",
     "REQUEST_FILENAME": "filename",
     "REQUEST_BASENAME": "basename",
     "QUERY_STRING": "query",
+    "RESPONSE_BODY": "resp_body",
+    "RESPONSE_STATUS": "status",
+}
+
+#: bases that only approximate to a coarse blob (REQUEST_LINE has no
+#: method/protocol in the uri stream; XML:/JSON: selectors address
+#: nodes we don't model): positive pattern ops get the blob superset,
+#: negated/numeric ops abstain (round-3 review: marking these exact
+#: made '!@rx ^(GET|POST)' on REQUEST_LINE fire on every request)
+_BLOB_BASES = {
+    "REQUEST_LINE": "uri",
+    "XML": "body",
+    "JSON": "body",
 }
 
 #: collection bases → (parser kind, which part of the k/v pair)
@@ -211,28 +221,39 @@ _COLLECTION_BASES = {
     "REQUEST_HEADERS_NAMES": ("headers", "names"),
     "REQUEST_COOKIES": ("cookies", "values"),
     "REQUEST_COOKIES_NAMES": ("cookies", "names"),
-    "ARGS": ("args", "values"),
+    "ARGS": ("args", "values"),          # ARGS_GET ∪ ARGS_POST
     "ARGS_NAMES": ("args", "names"),
-    "ARGS_GET": ("args", "values"),
-    "ARGS_GET_NAMES": ("args", "names"),
+    "ARGS_GET": ("queryargs", "values"),
+    "ARGS_GET_NAMES": ("queryargs", "names"),
     "ARGS_POST": ("bodyargs", "values"),
     "ARGS_POST_NAMES": ("bodyargs", "names"),
     "FILES": ("bodyargs", "values"),
     "FILES_NAMES": ("bodyargs", "names"),
+    "RESPONSE_HEADERS": ("resp_headers", "values"),
+    "RESPONSE_HEADERS_NAMES": ("resp_headers", "names"),
 }
 
 
 def _looks_like_form(body: bytes) -> bool:
-    """Heuristic for ARGS_POST without a content-type at hand: a
+    """Heuristic for ARGS_POST when no content-type is available: a
     form-urlencoded body is k=v pairs with no raw control bytes.  A
     JSON/XML/binary body must NOT be k/v-split (mis-parsed pairs would
     feed wrong values to negated ops)."""
     if len(body) > 1 << 16 or b"=" not in body:
         return False
     head = body[:256]
-    if head[:1] in (b"{", b"[", b"<"):
+    if head[:1] in (b"{", b"[", b"<") or head[:2] == b"--":
         return False
     return not any(c < 9 or (13 < c < 32) for c in head)
+
+
+def _body_content_type(streams: Dict[str, bytes],
+                       cache: Optional[Dict]) -> bytes:
+    """Lowercased Content-Type header value (b"" when absent)."""
+    for lo, _n, v in (_parse_collection("headers", streams, cache) or ()):
+        if lo == b"content-type":
+            return v.lower()
+    return b""
 
 
 def _split_form(raw: bytes, decode: bool) -> List[tuple]:
@@ -268,8 +289,8 @@ def _parse_collection(kind: str, streams: Dict[str, bytes],
     if cache is not None and ck in cache:
         return cache[ck]
     out: Optional[List[tuple]]
-    if kind == "headers":
-        blob = streams.get("headers")
+    if kind in ("headers", "resp_headers"):
+        blob = streams.get(kind)
         out = []
         for unit in (blob.split(b"\x1f") if blob else ()):
             name, sep, val = unit.partition(b":")
@@ -288,7 +309,7 @@ def _parse_collection(kind: str, streams: Dict[str, bytes],
                 k = k.strip()
                 if k:
                     out.append((k.lower(), k, v.strip()))
-    elif kind == "args":
+    elif kind == "queryargs":
         # prefer the RAW query (confirm_streams provides it); the
         # decoded args blob is a legacy fallback where encoded '&'/'='
         # can't be distinguished — still split-then-nothing, since the
@@ -301,12 +322,28 @@ def _parse_collection(kind: str, streams: Dict[str, bytes],
             out = _split_form(blob, decode=False) if blob else []
     elif kind == "bodyargs":
         blob = streams.get("body")
+        ct = _body_content_type(streams, cache)
         if not blob:
             out = []
-        elif _looks_like_form(blob):
+        elif b"multipart/form-data" in ct:
+            # splitting multipart on '&'/'=' fabricates pairs (round-3
+            # review); faithful node values need a multipart parser we
+            # don't model — abstain
+            out = None
+        elif (b"application/x-www-form-urlencoded" in ct
+              or (not ct and _looks_like_form(blob))):
             out = _split_form(blob, decode=True)
         else:
-            out = None   # present but not a form: abstain, don't report 0
+            # non-form body: ModSecurity's ARGS_POST is empty here (the
+            # JSON/XML processors feed different collections)
+            out = []
+    elif kind == "args":
+        # ModSecurity's ARGS is ARGS_GET ∪ ARGS_POST (round-3 review:
+        # query-only counts fabricated '&ARGS @eq 0' hits on POSTs);
+        # an abstaining body parse makes the whole union abstain
+        q = _parse_collection("queryargs", streams, cache)
+        b = _parse_collection("bodyargs", streams, cache)
+        out = None if (q is None or b is None) else q + b
     else:
         out = None
     if cache is not None:
@@ -426,7 +463,9 @@ class ConfirmRule:
                 # finding); positive pattern ops keep the blob superset
                 if not count and sel is None:
                     coarse = {"headers": "headers", "cookies": "headers",
-                              "args": "args", "bodyargs": "body"}[kind]
+                              "args": "args", "queryargs": "args",
+                              "bodyargs": "body",
+                              "resp_headers": "resp_headers"}[kind]
                     blob = streams.get(coarse)
                     if blob:
                         yield blob, False, False
@@ -444,6 +483,13 @@ class ConfirmRule:
                 for v in vals:
                     yield v, True, False
             return
+        blob_stream = _BLOB_BASES.get(base)
+        if blob_stream is not None:
+            if not count:
+                blob = streams.get(blob_stream)
+                if blob:
+                    yield blob, False, False
+            return  # counts on blob-approximated bases abstain
         stream = _SCALAR_BASES.get(base)
         if stream is None:
             return  # unknown base: abstain

@@ -272,34 +272,38 @@ class DetectionEngine:
         n_sv = self.tables.rule_sv.shape[1]
         row_sv = jnp.asarray(np.ones((B, n_sv), np.int8))
         tables = self.tables
-        W = tables.scan.n_words
         scanner = (self._pallas_scanner() if "pallas" in candidates
                    else None)
         interpret = self.pallas_interpret
 
         def make_chain(impl):
+            # inputs are jit ARGUMENTS, not closure constants — closed-over
+            # device arrays become compile-time constants and XLA spends
+            # seconds constant-folding the scan chain's scatter-max
+            # (BENCH_r02 tail; the serve-startup log showed the same fold
+            # here in jit(chain))
             @functools.partial(jax.jit, static_argnames=("kk",))
-            def chain(kk: int):
+            def chain(kk: int, tabs, tok, lens, rreq, rsv):
                 def body(i, carry):
                     acc, state, match = carry
                     if impl == "pallas":
-                        match, state = scanner(tokens, lengths,
+                        match, state = scanner(tok, lens,
                                                state=state, match=match,
                                                interpret=interpret)
                         rh, _, _ = map_match_words(
-                            tables, match, row_req, row_sv, 8)
+                            tabs, match, rreq, rsv, 8)
                     elif impl == "pair":
                         rh, _, _, match, state = detect_rows(
-                            tables, tokens, lengths, row_req, row_sv, 8,
+                            tabs, tok, lens, rreq, rsv, 8,
                             match=match, scan_impl="pair")
                     else:
                         rh, _, _, match, state = detect_rows(
-                            tables, tokens, lengths, row_req, row_sv, 8,
+                            tabs, tok, lens, rreq, rsv, 8,
                             state=state, match=match, scan_impl="take")
                     return (acc + match.sum()
                             + rh.sum().astype(jnp.uint32), state, match)
 
-                z = jnp.zeros((B, W), jnp.uint32)
+                z = jnp.zeros((B, tabs.scan.n_words), jnp.uint32)
                 acc, _, _ = jax.lax.fori_loop(
                     0, kk, body, (jnp.zeros((), jnp.uint32), z, z))
                 return acc
@@ -309,7 +313,9 @@ class DetectionEngine:
         for impl in candidates:
             try:
                 chain = make_chain(impl)
-                dt = k_diff_time(lambda kk, rep: chain(kk), k, n=n)
+                dt = k_diff_time(
+                    lambda kk, rep: chain(kk, tables, tokens, lengths,
+                                          row_req, row_sv), k, n=n)
                 # <=0 means RTT jitter swamped the compute delta — treat
                 # as no-signal, not as infinitely fast
                 timings[impl] = dt if dt > 0 else float("inf")

@@ -80,15 +80,23 @@ class DetectionPipeline:
         self,
         ruleset: CompiledRuleset,
         mode: str = "block",
-        anomaly_threshold: int = 5,
+        anomaly_threshold: Optional[int] = None,
         fail_open: bool = True,
-        paranoia_level: int = 2,
+        paranoia_level: Optional[int] = None,
         tenant_rule_mask: Optional[np.ndarray] = None,  # (T, R) bool
         scan_impl: str = "pair",
     ):
         self.engine = DetectionEngine(ruleset, scan_impl=scan_impl)
         self.mode = mode
+        # precedence for both knobs: explicit arg > the pack's compiled
+        # CRS config (SecAction setvars / 949-style rule) > classic
+        # defaults (threshold 5, PL2)
+        if anomaly_threshold is None:
+            anomaly_threshold = getattr(ruleset, "anomaly_threshold",
+                                        None) or 5
         self.anomaly_threshold = anomaly_threshold
+        if paranoia_level is None:
+            paranoia_level = getattr(ruleset, "paranoia_hint", None) or 2
         self.fail_open = fail_open
         self.stats = PipelineStats()
         self.tenant_rule_mask = tenant_rule_mask
@@ -107,10 +115,12 @@ class DetectionPipeline:
             int(sv) for sv in np.nonzero(ruleset.rule_sv_mask.any(axis=0))[0])
 
     def swap_ruleset(self, ruleset: CompiledRuleset,
-                     paranoia_level: int = 2) -> None:
+                     paranoia_level: Optional[int] = None) -> None:
         """Hot-swap (proton.db sync-node analog): atomic from the caller's
         perspective — in-flight batches finish on the old tables."""
         self.engine.swap_ruleset(ruleset)
+        if paranoia_level is None:   # same precedence as __init__
+            paranoia_level = getattr(ruleset, "paranoia_hint", None) or 2
         self._install(ruleset, paranoia_level)
 
     def warm_shape(self, B: int, L: int, Q_pad: int) -> None:

@@ -245,20 +245,23 @@ def consolidate_once(spool_dir: str | Path, url: Optional[str] = None,
             live.rename(claimed)
         except OSError:
             pass
-    def _unlink_claimed(f: Path, nread: int) -> None:
+    def _unlink_claimed(f: Path, keep_from: int, nread: int) -> None:
         """Unlink a processed .sending file WITHOUT dropping bytes a
         still-in-flight writer appended after our read (round-2 advisor:
         the claim-rename can land mid-append; the writer's completed
         tail would die with the unlink).  Only ONE burst can race — the
         writer re-opens by name each cycle and the name now points to a
         fresh live file — so: wait for the size to go stable (bounded),
-        then requeue any appended tail as a new .sending."""
+        then requeue everything from ``keep_from`` (the last PARSED line
+        boundary, so a record straddling the read boundary is requeued
+        whole, torn prefix included — round-3 review) as a new .sending.
+        """
         try:
             size = f.stat().st_size
             # wait for STABILITY (size stops changing), not equality
             # with nread — once a tail exists the size can never re-equal
             # nread, and an in-flight flush straddling the window would
-            # still be torn (review finding); no tail costs zero sleeps
+            # still be torn; no tail costs zero sleeps
             for _ in range(5):
                 if size == nread:
                     break
@@ -267,12 +270,18 @@ def consolidate_once(spool_dir: str | Path, url: Optional[str] = None,
                 if size == prev:
                     break
             if size > nread:
+                # bytes WERE appended after our read: requeue from the
+                # line boundary so the straddled record survives whole.
+                # (With no append, a torn final fragment is dropped as
+                # before — requeueing it unconditionally would loop
+                # forever on a fragment no writer will ever complete.)
                 with f.open("rb") as fh:
-                    fh.seek(nread)
+                    fh.seek(keep_from)
                     tail = fh.read()
-                requeued = spool / ("attacks.%d_tail.sending"
-                                    % int(time.time() * 1e6))
-                requeued.write_bytes(tail)
+                if tail.strip():
+                    requeued = spool / ("attacks.%d_tail.sending"
+                                        % int(time.time() * 1e6))
+                    requeued.write_bytes(tail)
             f.unlink()
         except OSError:
             pass  # transient; the whole file is retried next cycle
@@ -282,6 +291,9 @@ def consolidate_once(spool_dir: str | Path, url: Optional[str] = None,
             raw = f.read_bytes()
         except OSError:
             continue  # transient; retried next cycle
+        # start of the trailing incomplete line (== len(raw) if none):
+        # the requeue boundary for a record straddling this read
+        boundary = len(raw) if raw.endswith(b"\n") else raw.rfind(b"\n") + 1
         text = raw.decode("utf-8", "replace")
         # salvage line-by-line: a torn line from a partial append must not
         # discard the batch's valid records (at-least-once contract)
@@ -294,7 +306,7 @@ def consolidate_once(spool_dir: str | Path, url: Optional[str] = None,
             except json.JSONDecodeError:
                 pass
         if not records:
-            _unlink_claimed(f, len(raw))
+            _unlink_claimed(f, boundary, len(raw))
             continue
         if url:
             try:
@@ -308,7 +320,7 @@ def consolidate_once(spool_dir: str | Path, url: Optional[str] = None,
             with (out / "attacks.jsonl").open("a") as fh:
                 for r in records:
                     fh.write(json.dumps(r) + "\n")
-        _unlink_claimed(f, len(raw))
+        _unlink_claimed(f, boundary, len(raw))
         n += len(records)
     return n
 

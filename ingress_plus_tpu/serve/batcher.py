@@ -236,7 +236,7 @@ class Batcher:
         is atomic; the dispatch thread skips aborted streams)."""
         handle.aborted = True
 
-    def swap_ruleset(self, ruleset, paranoia_level: int = 2) -> None:
+    def swap_ruleset(self, ruleset, paranoia_level=None) -> None:
         """Hot-swap (sync-node† analog), zero serve gap:
 
         1. OFF-lock: build a complete new pipeline and pre-compile every
@@ -283,6 +283,18 @@ class Batcher:
         self._stop.set()
         self._thread.join(timeout=5)
         self._oversized_thread.join(timeout=5)
+        # items still queued on the side lane would strand their futures
+        # (connection handlers block forever) — resolve them fail-open
+        # (round-3 review)
+        while True:
+            try:
+                request, _plan, fut = self._oversized_q.get_nowait()
+            except queue.Empty:
+                break
+            self.pipeline.stats.fail_open += 1
+            _safe_set(fut, Verdict(
+                request_id=request.request_id, blocked=False, attack=False,
+                classes=[], rule_ids=[], score=0, fail_open=True))
 
     # ------------------------------------------------------------ loop
 

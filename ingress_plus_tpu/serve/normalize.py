@@ -136,6 +136,11 @@ def variant_chain(data: bytes, variant: int) -> bytes:
         return squash(data)
     if variant == 4:
         return squash(dec_html)
+    if variant == 5:
+        # ws-collapse + urlDecode WITHOUT html decode: html entity decode
+        # deletes factor bytes ("&#x61;" → "a") that such a rule's own
+        # transform chain keeps — prefilter-gate finding, round 3
+        return squash(dec)
     raise ValueError("unknown variant %d" % variant)
 
 
@@ -158,6 +163,9 @@ class Request:
     parsers_off: frozenset = frozenset()   # wallarm-parser-disable analog;
                              # per-location disables also ride the
                              # x-detect-tpu-parser-disable header
+
+    #: which stream the StreamEngine chunk-scans (Response: "resp_body")
+    body_stream = "body"
 
     def streams(self) -> Dict[str, bytes]:
         """stream name → base bytes (the 4 scan streams).
@@ -204,6 +212,50 @@ class Request:
         s["method"] = self.method.encode("utf-8", "surrogateescape")
         if self.protocol:   # unknown protocol stays absent → abstain
             s["protocol"] = self.protocol.encode("utf-8", "surrogateescape")
+        return s
+
+
+@dataclass
+class Response:
+    """Neutral upstream-HTTP-response model (the wallarm_parse_response /
+    wallarm-unpack-response analog — SURVEY.md §2.1/§2.2 response rows).
+
+    Duck-typed to flow through the SAME pipeline as Request (streams(),
+    confirm_streams(), tenant/mode/request_id): response rules compile
+    into the same ruleset with sv bits on the resp_* streams, so a
+    response scan is just a detect() over different rows — request rules
+    can't fire (their streams are absent) and vice versa."""
+
+    status: int = 200
+    headers: Dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+    tenant: int = 0
+    request_id: str = ""
+    mode: int = 2
+    parsers_off: frozenset = frozenset()
+
+    #: StreamEngine scans this stream for chunked/oversized bodies
+    body_stream = "resp_body"
+    #: postanalytics (post/channel.py Hit) records responses with a
+    #: sentinel method and no uri — leak hits aggregate per tenant/client
+    method = "RESPONSE"
+    uri = ""
+
+    def streams(self) -> Dict[str, bytes]:
+        hdr = b"\x1f".join(
+            ("%s: %s" % (k, v)).encode("utf-8", "surrogateescape")
+            for k, v in self.headers.items())
+        body = self.body
+        if body:
+            # same unpack stage as requests (wallarm-unpack-response):
+            # gzip/base64/json/xml wrapped response bodies are scanned
+            # decoded, honoring the same parser disables
+            body = unpack_body(body, self.headers, self.parsers_off)
+        return {"resp_headers": hdr, "resp_body": body}
+
+    def confirm_streams(self) -> Dict[str, bytes]:
+        s = self.streams()
+        s["status"] = str(self.status).encode()
         return s
 
 

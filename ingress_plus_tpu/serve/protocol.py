@@ -46,6 +46,20 @@ Chunk frame (client → server):
 The verdict response is sent after the last chunk (the reference's
 incremental body parse† finishes at body end the same way).
 
+Response-scan frame (client → server; the wallarm_parse_response /
+wallarm-unpack-response analog — upstream HTTP responses scanned for the
+95x leakage families; verdict returns as a normal RTPI frame):
+    magic   u32  'PTPI' (b"PTPI")
+    length  u32
+    req_id  u64
+    tenant  u32
+    mode    u8   — same bits as the request frame (parser disables honor
+                   detect_tpu_unpack_response); MODE_STREAM unused
+    status  u16  — upstream HTTP status code
+    hdr_len u32  — response headers blob, same "key: value\\x1f" layout
+    body_len u32
+    bytes: headers, body
+
 Responses may arrive out of order; req_id correlates.
 """
 
@@ -56,15 +70,17 @@ from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 from ingress_plus_tpu.compiler.seclang import CLASSES
-from ingress_plus_tpu.serve.normalize import Request
+from ingress_plus_tpu.serve.normalize import Request, Response
 
 REQ_MAGIC = b"QTPI"
 RESP_MAGIC = b"RTPI"
 CHUNK_MAGIC = b"KTPI"
+RSCAN_MAGIC = b"PTPI"
 
 _REQ_HEAD = struct.Struct("<QIBB III")   # req_id tenant mode m_len | uri hdr body
 _RESP_HEAD = struct.Struct("<QBIBH")     # req_id flags score n_cls n_rules
 _CHUNK_HEAD = struct.Struct("<QB")       # req_id flags
+_RSCAN_HEAD = struct.Struct("<QIBH II")  # req_id tenant mode status | hdr body
 
 FLAG_ATTACK = 1
 FLAG_BLOCKED = 2
@@ -144,6 +160,45 @@ def decode_request(payload: bytes) -> Tuple[int, int, Request]:
         name for name, bit in PARSER_OFF_BITS.items() if mode & bit)
     return req_id, mode & ~_PARSER_MASK, Request(
         method=method, uri=uri, headers=headers, body=body, tenant=tenant,
+        request_id=str(req_id), parsers_off=parsers_off)
+
+
+def encode_response_scan(resp: Response, req_id: int, mode: int = 2) -> bytes:
+    for p in resp.parsers_off:
+        mode |= PARSER_OFF_BITS.get(p, 0)
+    hdr = b"\x1f".join(
+        ("%s: %s" % (k, v)).encode("utf-8", "surrogateescape")
+        for k, v in resp.headers.items())
+    payload = _RSCAN_HEAD.pack(req_id, resp.tenant, mode,
+                               resp.status & 0xFFFF, len(hdr),
+                               len(resp.body))
+    payload += hdr + resp.body
+    return RSCAN_MAGIC + struct.pack("<I", len(payload)) + payload
+
+
+def decode_response_scan(payload: bytes) -> Tuple[int, int, Response]:
+    """payload after magic+length.  Returns (req_id, mode, Response)."""
+    if len(payload) < _RSCAN_HEAD.size:
+        raise ProtocolError("short response-scan frame")
+    req_id, tenant, mode, status, hdr_len, body_len = \
+        _RSCAN_HEAD.unpack_from(payload)
+    off = _RSCAN_HEAD.size
+    if len(payload) != off + hdr_len + body_len:
+        raise ProtocolError("response-scan frame length mismatch")
+    headers = {}
+    hdr = payload[off:off + hdr_len]
+    off += hdr_len
+    if hdr:
+        for pair in hdr.split(b"\x1f"):
+            k, _, v = pair.partition(b": ")
+            if k:
+                headers[k.decode("utf-8", "surrogateescape")] = \
+                    v.decode("utf-8", "surrogateescape")
+    body = payload[off:off + body_len]
+    parsers_off = frozenset(
+        name for name, bit in PARSER_OFF_BITS.items() if mode & bit)
+    return req_id, mode & ~_PARSER_MASK, Response(
+        status=status, headers=headers, body=body, tenant=tenant,
         request_id=str(req_id), parsers_off=parsers_off)
 
 

@@ -28,10 +28,12 @@ from ingress_plus_tpu.serve.protocol import (
     CHUNK_MAGIC,
     MODE_STREAM,
     REQ_MAGIC,
+    RSCAN_MAGIC,
     MultiFrameReader,
     ProtocolError,
     decode_chunk,
     decode_request,
+    decode_response_scan,
     encode_response,
 )
 
@@ -56,7 +58,8 @@ class ServeLoop:
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
         self.connections += 1
-        frames = MultiFrameReader({REQ_MAGIC: "req", CHUNK_MAGIC: "chunk"})
+        frames = MultiFrameReader({REQ_MAGIC: "req", CHUNK_MAGIC: "chunk",
+                                   RSCAN_MAGIC: "rscan"})
         loop = asyncio.get_running_loop()
         streams = {}  # req_id → StreamState | None (None = mode-off stream)
         write_lock = asyncio.Lock()
@@ -138,7 +141,16 @@ class ServeLoop:
                             task.add_done_callback(_sdone)
                         continue
                     try:
-                        req_id, mode, request = decode_request(payload)
+                        if kind == "rscan":
+                            # response-side analysis (wallarm_parse_response
+                            # analog): a Response flows through the SAME
+                            # batcher/pipeline — its rows carry resp_*
+                            # stream ids, so only 95x-family rules apply
+                            req_id, mode, request = \
+                                decode_response_scan(payload)
+                            mode &= ~MODE_STREAM   # undefined for rscan
+                        else:
+                            req_id, mode, request = decode_request(payload)
                     except ProtocolError:
                         continue
                     if mode & MODE_STREAM:
@@ -356,8 +368,9 @@ class ServeLoop:
                 if not isinstance(spec, dict):
                     raise ValueError("payload must be a JSON object")
                 cr = CompiledRuleset.load(spec["path"])
+                pl = spec.get("paranoia_level")
                 self.batcher.swap_ruleset(
-                    cr, paranoia_level=int(spec.get("paranoia_level", 2)))
+                    cr, paranoia_level=int(pl) if pl is not None else None)
                 return cr
 
             try:

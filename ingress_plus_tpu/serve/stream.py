@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import re
 import time
+from dataclasses import replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -103,6 +104,8 @@ class IncrementalVariant:
         dec = remove_nulls(url_decode_uni(safe))
         if v == 1:
             return dec
+        if v == 5:                   # squash(urldec) — NO html stage
+            return squash(dec)
         safe2, self._ent_tail = _split_tail(self._ent_tail + dec, _ENT_TAIL)
         out = html_entity_decode(safe2)
         return squash(out) if v == 4 else out
@@ -115,6 +118,8 @@ class IncrementalVariant:
         self._url_tail = b""
         if v == 1:
             return out
+        if v == 5:
+            return squash(out)
         out = html_entity_decode(self._ent_tail + out)
         self._ent_tail = b""
         return squash(out) if v == 4 else out
@@ -264,7 +269,7 @@ class StreamEngine:
         oversized-reroute path already holds the full body in memory, so
         capping the confirm copy below it would only lose the tail."""
         p = self.pipeline
-        si = STREAM_INDEX["body"]
+        si = STREAM_INDEX[getattr(request, "body_stream", "body")]
         base = [(v, si * len(VARIANTS) + v, 0) for v in range(len(VARIANTS))
                 if si * len(VARIANTS) + v in p.needed_sv]
         off = request.parsers_off
@@ -380,11 +385,10 @@ class StreamEngine:
         # parsers_off must carry over: the confirm stage re-unpacks the
         # accumulated body and must not run a decoder the scan stage had
         # disabled (the "both stages see identical bytes" contract)
-        confirm_req = Request(
-            method=req.method, uri=req.uri, protocol=req.protocol,
-            headers=req.headers, body=bytes(st.acc), tenant=req.tenant,
-            request_id=req.request_id, mode=req.mode,
-            parsers_off=req.parsers_off)
+        # dataclasses.replace keeps every other field AND the concrete
+        # type (a Response reroutes through here too — its confirm twin
+        # must stay a Response so resp_* streams rebuild)
+        confirm_req = replace(req, body=bytes(st.acc))
         v = p.finalize([confirm_req], hits, st.t0)[0]
         # scan/confirm caps were hit: the verdict is based on a prefix —
         # surface it the fail-open way (pass-and-flag, never silently)

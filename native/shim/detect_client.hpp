@@ -54,6 +54,23 @@ class DetectClient {
     return WaitVerdict(req.req_id, deadline, fail);
   }
 
+  // Response-side analysis (wallarm_parse_response analog): ship an
+  // upstream response for leak scanning, wait for the verdict.  Same
+  // fail-open discipline as Detect.
+  Response DetectResponse(const ResponseScan& resp) {
+    Response fail;
+    fail.req_id = resp.req_id;
+    fail.flags = kFailOpen;
+    uint64_t deadline = NowNs() + uint64_t(deadline_ms_ * 1e6);
+    if (fd_ < 0 && !Connect()) return fail;
+    std::string frame = EncodeResponseScan(resp);
+    if (!SendAll(frame.data(), frame.size(), deadline)) {
+      Close();
+      return fail;
+    }
+    return WaitVerdict(resp.req_id, deadline, fail);
+  }
+
   // Streaming-body variant: open with Detect-style request (mode must
   // include kModeStream), then feed chunks, then FinishStream for the
   // verdict.  Mirrors the wallarm module's incremental body parse†.

@@ -1,0 +1,32 @@
+/* nginx_compat: compile-check declarations — see README.md.  Mirrors the
+ * public nginx API subset ngx_http_detect_tpu_module.c uses (nginx is
+ * BSD-2-Clause; these are API declarations, not nginx source). */
+#ifndef _NGX_CONFIG_H_INCLUDED_
+#define _NGX_CONFIG_H_INCLUDED_
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/types.h>
+
+typedef unsigned char u_char;
+
+typedef intptr_t  ngx_int_t;
+typedef uintptr_t ngx_uint_t;
+typedef intptr_t  ngx_flag_t;
+typedef ngx_uint_t ngx_msec_t;
+
+#define NGX_OK        0
+#define NGX_ERROR    -1
+#define NGX_AGAIN    -2
+#define NGX_BUSY     -3
+#define NGX_DONE     -4
+#define NGX_DECLINED -5
+#define NGX_ABORT    -6
+
+#define NGX_THREADS   1
+
+#define ngx_memcpy(dst, src, n)  (void) memcpy(dst, src, n)
+#define ngx_cpymem(dst, src, n)  (((u_char *) memcpy(dst, src, n)) + (n))
+
+#endif /* _NGX_CONFIG_H_INCLUDED_ */

@@ -1,0 +1,271 @@
+/* nginx_compat: compile-check declarations — see README.md. */
+#ifndef _NGX_CORE_H_INCLUDED_
+#define _NGX_CORE_H_INCLUDED_
+
+#include <ngx_config.h>
+
+/* ---------------------------------------------------------- strings */
+
+typedef struct {
+    size_t  len;
+    u_char *data;
+} ngx_str_t;
+
+#define ngx_string(str)  { sizeof(str) - 1, (u_char *) str }
+#define ngx_null_string  { 0, NULL }
+#define ngx_str_set(str, text) \
+    (str)->len = sizeof(text) - 1; (str)->data = (u_char *) text
+#define ngx_str_null(str)  (str)->len = 0; (str)->data = NULL
+
+ngx_int_t ngx_strncasecmp(u_char *s1, u_char *s2, size_t n);
+u_char *ngx_snprintf(u_char *buf, size_t max, const char *fmt, ...);
+
+/* ---------------------------------------------------- pools + memory */
+
+typedef struct ngx_pool_s  ngx_pool_t;
+typedef struct ngx_log_s   ngx_log_t;
+
+void *ngx_pcalloc(ngx_pool_t *pool, size_t size);
+void *ngx_pnalloc(ngx_pool_t *pool, size_t size);
+
+/* ------------------------------------------------- array, list, hash */
+
+typedef struct {
+    void       *elts;
+    ngx_uint_t  nelts;
+    size_t      size;
+    ngx_uint_t  nalloc;
+    ngx_pool_t *pool;
+} ngx_array_t;
+
+void *ngx_array_push(ngx_array_t *a);
+
+typedef struct ngx_list_part_s  ngx_list_part_t;
+
+struct ngx_list_part_s {
+    void            *elts;
+    ngx_uint_t       nelts;
+    ngx_list_part_t *next;
+};
+
+typedef struct {
+    ngx_list_part_t *last;
+    ngx_list_part_t  part;
+    size_t           size;
+    ngx_uint_t       nalloc;
+    ngx_pool_t      *pool;
+} ngx_list_t;
+
+void *ngx_list_push(ngx_list_t *list);
+
+typedef struct ngx_table_elt_s  ngx_table_elt_t;
+
+struct ngx_table_elt_s {
+    ngx_uint_t       hash;
+    ngx_str_t        key;
+    ngx_str_t        value;
+    u_char          *lowcase_key;
+    ngx_table_elt_t *next;
+};
+
+/* -------------------------------------------------------- buf, chain */
+
+typedef struct ngx_file_s  ngx_file_t;
+
+typedef struct ngx_buf_s  ngx_buf_t;
+
+struct ngx_buf_s {
+    u_char     *pos;
+    u_char     *last;
+    off_t       file_pos;
+    off_t       file_last;
+    u_char     *start;
+    u_char     *end;
+    void       *tag;
+    ngx_file_t *file;
+    ngx_buf_t  *shadow;
+    unsigned    temporary:1;
+    unsigned    memory:1;
+    unsigned    mmap:1;
+    unsigned    recycled:1;
+    unsigned    in_file:1;
+    unsigned    flush:1;
+    unsigned    sync:1;
+    unsigned    last_buf:1;
+    unsigned    last_in_chain:1;
+    unsigned    last_shadow:1;
+    unsigned    temp_file:1;
+};
+
+typedef struct ngx_chain_s  ngx_chain_t;
+
+struct ngx_chain_s {
+    ngx_buf_t   *buf;
+    ngx_chain_t *next;
+};
+
+ssize_t ngx_read_file(ngx_file_t *file, u_char *buf, size_t size,
+                      off_t offset);
+
+/* ------------------------------------------------------------ events */
+
+typedef struct ngx_event_s  ngx_event_t;
+
+struct ngx_event_s {
+    void  *data;
+    void (*handler)(ngx_event_t *ev);
+    unsigned  active:1;
+    unsigned  ready:1;
+};
+
+/* ------------------------------------------------------------- cycle */
+
+typedef struct ngx_cycle_s  ngx_cycle_t;
+
+struct ngx_cycle_s {
+    void      ****conf_ctx;
+    ngx_pool_t   *pool;
+    ngx_log_t    *log;
+};
+
+extern volatile ngx_cycle_t *ngx_cycle;
+
+/* ----------------------------------------------------- configuration */
+
+#define NGX_CONF_OK     NULL
+#define NGX_CONF_ERROR  ((char *) -1)
+
+#define NGX_CONF_UNSET       ((ngx_flag_t) -1)
+#define NGX_CONF_UNSET_UINT  ((ngx_uint_t) -1)
+#define NGX_CONF_UNSET_PTR   ((void *) -1)
+#define NGX_CONF_UNSET_SIZE  ((size_t) -1)
+
+#define NGX_CONF_NOARGS  0x00000001
+#define NGX_CONF_TAKE1   0x00000002
+#define NGX_CONF_TAKE2   0x00000004
+#define NGX_CONF_1MORE   0x00000800
+#define NGX_CONF_FLAG    0x00000200
+
+typedef struct ngx_conf_s     ngx_conf_t;
+typedef struct ngx_command_s  ngx_command_t;
+
+struct ngx_conf_s {
+    char        *name;
+    ngx_array_t *args;
+    ngx_cycle_t *cycle;
+    ngx_pool_t  *pool;
+    ngx_log_t   *log;
+    void        *ctx;
+};
+
+struct ngx_command_s {
+    ngx_str_t   name;
+    ngx_uint_t  type;
+    char     *(*set)(ngx_conf_t *cf, ngx_command_t *cmd, void *conf);
+    ngx_uint_t  conf;
+    ngx_uint_t  offset;
+    void       *post;
+};
+
+#define ngx_null_command  { ngx_null_string, 0, NULL, 0, 0, NULL }
+
+typedef struct {
+    ngx_str_t   name;
+    ngx_uint_t  value;
+} ngx_conf_enum_t;
+
+char *ngx_conf_set_flag_slot(ngx_conf_t *cf, ngx_command_t *cmd, void *conf);
+char *ngx_conf_set_str_slot(ngx_conf_t *cf, ngx_command_t *cmd, void *conf);
+char *ngx_conf_set_str_array_slot(ngx_conf_t *cf, ngx_command_t *cmd,
+                                  void *conf);
+char *ngx_conf_set_num_slot(ngx_conf_t *cf, ngx_command_t *cmd, void *conf);
+char *ngx_conf_set_enum_slot(ngx_conf_t *cf, ngx_command_t *cmd, void *conf);
+
+#define ngx_conf_merge_value(conf, prev, default_)                          \
+    if (conf == NGX_CONF_UNSET) {                                           \
+        conf = (prev == NGX_CONF_UNSET) ? default_ : prev;                  \
+    }
+
+#define ngx_conf_merge_uint_value(conf, prev, default_)                     \
+    if (conf == NGX_CONF_UNSET_UINT) {                                      \
+        conf = (prev == NGX_CONF_UNSET_UINT) ? default_ : prev;             \
+    }
+
+#define ngx_conf_merge_ptr_value(conf, prev, default_)                      \
+    if (conf == NGX_CONF_UNSET_PTR) {                                       \
+        conf = (prev == NGX_CONF_UNSET_PTR) ? default_ : prev;              \
+    }
+
+#define ngx_conf_merge_str_value(conf, prev, default_)                      \
+    if (conf.data == NULL) {                                                \
+        if (prev.data) {                                                    \
+            conf.len = prev.len;                                            \
+            conf.data = prev.data;                                          \
+        } else {                                                            \
+            conf.len = sizeof(default_) - 1;                                \
+            conf.data = (u_char *) default_;                                \
+        }                                                                   \
+    }
+
+/* ------------------------------------------------------------ module */
+
+#define NGX_MODULE_UNSET_INDEX  ((ngx_uint_t) -1)
+
+#define NGX_MODULE_V1                                                       \
+    NGX_MODULE_UNSET_INDEX, NGX_MODULE_UNSET_INDEX,                         \
+    NULL, 0, 0, 0, (const char *) "compat"
+
+#define NGX_MODULE_V1_PADDING  0, 0, 0, 0, 0, 0, 0, 0
+
+typedef struct ngx_module_s  ngx_module_t;
+
+struct ngx_module_s {
+    ngx_uint_t     ctx_index;
+    ngx_uint_t     index;
+    char          *name;
+    ngx_uint_t     spare0;
+    ngx_uint_t     spare1;
+    ngx_uint_t     version;
+    const char    *signature;
+
+    void          *ctx;
+    ngx_command_t *commands;
+    ngx_uint_t     type;
+
+    ngx_int_t    (*init_master)(ngx_log_t *log);
+    ngx_int_t    (*init_module)(ngx_cycle_t *cycle);
+    ngx_int_t    (*init_process)(ngx_cycle_t *cycle);
+    ngx_int_t    (*init_thread)(ngx_cycle_t *cycle);
+    void         (*exit_thread)(ngx_cycle_t *cycle);
+    void         (*exit_process)(ngx_cycle_t *cycle);
+    void         (*exit_master)(ngx_cycle_t *cycle);
+
+    uintptr_t      spare_hook0;
+    uintptr_t      spare_hook1;
+    uintptr_t      spare_hook2;
+    uintptr_t      spare_hook3;
+    uintptr_t      spare_hook4;
+    uintptr_t      spare_hook5;
+    uintptr_t      spare_hook6;
+    uintptr_t      spare_hook7;
+};
+
+/* ------------------------------------------------------- thread pool */
+
+typedef struct ngx_thread_pool_s  ngx_thread_pool_t;
+typedef struct ngx_thread_task_s  ngx_thread_task_t;
+
+struct ngx_thread_task_s {
+    ngx_thread_task_t *next;
+    ngx_uint_t         id;
+    void              *ctx;
+    void             (*handler)(void *data, ngx_log_t *log);
+    ngx_event_t        event;
+};
+
+ngx_thread_pool_t *ngx_thread_pool_add(ngx_conf_t *cf, ngx_str_t *name);
+ngx_thread_pool_t *ngx_thread_pool_get(ngx_cycle_t *cycle, ngx_str_t *name);
+ngx_thread_task_t *ngx_thread_task_alloc(ngx_pool_t *pool, size_t size);
+ngx_int_t ngx_thread_task_post(ngx_thread_pool_t *tp, ngx_thread_task_t *task);
+
+#endif /* _NGX_CORE_H_INCLUDED_ */

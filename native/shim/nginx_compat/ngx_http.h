@@ -1,0 +1,157 @@
+/* nginx_compat: compile-check declarations — see README.md. */
+#ifndef _NGX_HTTP_H_INCLUDED_
+#define _NGX_HTTP_H_INCLUDED_
+
+#include <ngx_config.h>
+#include <ngx_core.h>
+
+typedef struct ngx_http_request_s  ngx_http_request_t;
+
+/* ------------------------------------------------------------ phases */
+
+typedef enum {
+    NGX_HTTP_POST_READ_PHASE = 0,
+    NGX_HTTP_SERVER_REWRITE_PHASE,
+    NGX_HTTP_FIND_CONFIG_PHASE,
+    NGX_HTTP_REWRITE_PHASE,
+    NGX_HTTP_POST_REWRITE_PHASE,
+    NGX_HTTP_PREACCESS_PHASE,
+    NGX_HTTP_ACCESS_PHASE,
+    NGX_HTTP_POST_ACCESS_PHASE,
+    NGX_HTTP_PRECONTENT_PHASE,
+    NGX_HTTP_CONTENT_PHASE,
+    NGX_HTTP_LOG_PHASE
+} ngx_http_phases;
+
+typedef ngx_int_t (*ngx_http_handler_pt)(ngx_http_request_t *r);
+
+typedef struct {
+    ngx_array_t  handlers;
+} ngx_http_phase_t;
+
+typedef struct {
+    ngx_array_t       servers;
+    ngx_http_phase_t  phases[NGX_HTTP_LOG_PHASE + 1];
+} ngx_http_core_main_conf_t;
+
+/* --------------------------------------------------- status + module */
+
+#define NGX_HTTP_SPECIAL_RESPONSE       300
+#define NGX_HTTP_FORBIDDEN              403
+#define NGX_HTTP_INTERNAL_SERVER_ERROR  500
+#define NGX_HTTP_SERVICE_UNAVAILABLE    503
+
+#define NGX_HTTP_MODULE  0x50545448  /* "HTTP" */
+
+#define NGX_HTTP_MAIN_CONF  0x02000000
+#define NGX_HTTP_SRV_CONF   0x04000000
+#define NGX_HTTP_LOC_CONF   0x08000000
+
+#define NGX_HTTP_MAIN_CONF_OFFSET  offsetof(ngx_http_conf_ctx_t, main_conf)
+#define NGX_HTTP_SRV_CONF_OFFSET   offsetof(ngx_http_conf_ctx_t, srv_conf)
+#define NGX_HTTP_LOC_CONF_OFFSET   offsetof(ngx_http_conf_ctx_t, loc_conf)
+
+typedef struct {
+    void **main_conf;
+    void **srv_conf;
+    void **loc_conf;
+} ngx_http_conf_ctx_t;
+
+typedef struct {
+    ngx_int_t (*preconfiguration)(ngx_conf_t *cf);
+    ngx_int_t (*postconfiguration)(ngx_conf_t *cf);
+    void     *(*create_main_conf)(ngx_conf_t *cf);
+    char     *(*init_main_conf)(ngx_conf_t *cf, void *conf);
+    void     *(*create_srv_conf)(ngx_conf_t *cf);
+    char     *(*merge_srv_conf)(ngx_conf_t *cf, void *prev, void *conf);
+    void     *(*create_loc_conf)(ngx_conf_t *cf);
+    char     *(*merge_loc_conf)(ngx_conf_t *cf, void *prev, void *conf);
+} ngx_http_module_t;
+
+extern ngx_module_t ngx_http_core_module;
+
+/* ----------------------------------------------------------- request */
+
+typedef struct {
+    ngx_list_t        headers;
+    ngx_table_elt_t  *host;
+    ngx_table_elt_t  *content_length;
+    off_t             content_length_n;
+} ngx_http_headers_in_t;
+
+typedef struct {
+    ngx_list_t        headers;
+    ngx_uint_t        status;
+    ngx_str_t         status_line;
+    ngx_str_t         content_type;
+    off_t             content_length_n;
+} ngx_http_headers_out_t;
+
+typedef struct {
+    ngx_chain_t  *bufs;
+    off_t         rest;
+} ngx_http_request_body_t;
+
+typedef void (*ngx_http_event_handler_pt)(ngx_http_request_t *r);
+typedef void (*ngx_http_client_body_handler_pt)(ngx_http_request_t *r);
+
+struct ngx_http_request_s {
+    void                      **ctx;
+    void                      **main_conf;
+    void                      **srv_conf;
+    void                      **loc_conf;
+
+    ngx_pool_t                 *pool;
+    ngx_http_request_t         *main;
+    ngx_http_request_t         *parent;
+
+    ngx_http_headers_in_t       headers_in;
+    ngx_http_headers_out_t      headers_out;
+    ngx_http_request_body_t    *request_body;
+
+    ngx_str_t                   method_name;
+    ngx_str_t                   uri;
+    ngx_str_t                   unparsed_uri;
+    ngx_str_t                   args;
+
+    ngx_http_event_handler_pt   read_event_handler;
+    ngx_http_event_handler_pt   write_event_handler;
+
+    unsigned                    count:16;
+    unsigned                    blocked:8;
+    unsigned                    aio:1;
+    unsigned                    preserve_body:1;
+};
+
+/* ------------------------------------------------------------ macros */
+
+#define ngx_http_get_module_ctx(r, module)  (r)->ctx[module.ctx_index]
+#define ngx_http_set_ctx(r, c, module)      (r)->ctx[module.ctx_index] = c
+
+#define ngx_http_get_module_main_conf(r, module)                            \
+    (r)->main_conf[module.ctx_index]
+#define ngx_http_get_module_loc_conf(r, module)                             \
+    (r)->loc_conf[module.ctx_index]
+
+#define ngx_http_conf_get_module_main_conf(cf, module)                      \
+    ((ngx_http_conf_ctx_t *) cf->ctx)->main_conf[module.ctx_index]
+
+/* --------------------------------------------------------- functions */
+
+ngx_int_t ngx_http_read_client_request_body(
+    ngx_http_request_t *r, ngx_http_client_body_handler_pt post_handler);
+void ngx_http_finalize_request(ngx_http_request_t *r, ngx_int_t rc);
+ngx_int_t ngx_http_internal_redirect(ngx_http_request_t *r, ngx_str_t *uri,
+                                     ngx_str_t *args);
+void ngx_http_core_run_phases(ngx_http_request_t *r);
+
+/* ------------------------------------------------------------ filters */
+
+typedef ngx_int_t (*ngx_http_output_header_filter_pt)(ngx_http_request_t *r);
+typedef ngx_int_t (*ngx_http_output_body_filter_pt)(ngx_http_request_t *r,
+                                                    ngx_chain_t *chain);
+
+extern ngx_http_output_header_filter_pt  ngx_http_top_header_filter;
+extern ngx_http_output_body_filter_pt    ngx_http_top_body_filter;
+
+#endif /* _NGX_HTTP_H_INCLUDED_ */

@@ -15,7 +15,7 @@
  *     detect_tpu_fail_open on;
  *     detect_tpu_tenant 7;
  *     detect_tpu_block_page /blocked.html;
- *     detect_tpu_parse_response on;        (body-filter phase, later)
+ *     detect_tpu_parse_response on;        (body-filter phase, below)
  *     detect_tpu_parse_websocket on;
  *     detect_tpu_parser_disable xml;
  *     detect_tpu_metrics 127.0.0.1:9901;   (server scope)
@@ -68,6 +68,20 @@ extern ngx_int_t detect_tpu_roundtrip(
     const char *body, size_t body_len,
     /* out */ uint8_t *flags, uint32_t *score);
 
+/* response-side twin (shim_bridge.cc): ships a PTPI response-scan frame,
+ * waits for the leak verdict */
+extern ngx_int_t detect_tpu_response_roundtrip(
+    const char *socket_path, double timeout_ms, uint64_t req_id,
+    uint32_t tenant, uint8_t mode, uint16_t status,
+    const char *headers, size_t headers_len,
+    const char *body, size_t body_len,
+    /* out */ uint8_t *flags, uint32_t *score);
+
+/* response bodies beyond this are scanned in their first megabyte only
+ * (the serve loop's oversized reroute guards the request side; response
+ * leak patterns — error pages, stack traces — sit at the front) */
+#define DETECT_TPU_RESP_CAP  (1024 * 1024)
+
 #define DETECT_TPU_FLAG_ATTACK    0x01
 #define DETECT_TPU_FLAG_BLOCKED   0x02
 #define DETECT_TPU_FLAG_FAIL_OPEN 0x04
@@ -91,6 +105,22 @@ typedef struct {
                                     * at server scope by the template) */
 } ngx_http_detect_tpu_loc_conf_t;
 
+/* response-scan task context: lives in r->pool; the request is pinned
+ * (r->main->count++) until the completion event finalizes it, so the
+ * pooled buffers outlive the pool thread's read */
+typedef struct {
+    ngx_http_request_t  *request;
+    ngx_str_t            headers_blob;   /* response headers */
+    ngx_str_t            body;           /* captured (capped) body */
+    ngx_str_t            socket_path;
+    double               timeout_ms;
+    uint32_t             tenant;
+    uint8_t              mode;
+    uint16_t             status;
+    uint8_t              flags;
+    uint32_t             score;
+} ngx_http_detect_tpu_resp_ctx_t;
+
 typedef struct {
     ngx_http_request_t  *request;
     /* captured on the event thread before the task is posted; the pool
@@ -111,6 +141,14 @@ typedef struct {
     unsigned             body_ready:1;
     unsigned             task_posted:1;
     unsigned             done_ev:1;
+    /* response capture (body-filter phase, detect_tpu_parse_response) */
+    u_char              *resp_buf;
+    size_t               resp_len;
+    size_t               resp_cap;       /* grown geometrically to the
+                                          * 1MB ceiling — a flat 1MB per
+                                          * response would pin ~1GB at
+                                          * 1k concurrent responses */
+    unsigned             resp_scanned:1;
 } ngx_http_detect_tpu_ctx_t;
 
 static ngx_int_t ngx_http_detect_tpu_handler(ngx_http_request_t *r);
@@ -230,10 +268,12 @@ ngx_module_t ngx_http_detect_tpu_module = {
     NGX_MODULE_V1_PADDING
 };
 
-/* join request headers as "k: v\x1f k: v" — the wire blob the serve
- * loop's normalizer splits back into per-header match units */
+/* join a header list as "k: v\x1f k: v" — the wire blob the serve
+ * loop's normalizer splits back into per-header match units (used for
+ * headers_in on the request path, headers_out on the response path) */
 static ngx_int_t
-ngx_http_detect_tpu_headers_blob(ngx_http_request_t *r, ngx_str_t *out)
+ngx_http_detect_tpu_headers_blob(ngx_http_request_t *r, ngx_list_t *list,
+                                 ngx_str_t *out)
 {
     size_t            len = 0;
     ngx_uint_t        i;
@@ -241,7 +281,7 @@ ngx_http_detect_tpu_headers_blob(ngx_http_request_t *r, ngx_str_t *out)
     ngx_table_elt_t  *h;
     u_char           *p;
 
-    for (part = &r->headers_in.headers.part; part; part = part->next) {
+    for (part = &list->part; part; part = part->next) {
         h = part->elts;
         for (i = 0; i < part->nelts; i++) {
             len += h[i].key.len + 2 + h[i].value.len + 1;
@@ -256,7 +296,7 @@ ngx_http_detect_tpu_headers_blob(ngx_http_request_t *r, ngx_str_t *out)
         return NGX_ERROR;
     }
     out->data = p;
-    for (part = &r->headers_in.headers.part; part; part = part->next) {
+    for (part = &list->part; part; part = part->next) {
         h = part->elts;
         for (i = 0; i < part->nelts; i++) {
             p = ngx_cpymem(p, h[i].key.data, h[i].key.len);
@@ -468,8 +508,8 @@ ngx_http_detect_tpu_handler(ngx_http_request_t *r)
             return conf->fail_open ? NGX_DECLINED
                                    : NGX_HTTP_SERVICE_UNAVAILABLE;
         }
-        if (ngx_http_detect_tpu_headers_blob(r, &ctx->headers_blob)
-                != NGX_OK
+        if (ngx_http_detect_tpu_headers_blob(r, &r->headers_in.headers,
+                                             &ctx->headers_blob) != NGX_OK
             || ngx_http_detect_tpu_capture_body(r, &ctx->body) != NGX_OK)
         {
             return conf->fail_open ? NGX_DECLINED : NGX_ERROR;
@@ -552,6 +592,8 @@ ngx_http_detect_tpu_merge_loc_conf(ngx_conf_t *cf, void *parent, void *child)
     ngx_http_detect_tpu_loc_conf_t *prev = parent;
     ngx_http_detect_tpu_loc_conf_t *conf = child;
 
+    (void) cf;   /* signature-mandated, unused here */
+
     ngx_conf_merge_value(conf->enabled, prev->enabled, 0);
     ngx_conf_merge_str_value(conf->socket_path, prev->socket_path,
                              "/run/ipt/detect.sock");
@@ -569,6 +611,230 @@ ngx_http_detect_tpu_merge_loc_conf(ngx_conf_t *cf, void *parent, void *child)
     return NGX_CONF_OK;
 }
 
+/* ------------------------------------------------------------------ *
+ * Response-side analysis (detect_tpu_parse_response): a body filter
+ * captures the upstream response (bounded at DETECT_TPU_RESP_CAP) while
+ * forwarding every buffer UNCHANGED — client latency never waits on the
+ * scan.  At last_buf the capture is shipped to the serve loop as a PTPI
+ * frame on a pool thread; the verdict is advisory (the serve loop
+ * records leak hits in postanalytics — response bytes already sent
+ * can't be retracted, matching the reference's parse_response
+ * semantics†).  The request is pinned (count++) until the verdict event
+ * so the pooled capture outlives the pool thread.
+ * ------------------------------------------------------------------ */
+
+static ngx_http_output_body_filter_pt ngx_http_detect_tpu_next_body_filter;
+
+/* nginx keeps Content-Type / Content-Length OUT of the headers_out list
+ * (dedicated fields, rendered by the header filter), but they're the
+ * most commonly matched response headers (CRS 95x gating chains) — the
+ * blob shipped for scanning must include them (round-3 review). */
+static ngx_int_t
+ngx_http_detect_tpu_resp_headers_blob(ngx_http_request_t *r, ngx_str_t *out)
+{
+    u_char     buf[64];
+    u_char    *p, *q;
+    size_t     extra = 0, cl_len = 0;
+    ngx_str_t  list_blob;
+
+    if (ngx_http_detect_tpu_headers_blob(r, &r->headers_out.headers,
+                                         &list_blob) != NGX_OK)
+    {
+        return NGX_ERROR;
+    }
+    if (r->headers_out.content_type.len) {
+        extra += sizeof("Content-Type: ") - 1
+                 + r->headers_out.content_type.len + 1;
+    }
+    if (r->headers_out.content_length_n >= 0) {
+        q = ngx_snprintf(buf, sizeof(buf), "%O",
+                         r->headers_out.content_length_n);
+        cl_len = (size_t) (q - buf);
+        extra += sizeof("Content-Length: ") - 1 + cl_len + 1;
+    }
+    if (extra == 0) {
+        *out = list_blob;
+        return NGX_OK;
+    }
+    p = ngx_pnalloc(r->pool, list_blob.len + 1 + extra);
+    if (p == NULL) {
+        return NGX_ERROR;
+    }
+    out->data = p;
+    if (r->headers_out.content_type.len) {
+        p = ngx_cpymem(p, "Content-Type: ", sizeof("Content-Type: ") - 1);
+        p = ngx_cpymem(p, r->headers_out.content_type.data,
+                       r->headers_out.content_type.len);
+        *p++ = 0x1f;
+    }
+    if (r->headers_out.content_length_n >= 0) {
+        p = ngx_cpymem(p, "Content-Length: ",
+                       sizeof("Content-Length: ") - 1);
+        p = ngx_cpymem(p, buf, cl_len);
+        *p++ = 0x1f;
+    }
+    if (list_blob.len) {
+        p = ngx_cpymem(p, list_blob.data, list_blob.len);
+    } else {
+        p--;    /* drop the trailing separator */
+    }
+    out->len = (size_t) (p - out->data);
+    return NGX_OK;
+}
+
+static void
+ngx_http_detect_tpu_resp_thread_func(void *data, ngx_log_t *log)
+{
+    ngx_http_detect_tpu_resp_ctx_t *c = data;
+
+    (void) log;
+    if (detect_tpu_response_roundtrip(
+            (const char *) c->socket_path.data, c->timeout_ms,
+            (uint64_t) (uintptr_t) c->request, c->tenant, c->mode,
+            c->status,
+            (const char *) c->headers_blob.data, c->headers_blob.len,
+            (const char *) c->body.data, c->body.len,
+            &c->flags, &c->score) != NGX_OK)
+    {
+        c->flags = DETECT_TPU_FLAG_FAIL_OPEN;
+        c->score = 0;
+    }
+}
+
+static void
+ngx_http_detect_tpu_resp_thread_done(ngx_event_t *ev)
+{
+    ngx_http_detect_tpu_resp_ctx_t *c = ev->data;
+
+    /* release the pin taken at post time; verdict is advisory */
+    ngx_http_finalize_request(c->request->main, NGX_DONE);
+}
+
+static ngx_int_t
+ngx_http_detect_tpu_body_filter(ngx_http_request_t *r, ngx_chain_t *in)
+{
+    ngx_http_detect_tpu_loc_conf_t  *conf;
+    ngx_http_detect_tpu_ctx_t       *ctx;
+    ngx_http_detect_tpu_resp_ctx_t  *rc;
+    ngx_thread_task_t               *task;
+    ngx_thread_pool_t               *tp;
+    ngx_chain_t                     *cl;
+    ngx_buf_t                       *b;
+    size_t                           n, room;
+    ngx_uint_t                       last = 0;
+    ngx_str_t                        pool_name = ngx_string("detect_tpu");
+
+    conf = ngx_http_get_module_loc_conf(r, ngx_http_detect_tpu_module);
+    if (r != r->main || !conf->enabled || !conf->parse_response
+        || conf->mode == 0)
+    {
+        return ngx_http_detect_tpu_next_body_filter(r, in);
+    }
+
+    ctx = ngx_http_get_module_ctx(r, ngx_http_detect_tpu_module);
+    if (ctx == NULL) {
+        ctx = ngx_pcalloc(r->pool, sizeof(ngx_http_detect_tpu_ctx_t));
+        if (ctx == NULL) {
+            return ngx_http_detect_tpu_next_body_filter(r, in);
+        }
+        ctx->request = r;
+        ngx_http_set_ctx(r, ctx, ngx_http_detect_tpu_module);
+    }
+
+    if (!ctx->resp_scanned) {
+        for (cl = in; cl; cl = cl->next) {
+            b = cl->buf;
+            if (!b->in_file && b->last > b->pos) {
+                /* bounded capture; file buffers (sendfile of static
+                 * assets) are skipped — leak rules target dynamically
+                 * generated error output, which is in-memory */
+                n = (size_t) (b->last - b->pos);
+                if (ctx->resp_len + n > ctx->resp_cap
+                    && ctx->resp_cap < DETECT_TPU_RESP_CAP)
+                {
+                    /* grow geometrically toward the cap; size the first
+                     * allocation from Content-Length when declared */
+                    size_t  want = ctx->resp_len + n;
+                    size_t  cap = ctx->resp_cap ? ctx->resp_cap * 2
+                                                : (size_t) 16384;
+                    if (ctx->resp_cap == 0
+                        && r->headers_out.content_length_n > 0)
+                    {
+                        cap = (size_t) r->headers_out.content_length_n;
+                    }
+                    while (cap < want && cap < DETECT_TPU_RESP_CAP) {
+                        cap *= 2;
+                    }
+                    if (cap > DETECT_TPU_RESP_CAP) {
+                        cap = DETECT_TPU_RESP_CAP;
+                    }
+                    {
+                        u_char *nb = ngx_pnalloc(r->pool, cap);
+                        if (nb == NULL) {
+                            ctx->resp_scanned = 1;   /* fail open, stop */
+                            break;
+                        }
+                        if (ctx->resp_len) {
+                            ngx_memcpy(nb, ctx->resp_buf, ctx->resp_len);
+                        }
+                        ctx->resp_buf = nb;
+                        ctx->resp_cap = cap;
+                    }
+                }
+                room = ctx->resp_cap - ctx->resp_len;
+                if (n > room) {
+                    n = room;
+                }
+                if (n) {
+                    ngx_memcpy(ctx->resp_buf + ctx->resp_len, b->pos, n);
+                    ctx->resp_len += n;
+                }
+            }
+            if (b->last_buf) {
+                last = 1;
+            }
+        }
+
+        if (last) {
+            ctx->resp_scanned = 1;
+            tp = ngx_thread_pool_get((ngx_cycle_t *) ngx_cycle, &pool_name);
+            /* post even with an empty capture: RESPONSE_STATUS /
+             * RESPONSE_HEADERS rules (5xx leak, header fingerprints)
+             * must fire for body-less and sendfile-only responses too
+             * (round-3 review) */
+            if (tp != NULL) {
+                task = ngx_thread_task_alloc(
+                    r->pool, sizeof(ngx_http_detect_tpu_resp_ctx_t));
+                if (task != NULL) {
+                    rc = task->ctx;
+                    rc->request = r;
+                    rc->socket_path = conf->socket_path;
+                    rc->timeout_ms = (double) conf->timeout_ms;
+                    rc->tenant = (uint32_t) conf->tenant;
+                    rc->mode = (uint8_t) conf->mode
+                        | ngx_http_detect_tpu_parser_bits(
+                              conf->parser_disable);
+                    rc->status = (uint16_t) r->headers_out.status;
+                    rc->body.data = ctx->resp_buf;
+                    rc->body.len = ctx->resp_len;
+                    if (ngx_http_detect_tpu_resp_headers_blob(
+                            r, &rc->headers_blob) == NGX_OK) {
+                        task->handler = ngx_http_detect_tpu_resp_thread_func;
+                        task->event.handler =
+                            ngx_http_detect_tpu_resp_thread_done;
+                        task->event.data = rc;
+                        if (ngx_thread_task_post(tp, task) == NGX_OK) {
+                            r->main->count++;   /* pinned until done ev */
+                        }
+                    }
+                }
+            }
+        }
+    }
+
+    return ngx_http_detect_tpu_next_body_filter(r, in);
+}
+
 static ngx_int_t
 ngx_http_detect_tpu_init(ngx_conf_t *cf)
 {
@@ -581,5 +847,10 @@ ngx_http_detect_tpu_init(ngx_conf_t *cf)
         return NGX_ERROR;
     }
     *h = ngx_http_detect_tpu_handler;
+
+    /* response-side body filter (runs for every request; cheap early-out
+     * unless detect_tpu_parse_response is on for the location) */
+    ngx_http_detect_tpu_next_body_filter = ngx_http_top_body_filter;
+    ngx_http_top_body_filter = ngx_http_detect_tpu_body_filter;
     return NGX_OK;
 }

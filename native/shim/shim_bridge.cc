@@ -29,6 +29,35 @@ ipt::DetectClient* ClientFor(const char* socket_path, double timeout_ms) {
 
 }  // namespace
 
+// Response-side scan for the module's body-filter phase
+// (detect_tpu_parse_response on): ships the buffered upstream response,
+// returns verdict flags.  Fail-open like the request path.
+extern "C" int detect_tpu_response_roundtrip(
+    const char* socket_path, double timeout_ms, uint64_t req_id,
+    uint32_t tenant, uint8_t mode, uint16_t status,
+    const char* headers, size_t headers_len,
+    const char* body, size_t body_len,
+    uint8_t* flags, uint32_t* score) {
+  try {
+    ipt::DetectClient* client = ClientFor(socket_path, timeout_ms);
+    ipt::ResponseScan rs;
+    rs.req_id = req_id;
+    rs.tenant = tenant;
+    rs.mode = mode;
+    rs.status = status;
+    rs.headers_blob.assign(headers ? headers : "", headers_len);
+    rs.body.assign(body ? body : "", body_len);
+    ipt::Response r = client->DetectResponse(rs);
+    *flags = r.flags;
+    *score = r.score;
+    return 0;  /* NGX_OK */
+  } catch (...) {
+    *flags = 4;  /* fail_open */
+    *score = 0;
+    return 0;
+  }
+}
+
 extern "C" int detect_tpu_roundtrip(
     const char* socket_path, double timeout_ms, uint64_t req_id,
     uint32_t tenant, uint8_t mode, const char* method, size_t method_len,

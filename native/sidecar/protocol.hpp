@@ -17,6 +17,9 @@ constexpr uint32_t kMaxFrame = 8u << 20;
 inline const char kReqMagic[4] = {'Q', 'T', 'P', 'I'};
 inline const char kRespMagic[4] = {'R', 'T', 'P', 'I'};
 inline const char kChunkMagic[4] = {'K', 'T', 'P', 'I'};
+// Response-scan frame (upstream HTTP response → leak analysis; the
+// wallarm_parse_response analog).  Verdict returns as a normal RTPI frame.
+inline const char kRespScanMagic[4] = {'P', 'T', 'P', 'I'};
 
 enum Flags : uint8_t {
   kAttack = 1,
@@ -98,6 +101,39 @@ inline std::string EncodeRequest(const Request& r) {
   return frame;
 }
 
+// Upstream HTTP response for leak scanning (twin of protocol.py
+// encode_response_scan: req_id u64, tenant u32, mode u8, status u16,
+// hdr_len u32, body_len u32, headers blob, body).
+struct ResponseScan {
+  uint64_t req_id = 0;
+  uint32_t tenant = 0;
+  uint8_t mode = 2;
+  uint16_t status = 200;
+  std::string headers_blob;  // "key: value\x1f key: value"
+  std::string body;
+};
+
+inline std::string EncodeResponseScan(const ResponseScan& r) {
+  std::string payload;
+  payload.reserve(23 + r.headers_blob.size() + r.body.size());
+  detail::put<uint64_t>(&payload, r.req_id);
+  detail::put<uint32_t>(&payload, r.tenant);
+  payload.push_back(static_cast<char>(r.mode));
+  detail::put<uint16_t>(&payload, r.status);
+  detail::put<uint32_t>(&payload,
+                        static_cast<uint32_t>(r.headers_blob.size()));
+  detail::put<uint32_t>(&payload, static_cast<uint32_t>(r.body.size()));
+  payload += r.headers_blob;
+  payload += r.body;
+
+  std::string frame;
+  frame.reserve(8 + payload.size());
+  frame.append(kRespScanMagic, 4);
+  detail::put<uint32_t>(&frame, static_cast<uint32_t>(payload.size()));
+  frame += payload;
+  return frame;
+}
+
 // Body chunk for a stream opened with kModeStream (twin of
 // protocol.py encode_chunk: req_id u64, flags u8, data).
 inline std::string EncodeChunk(uint64_t req_id, const std::string& data,
@@ -167,6 +203,7 @@ inline Response DecodeResponse(const uint8_t* p, size_t n) {
 constexpr size_t kMinRequestPayload = 26;   // _REQ_HEAD: Q I B B I I I
 constexpr size_t kMinResponsePayload = 16;  // _RESP_HEAD + counts
 constexpr size_t kMinChunkPayload = 9;      // _CHUNK_HEAD: Q B
+constexpr size_t kMinRespScanPayload = 23;  // _RSCAN_HEAD: Q I B H I I
 
 // Incremental splitter for a stream interleaving several frame kinds —
 // C++ twin of protocol.py's MultiFrameReader (the framing loop exists

@@ -99,6 +99,7 @@ inline ipt::MultiFrameReader MakeDownReader() {
   return ipt::MultiFrameReader({
       {ipt::kReqMagic, 0, ipt::kMinRequestPayload},
       {ipt::kChunkMagic, 1, ipt::kMinChunkPayload},
+      {ipt::kRespScanMagic, 2, ipt::kMinRespScanPayload},
   });
 }
 
@@ -444,6 +445,7 @@ class Sidecar {
           c->reader.Feed(buf, size_t(n),
                          [&](int kind, const uint8_t* p, size_t len) {
             if (kind == 0) OnRequest(c, p, len);
+            else if (kind == 2) OnRespScan(c, p, len);
             else OnChunk(c, p, len);
           });
         } catch (const std::exception&) {
@@ -480,6 +482,28 @@ class Sidecar {
       c->open_streams.insert(orig_id);
     }
     AppendUpstream(u, ipt::kReqMagic, payload, len, up_id);
+  }
+
+  // Response-scan frames route exactly like requests (req_id-rewritten,
+  // balanced, deadline-tracked; the verdict rides a normal RTPI frame
+  // back) — minus stream bookkeeping, which rscan doesn't use.
+  void OnRespScan(DownConn* c, const uint8_t* payload, size_t len) {
+    ++counters_.requests_in;
+    uint64_t orig_id = ipt::detail::get<uint64_t>(payload);
+    uint32_t tenant = ipt::detail::get<uint32_t>(payload + 8);
+    int u = PickUpstream(tenant);
+    if (u < 0) {
+      if (AnyReady()) ++counters_.fail_open_overload;
+      else ++counters_.fail_open_upstream;
+      SendFailOpenTo(c, orig_id);
+      return;
+    }
+    uint64_t now = NowNs();
+    uint64_t up_id = ++next_up_id_;
+    uint64_t dl = now + uint64_t(opt_.deadline_ms * 1e6);
+    pending_[up_id] = Pending{c->id, orig_id, dl, now, u};
+    deadlines_.emplace(dl, up_id);
+    AppendUpstream(u, ipt::kRespScanMagic, payload, len, up_id);
   }
 
   void OnChunk(DownConn* c, const uint8_t* payload, size_t len) {
@@ -608,7 +632,9 @@ class Sidecar {
     size_t at = up.outbuf.size();
     up.outbuf.append(reinterpret_cast<const char*>(payload), len);
     std::memcpy(&up.outbuf[at], &up_id, 8);  // re-id for global uniqueness
-    if (std::memcmp(magic, ipt::kReqMagic, 4) == 0) {
+    if (std::memcmp(magic, ipt::kChunkMagic, 4) != 0) {
+      // requests AND response-scans count toward balancing state;
+      // chunks belong to an already-counted stream
       ++up.inflight;
       ++up.forwarded;
     }
@@ -659,7 +685,7 @@ class Sidecar {
     if (events & (EPOLLHUP | EPOLLERR)) { DropUpstream(u); return; }
     if (events & EPOLLIN) {
       uint8_t buf[1 << 16];
-      ssize_t n;
+      ssize_t n = -1;   /* read only when fd >= 0; guards below re-check */
       while (up.fd >= 0 && (n = read(up.fd, buf, sizeof buf)) > 0) {
         try {
           up.reader.Feed(buf, size_t(n), [&](const uint8_t* p, size_t len) {

@@ -1,0 +1,172 @@
+"""CRS anomaly-scoring mode (VERDICT round-2 item 5; SURVEY.md §2.2
+libmodsecurity row: "CRS v3.3 is the primary corpus").
+
+Real CRS blocks via setvar accumulation: crs-setup.conf's SecAction
+initializes tx weights, each rule adds setvar:'tx.anomaly_score_pl1=
++%{tx.critical_anomaly_score}', and rule 949110 blocks when the summed
+TX:ANOMALY_SCORE crosses %{tx.inbound_anomaly_score_threshold}.  The
+compiler resolves this protocol statically: increments → rule_score,
+949 rule → pipeline anomaly_threshold, macros → literals.  These tests
+drive a CRS-shaped config end-to-end and pin ModSecurity-equivalent
+block decisions.
+"""
+
+from __future__ import annotations
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset, resolve_macros
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.normalize import Request
+
+CRS_SETUP = """
+SecAction \\
+    "id:900110,phase:1,pass,nolog,\\
+    setvar:tx.inbound_anomaly_score_threshold=5,\\
+    setvar:tx.outbound_anomaly_score_threshold=4"
+
+SecAction \\
+    "id:900000,phase:1,pass,nolog,\\
+    setvar:tx.detection_paranoia_level=2"
+
+SecAction \\
+    "id:901140,phase:1,pass,nolog,\\
+    setvar:tx.critical_anomaly_score=5,\\
+    setvar:tx.error_anomaly_score=4,\\
+    setvar:tx.warning_anomaly_score=3,\\
+    setvar:tx.notice_anomaly_score=2"
+"""
+
+RULES = """
+SecRule ARGS "@rx (?i)union\\s+select" \\
+    "id:942100,phase:2,block,t:urlDecodeUni,severity:'CRITICAL',\\
+    tag:'attack-sqli',tag:'paranoia-level/1',\\
+    setvar:'tx.sql_injection_score=+%{tx.critical_anomaly_score}',\\
+    setvar:'tx.anomaly_score_pl1=+%{tx.critical_anomaly_score}'"
+
+SecRule ARGS "@rx (?i)sleep\\s*\\(" \\
+    "id:942160,phase:2,block,t:urlDecodeUni,severity:'WARNING',\\
+    tag:'attack-sqli',tag:'paranoia-level/1',\\
+    setvar:'tx.anomaly_score_pl1=+%{tx.warning_anomaly_score}'"
+
+SecRule ARGS "@rx (?i)xp_cmdshell" \\
+    "id:942170,phase:2,block,t:urlDecodeUni,severity:'WARNING',\\
+    tag:'attack-sqli',tag:'paranoia-level/1',\\
+    setvar:'tx.anomaly_score_pl1=+%{tx.warning_anomaly_score}'"
+
+SecRule TX:ANOMALY_SCORE "@ge %{tx.inbound_anomaly_score_threshold}" \\
+    "id:949110,phase:2,block,severity:'CRITICAL',\\
+    tag:'attack-generic'"
+"""
+
+
+def _pipeline(setup: str = CRS_SETUP, rules: str = RULES,
+              **kw) -> DetectionPipeline:
+    cr = compile_ruleset(parse_seclang(setup + rules))
+    return DetectionPipeline(cr, mode="block", **kw)
+
+
+def test_setup_resolves_threshold_and_weights():
+    cr = compile_ruleset(parse_seclang(CRS_SETUP + RULES))
+    assert cr.anomaly_threshold == 5
+    assert cr.paranoia_hint == 2
+    # config SecActions are folded, not compiled as rules
+    assert 900110 not in cr.rule_ids
+    # per-rule increments come from the setvar chain, not severity
+    import numpy as np
+    assert cr.rule_score[np.nonzero(cr.rule_ids == 942100)[0][0]] == 5
+    assert cr.rule_score[np.nonzero(cr.rule_ids == 942160)[0][0]] == 3
+
+
+def test_single_critical_blocks_single_warning_does_not():
+    """ModSecurity equivalence: one CRITICAL (5) >= threshold 5 blocks;
+    one WARNING (3) stays under."""
+    p = _pipeline()
+    crit = Request(uri="/q?id=1 union select password")
+    warn = Request(uri="/q?id=sleep(5)")
+    v = p.detect([crit])[0]
+    assert v.attack and v.blocked and v.score >= 5
+    v = p.detect([warn])[0]
+    assert not v.attack and v.score == 3
+
+
+def test_two_warnings_accumulate_past_threshold():
+    p = _pipeline()
+    both = Request(uri="/q?a=sleep(1)&b=xp_cmdshell")
+    v = p.detect([both])[0]
+    assert v.attack and v.score == 6
+
+
+def test_custom_threshold_honored():
+    setup = CRS_SETUP.replace(
+        "tx.inbound_anomaly_score_threshold=5",
+        "tx.inbound_anomaly_score_threshold=10")
+    p = _pipeline(setup=setup)
+    assert p.anomaly_threshold == 10
+    crit = Request(uri="/q?id=1 union select password")
+    assert not p.detect([crit])[0].attack          # 5 < 10
+    combo = Request(uri="/q?a=1 union select x&b=sleep(1)&c=xp_cmdshell")
+    assert p.detect([combo])[0].attack             # 5+3+3 >= 10
+
+
+def test_explicit_pipeline_arg_overrides_pack():
+    p = _pipeline(anomaly_threshold=3)
+    warn = Request(uri="/q?id=sleep(5)")
+    assert p.detect([warn])[0].attack              # 3 >= 3
+
+
+def test_macro_resolution_in_operator_args():
+    """A %{tx.*} macro in a non-anomaly rule argument resolves to the
+    configured literal instead of abstaining."""
+    conf = ('SecAction "id:900200,phase:1,pass,nolog,'
+            'setvar:tx.max_num_args=3"\n'
+            'SecRule &ARGS "@gt %{tx.max_num_args}" '
+            '"id:920380,phase:2,block,severity:CRITICAL,'
+            'tag:\'attack-protocol\'"')
+    cr = compile_ruleset(parse_seclang(conf))
+    meta = cr.rules[0]
+    assert meta.confirm["arg"] == "3"
+    p = DetectionPipeline(cr, mode="block", anomaly_threshold=5)
+    assert not p.detect([Request(uri="/q?a=1&b=2&c=3")])[0].attack
+    v = p.detect([Request(uri="/q?a=1&b=2&c=3&d=4")])[0]
+    assert v.attack and v.rule_ids == [920380]
+
+
+def test_resolve_macros_helper():
+    env = {"a": "5", "b": "%{tx.a}"}
+    assert resolve_macros("x=%{tx.a}", env) == "x=5"
+    assert resolve_macros("%{tx.b}", env) == "5"
+    assert resolve_macros("%{tx.missing}", env) is None
+    assert resolve_macros("no macros", env) == "no macros"
+    cyc = {"a": "%{tx.b}", "b": "%{tx.a}"}
+    assert resolve_macros("%{tx.a}", cyc) is None
+
+
+def test_paranoia_hint_drives_pipeline_mask():
+    """tx.detection_paranoia_level from crs-setup must actually gate
+    rules at serve time (round-3 review: the hint was resolved and
+    serialized but nothing consumed it)."""
+    setup_pl1 = CRS_SETUP.replace("tx.detection_paranoia_level=2",
+                                  "tx.detection_paranoia_level=1")
+    rules_pl2 = RULES.replace(
+        "id:942160,phase:2,block,t:urlDecodeUni,severity:'WARNING',\\\n"
+        "    tag:'attack-sqli',tag:'paranoia-level/1',",
+        "id:942160,phase:2,block,t:urlDecodeUni,severity:'WARNING',\\\n"
+        "    tag:'attack-sqli',tag:'paranoia-level/2',")
+    cr = compile_ruleset(parse_seclang(setup_pl1 + rules_pl2))
+    assert cr.paranoia_hint == 1
+    p = DetectionPipeline(cr, mode="block", anomaly_threshold=3)
+    # the PL2 rule is masked by the pack's own PL1 config
+    assert not p.detect([Request(uri="/q?id=sleep(5)")])[0].attack
+    # explicit arg still wins
+    p2 = DetectionPipeline(cr, mode="block", anomaly_threshold=3,
+                           paranoia_level=2)
+    assert p2.detect([Request(uri="/q?id=sleep(5)")])[0].attack
+
+
+def test_949_rule_is_inert_in_the_pack():
+    """The threshold rule itself must never fire as a detection rule
+    (it has no scannable stream)."""
+    p = _pipeline()
+    benign = Request(uri="/products?page=2")
+    v = p.detect([benign])[0]
+    assert not v.attack and 949110 not in v.rule_ids

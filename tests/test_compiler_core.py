@@ -380,8 +380,9 @@ def test_count_form_targets_evaluated_exactly():
     # Host missing (but other headers present) -> count 0 -> fires
     v = p.detect([Request(uri="/q", headers={"Accept": "*/*"})])[0]
     assert v.attack and v.rule_ids == [920280]
-    # mixed targets: count form now keeps its base stream too
+    # mixed targets: count form keeps its base streams too (ARGS spans
+    # both the query-args and body streams — ARGS_GET ∪ ARGS_POST)
     rules = parse_seclang(
         'SecRule &ARGS|REQUEST_URI "@rx (?i)union\\s+select" '
         '"id:942999,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"')
-    assert sorted(rules[0].targets) == ["args", "uri"]
+    assert sorted(rules[0].targets) == ["args", "body", "uri"]

@@ -65,8 +65,11 @@ def test_compresswhitespace_rule_on_squash_variant():
         '"id:3,phase:2,block,t:urlDecodeUni,t:lowercase,t:compressWhitespace"'
     )
     cr = compile_ruleset(rules)
-    assert cr.rules[0].variant == 4  # squash_dec
-    assert VARIANTS[4] == "squash_dec"
+    # ws-collapse + urlDecode WITHOUT html decode → squash_urldec (5):
+    # scanning the html-decoded row would delete factor bytes the rule's
+    # own chain keeps ("&#x61;" → "a") — round-3 prefilter-gate finding
+    assert cr.rules[0].variant == 5
+    assert VARIANTS[5] == "squash_urldec"
     # whitespace positions vanish on both sides: factor is "unionselect"
     assert _hits(cr, squash(b"1 union   select 2"))[0]
     assert _hits(cr, squash(b"1 union\t\nselect 2"))[0]

@@ -173,22 +173,62 @@ def test_encoded_separator_does_not_fabricate_args():
     assert p2.detect([Request(uri="/q?a=1&b=2")])[0].attack
 
 
-def test_unparseable_body_count_abstains_not_zero():
-    """A present-but-unparseable body must not report an exact count of
-    0 — '&ARGS_POST "@eq 0"' would block every large/JSON POST (review
-    finding).  An absent body IS a faithful 0."""
+def test_body_args_counts_follow_content_type():
+    """ARGS_POST counts mirror ModSecurity's body-processor selection:
+    an urlencoded body (by Content-Type, any size) parses into real
+    values; a multipart body ABSTAINS (we don't model its parser —
+    splitting it on '&'/'=' fabricated pairs, review finding); a JSON
+    body faithfully has an EMPTY ARGS_POST (its processor feeds a
+    different collection)."""
     p = _pipeline('SecRule &ARGS_POST "@eq 0" '
                   '"id:920991,phase:2,block,severity:CRITICAL,'
                   'tag:\'attack-protocol\'"')
-    big_form = ("k=" + "v" * (1 << 17)).encode()   # too big to k/v-split
+    ct_form = {"Content-Type": "application/x-www-form-urlencoded"}
+    # large declared form still parses (no size-heuristic misfire)
+    big_form = ("k=" + "v" * (1 << 17)).encode()
     assert not p.detect([Request(method="POST", uri="/f",
+                                 headers=ct_form,
                                  body=big_form)])[0].attack
-    json_body = b'{"a": 1, "b=c": 2}'
-    assert not p.detect([Request(method="POST", uri="/f",
-                                 body=json_body)])[0].attack
-    # genuinely form-shaped with args present -> count > 0 -> no fire
-    assert not p.detect([Request(method="POST", uri="/f",
-                                 body=b"a=1&b=2")])[0].attack
+    # multipart: abstain, never fabricate pairs or a zero count
+    mp = Request(method="POST", uri="/f",
+                 headers={"Content-Type":
+                          "multipart/form-data; boundary=xYz"},
+                 body=b'--xYz\r\nContent-Disposition: form-data; '
+                      b'name="f"\r\n\r\nv=1\r\n--xYz--\r\n')
+    assert not p.detect([mp])[0].attack
+    # JSON body: ARGS_POST is faithfully empty -> @eq 0 fires
+    js = Request(method="POST", uri="/f",
+                 headers={"Content-Type": "application/json"},
+                 body=b'{"a": 1}')
+    assert p.detect([js])[0].attack
+
+
+def test_args_union_includes_post_args():
+    """ModSecurity's ARGS is ARGS_GET ∪ ARGS_POST: a count rule must
+    see body args on a form POST (review finding: query-only counts
+    fabricated '&ARGS @eq 0' fires on every POST)."""
+    p = _pipeline('SecRule &ARGS "@eq 0" '
+                  '"id:920986,phase:2,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    ct = {"Content-Type": "application/x-www-form-urlencoded"}
+    post = Request(method="POST", uri="/f", headers=ct, body=b"a=1")
+    assert not p.detect([post])[0].attack
+    # negated/numeric per-value ops see body args too
+    p2 = _pipeline('SecRule ARGS "@gt 100" '
+                   '"id:920988,phase:2,block,severity:CRITICAL,'
+                   'tag:\'attack-protocol\'"')
+    assert p2.detect([Request(method="POST", uri="/f", headers=ct,
+                              body=b"n=500")])[0].attack
+
+
+def test_request_line_negation_abstains():
+    """REQUEST_LINE only approximates to the uri stream (no method or
+    protocol text): a negated op must abstain, not fire on every
+    request (review finding)."""
+    p = _pipeline('SecRule REQUEST_LINE "!@rx ^(?:GET|POST)" '
+                  '"id:920987,phase:1,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    assert not p.detect([Request(method="GET", uri="/index.html")])[0].attack
 
 
 def test_valueless_parameter_is_a_variable():
@@ -232,3 +272,37 @@ def test_chain_links_resolve_their_own_raw_targets():
     assert not p.detect([auth])[0].attack
     other = Request(uri="/public", headers={"Host": "h"})
     assert not p.detect([other])[0].attack
+
+
+def test_response_status_rule_always_confirms():
+    """RESPONSE_STATUS text never appears in a scanned stream: such
+    rules must compile always-confirm, not with a dead prefilter
+    (round-3 review)."""
+    from ingress_plus_tpu.serve.normalize import Response
+
+    rules = parse_seclang('SecRule RESPONSE_STATUS "@rx ^5\\\\d\\\\d$" '
+                          '"id:950999,phase:4,block,severity:CRITICAL,'
+                          'tag:\'attack-leak\'"')
+    cr = compile_ruleset(rules)
+    assert cr.tables.rule_nfactors[0] == 0
+    p = DetectionPipeline(cr, mode="block", anomaly_threshold=3)
+    hit = Response(status=503, headers={"Content-Type": "text/plain"},
+                   body=b"upstream sad")
+    ok = Response(status=200, headers={"Content-Type": "text/plain"},
+                  body=b"fine")
+    assert p.detect([hit])[0].attack
+    assert not p.detect([ok])[0].attack
+
+
+def test_tx_only_rule_abstains_not_args():
+    """A rule targeting only TX (anomaly-score plumbing) must abstain —
+    falling back to args would evaluate '@ge 5' against arg values
+    (round-3 review: the abstain branch had gone dead)."""
+    rules = parse_seclang('SecRule TX:ANOMALY_SCORE "@ge 5" '
+                          '"id:949110,phase:2,block,severity:CRITICAL,'
+                          'tag:\'attack-generic\'"')
+    assert rules[0].targets == []
+    p = _pipeline('SecRule TX:ANOMALY_SCORE "@ge 5" '
+                  '"id:949110,phase:2,block,severity:CRITICAL,'
+                  'tag:\'attack-generic\'"')
+    assert not p.detect([Request(uri="/q?n=7")])[0].attack

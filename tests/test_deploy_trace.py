@@ -53,6 +53,32 @@ def test_static_manifests_in_sync(tmp_path):
             "ingress_plus_tpu.control.deploy" % f.name
 
 
+def test_values_yaml_drives_render():
+    """The one-values-file packaging contract (VERDICT round-2 item 8):
+    deploy/values.yaml parses into DeployValues, every key is honored,
+    and a typo'd key fails loudly."""
+    import pytest
+
+    text = (REPO / "deploy" / "values.yaml").read_text()
+    v = DeployValues.from_yaml(text)
+    assert v.namespace == "ingress-plus-tpu" and v.chips_per_host == 4
+    # committed values == defaults, so the committed static render is
+    # exactly what the values file produces
+    assert render_all(v) == render_all(DeployValues())
+
+    custom = DeployValues.from_yaml(
+        "replicas: 5\nbalance: chash\nfail-open: false\n"
+        "deadline-ms: 75\ntenants:\n  1: [attack-sqli, attack-xss]\n")
+    assert custom.replicas == 5 and custom.balance == "chash"
+    assert custom.fail_open is False and custom.deadline_ms == 75
+    assert custom.tenants == {1: ["attack-sqli", "attack-xss"]}
+    dep = render_all(custom)["deployment.yaml"]
+    assert "replicas: 5" in dep and "chash" in dep
+
+    with pytest.raises(ValueError, match="unknown key"):
+        DeployValues.from_yaml("replcias: 5\n")
+
+
 def test_trace_ring_bounds_and_slowest():
     from ingress_plus_tpu.utils.trace import BatchTrace, TraceRing
 

@@ -41,10 +41,13 @@ def _mk_batch(ruleset, n_req=8, rows_per_req=2):
             rows.append(payloads[(q + r) % len(payloads)])
             row_req.append(q)
     tokens, lengths = pad_rows(rows, round_to=64)
-    n_sv = 25  # 5 streams... 4 streams × 5 variants + headroom
-    sv = np.zeros((len(rows), n_sv), np.int8)
-    sv[:, 5:10] = 1  # args stream, every variant (payloads are plain text)
-    return tokens, lengths, np.asarray(row_req, np.int32), sv[:, :20]
+    from ingress_plus_tpu.compiler.ruleset import N_SV, VARIANTS
+    from ingress_plus_tpu.compiler.seclang import STREAM_INDEX
+
+    sv = np.zeros((len(rows), N_SV), np.int8)
+    a = STREAM_INDEX["args"] * len(VARIANTS)
+    sv[:, a:a + len(VARIANTS)] = 1  # args stream, every variant
+    return tokens, lengths, np.asarray(row_req, np.int32), sv
 
 
 def test_tp_sharded_equals_single_device(ruleset):

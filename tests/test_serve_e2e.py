@@ -28,6 +28,8 @@ SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)<script" \
     "id:941100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-xss'"
 SecRule REQUEST_URI|ARGS|REQUEST_BODY|REQUEST_HEADERS "@rx /etc/passwd" \
     "id:930120,phase:2,block,severity:CRITICAL,tag:'attack-lfi'"
+SecRule RESPONSE_BODY "@rx (?i)you have an error in your sql syntax" \
+    "id:951100,phase:4,block,t:lowercase,severity:CRITICAL,tag:'attack-leak'"
 """
 
 
@@ -167,6 +169,42 @@ def test_python_client_roundtrip(server):
     assert 942100 in got[7001]["rule_ids"]
     assert not got[7002]["attack"]
 
+def test_response_scan_over_wire(server):
+    """Response-side analysis (wallarm_parse_response analog): a PTPI
+    frame carrying an upstream response with a planted SQL error leak
+    must come back flagged; a clean response must not.  Request-side
+    rules must NOT fire on response bytes (station-keeping: the planted
+    body contains 'union select' too, but 942100 targets request
+    streams only)."""
+    from ingress_plus_tpu.serve.protocol import (
+        RESP_MAGIC, FrameReader, decode_response, encode_response_scan)
+    from ingress_plus_tpu.serve.normalize import Response
+
+    s = socket.socket(socket.AF_UNIX)
+    s.connect(server)
+    leaky = Response(
+        status=500, headers={"Content-Type": "text/html"},
+        body=b"<h1>Oops</h1>You have an error in your SQL syntax near "
+             b"'union select' at line 1 ")
+    clean = Response(
+        status=200, headers={"Content-Type": "application/json"},
+        body=b'{"status": "ok", "items": [1, 2, 3]}')
+    s.sendall(encode_response_scan(leaky, req_id=8001))
+    s.sendall(encode_response_scan(clean, req_id=8002))
+    reader = FrameReader(RESP_MAGIC)
+    got = {}
+    s.settimeout(120)
+    while len(got) < 2:
+        for f in reader.feed(s.recv(65536)):
+            r = decode_response(f)
+            got[r["req_id"]] = r
+    s.close()
+    assert got[8001]["attack"] and got[8001]["blocked"]
+    assert got[8001]["rule_ids"] == [951100]
+    assert got[8001]["classes"] == ["leak"]
+    assert not got[8002]["attack"]
+
+
 def test_streaming_body_over_wire(server):
     """Config #5 on the wire: MODE_STREAM request + chunk frames; attack
     spans a chunk boundary; a parallel clean stream passes."""
@@ -275,7 +313,7 @@ def test_configuration_endpoints_and_dbg(server, tmp_path):
 
     conf = json.loads(urllib.request.urlopen(
         "http://127.0.0.1:19901/configuration", timeout=10).read())
-    assert conf["rules"] == 3 and conf["tenants"] == 1, conf
+    assert conf["rules"] == 4 and conf["tenants"] == 1, conf
 
     # push a tenant table: tenant 1 = sqli only
     req = urllib.request.Request(

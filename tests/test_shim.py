@@ -123,3 +123,21 @@ def test_nginx_module_directives_match_template():
             assert 'ngx_string("%s")' % directive in module_src, \
                 "template renders %r but the module doesn't define it" \
                 % directive
+
+
+def test_nginx_module_compiles():
+    """The 700-LoC nginx module must go through a real compiler in CI
+    (round-2 VERDICT: a typo'd nginx symbol would otherwise ship).  The
+    vendored nginx_compat headers declare the exact public-API subset
+    the module uses; -Wall -Wextra -Werror, so unused or mistyped
+    anything fails the suite."""
+    if shutil.which("gcc") is None and shutil.which("cc") is None:
+        pytest.skip("no C compiler")
+    obj = REPO / "native" / "shim" / "ngx_http_detect_tpu_module.o"
+    if obj.exists():
+        obj.unlink()
+    out = subprocess.run(
+        ["make", "-C", str(REPO / "native" / "shim"), "module"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert obj.exists()

@@ -31,6 +31,8 @@ SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)<script" \
     "id:941100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-xss'"
 SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx /etc/passwd" \
     "id:930120,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-lfi'"
+SecRule RESPONSE_BODY "@rx (?i)you have an error in your sql syntax" \
+    "id:951100,phase:4,block,t:lowercase,severity:CRITICAL,tag:'attack-leak'"
 """
 
 
@@ -156,6 +158,29 @@ def test_verdict_roundtrip(sidecar):
     v = c.recv_verdict()
     assert v["req_id"] == 8
     assert not v["attack"] and not v["blocked"]
+    c.close()
+
+
+def test_response_scan_through_sidecar(sidecar):
+    """PTPI frames route through the real sidecar binary like requests
+    (balanced, deadline-tracked, verdict restored to the original
+    req_id) — the minimal sidecar honor of detect_tpu_parse_response."""
+    from ingress_plus_tpu.serve.normalize import Response
+    from ingress_plus_tpu.serve.protocol import encode_response_scan
+
+    c = Client(sidecar)
+    c.send(encode_response_scan(Response(
+        status=500, headers={"Content-Type": "text/html"},
+        body=b"You have an error in your SQL syntax near 'x'"),
+        req_id=901))
+    v = c.recv_verdict()
+    assert v["req_id"] == 901
+    assert v["attack"] and v["blocked"] and not v["fail_open"]
+    assert v["rule_ids"] == [951100]
+    c.send(encode_response_scan(Response(
+        status=200, headers={}, body=b"all fine here"), req_id=902))
+    v = c.recv_verdict()
+    assert v["req_id"] == 902 and not v["attack"]
     c.close()
 
 
