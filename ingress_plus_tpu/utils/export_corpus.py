@@ -17,12 +17,17 @@ from ingress_plus_tpu.utils.corpus import generate_corpus
 
 
 def export(path: str, n: int = 10_000, seed: int = 20260729,
-           attack_fraction: float = 0.2, tenants: int = 1) -> int:
+           attack_fraction: float = 0.2, tenants: int = 1,
+           mode: int = 2) -> int:
+    """``mode=0`` exports wallarm_mode-off frames: the serve loop returns
+    an instant clean verdict without touching the pipeline, so a loadgen
+    replay of such a corpus measures the pure boundary chain
+    (loadgen→sidecar→serve framing), bench.py's chain-overhead leg."""
     corpus = generate_corpus(n=n, attack_fraction=attack_fraction,
                              seed=seed, tenants=tenants)
     with open(path, "wb") as f:
         for i, lr in enumerate(corpus):
-            f.write(encode_request(lr.request, req_id=i + 1))
+            f.write(encode_request(lr.request, req_id=i + 1, mode=mode))
     return len(corpus)
 
 
