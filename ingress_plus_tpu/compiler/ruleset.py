@@ -174,13 +174,28 @@ def _anomaly_increment(rule: Rule, env: Dict[str, str]) -> Optional[int]:
     return None
 
 
+#: TX selectors that ARE the inbound request-blocking score.  Outbound
+#: (959-style response evaluation) and per-PL sub-score rules
+#: (TX:ANOMALY_SCORE_PL1) must NOT set the pipeline's request threshold —
+#: on real CRS the outbound threshold (4) sorts after 949110's inbound
+#: (5) and a last-wins match would silently lower the blocking bar
+#: (round-3 review finding).
+_INBOUND_SCORE_SELECTORS = {
+    "ANOMALY_SCORE", "INBOUND_ANOMALY_SCORE",
+    "BLOCKING_INBOUND_ANOMALY_SCORE",
+}
+
+
 def _threshold_from_rule(rule: Rule, env: Dict[str, str]) -> Optional[int]:
-    """Detect the 949-style blocking rule: TX:...ANOMALY_SCORE '@ge N'
+    """Detect the 949-style blocking rule: TX:ANOMALY_SCORE '@ge N'
     (N possibly a %{tx.*} macro).  Returns the resolved threshold."""
     if rule.operator not in ("ge", "gt"):
         return None
-    if not any("anomaly_score" in t.lower() and t.upper().startswith("TX")
-               for t in rule.raw_targets):
+    def _is_inbound(t: str) -> bool:
+        base, _, sel = t.partition(":")
+        return (base.strip().upper() == "TX"
+                and sel.strip().upper() in _INBOUND_SCORE_SELECTORS)
+    if not any(_is_inbound(t) for t in rule.raw_targets):
         return None
     resolved = resolve_macros(rule.argument.strip(), env)
     if resolved is None:

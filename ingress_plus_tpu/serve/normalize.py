@@ -144,6 +144,18 @@ def variant_chain(data: bytes, variant: int) -> bytes:
     raise ValueError("unknown variant %d" % variant)
 
 
+def headers_blob(headers) -> bytes:
+    """Canonical "key: value\\x1f..." header join — the ONE definition
+    shared by the wire encoders (protocol.py) and the scan/confirm models
+    below, so wire bytes and confirm bytes can never drift apart.  \\x1f
+    (unit separator) survives every transform, matches no rule, and
+    prevents cross-header false adjacency (\\n would trip the
+    CRLF-injection rules on every request)."""
+    return b"\x1f".join(
+        ("%s: %s" % (k, v)).encode("utf-8", "surrogateescape")
+        for k, v in headers.items())
+
+
 @dataclass
 class Request:
     """Neutral HTTP-request model (what the sidecar ships over UDS)."""
@@ -178,14 +190,9 @@ class Request:
         uri = self.uri.encode("utf-8", "surrogateescape")
         q = uri.find(b"?")
         args = url_decode_uni(uri[q + 1 :]) if q >= 0 else b""
-        # Header values are separate match units in ModSecurity; we join
-        # them with \x1f (unit separator): survives every transform chain,
-        # is matched by no rule, and prevents cross-header false adjacency
-        # (\n would trip the CRLF-injection rules on every request).
-        hdr = b"\x1f".join(
-            ("%s: %s" % (k, v)).encode("utf-8", "surrogateescape")
-            for k, v in self.headers.items()
-        )
+        # Header values are separate match units in ModSecurity; the
+        # shared headers_blob join keeps them separate (see its docstring)
+        hdr = headers_blob(self.headers)
         # body unpack (gzip/b64/json/xml — SURVEY.md §3.3): the scan AND
         # the confirm stage both call streams(), so they see identical
         # unpacked bytes — the prefilter∧confirm contract holds through
@@ -242,9 +249,7 @@ class Response:
     uri = ""
 
     def streams(self) -> Dict[str, bytes]:
-        hdr = b"\x1f".join(
-            ("%s: %s" % (k, v)).encode("utf-8", "surrogateescape")
-            for k, v in self.headers.items())
+        hdr = headers_blob(self.headers)
         body = self.body
         if body:
             # same unpack stage as requests (wallarm-unpack-response):

@@ -60,6 +60,25 @@ wallarm-unpack-response analog — upstream HTTP responses scanned for the
     body_len u32
     bytes: headers, body
 
+WebSocket capture frame (client → server; the wallarm_parse_websocket
+analog — raw upgraded-connection bytes, either direction; serve parses
+RFC 6455 framing and scans messages — serve/websocket.py):
+    magic   u32  'WTPI' (b"WTPI")
+    length  u32
+    req_id  u64  — unique per frame; correlates this frame's RTPI verdict
+    stream  u64  — upgraded-connection id: keys persistent parser/scan
+                   state across frames (sidecar rewrites it globally
+                   unique, like req_id)
+    tenant  u32
+    mode    u8   — same bits as the request frame
+    flags   u8   — bit0: direction is server→client; bit1: stream end
+                   (connection closed — finalize and free state)
+    bytes: raw WebSocket wire bytes (any chunking: partial frames fine)
+
+Every WTPI frame gets exactly ONE RTPI verdict (sidecar bookkeeping is
+identical to requests); the verdict is the stream's sticky attack state
+after the messages this frame completed.
+
 Responses may arrive out of order; req_id correlates.
 """
 
@@ -70,17 +89,19 @@ from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 from ingress_plus_tpu.compiler.seclang import CLASSES
-from ingress_plus_tpu.serve.normalize import Request, Response
+from ingress_plus_tpu.serve.normalize import Request, Response, headers_blob
 
 REQ_MAGIC = b"QTPI"
 RESP_MAGIC = b"RTPI"
 CHUNK_MAGIC = b"KTPI"
 RSCAN_MAGIC = b"PTPI"
+WS_MAGIC = b"WTPI"
 
 _REQ_HEAD = struct.Struct("<QIBB III")   # req_id tenant mode m_len | uri hdr body
 _RESP_HEAD = struct.Struct("<QBIBH")     # req_id flags score n_cls n_rules
 _CHUNK_HEAD = struct.Struct("<QB")       # req_id flags
 _RSCAN_HEAD = struct.Struct("<QIBH II")  # req_id tenant mode status | hdr body
+_WS_HEAD = struct.Struct("<QQIBB")       # req_id stream tenant mode flags
 
 FLAG_ATTACK = 1
 FLAG_BLOCKED = 2
@@ -88,6 +109,8 @@ FLAG_FAIL_OPEN = 4
 
 MODE_STREAM = 0x80     # request-frame mode bit: body arrives chunked
 CHUNK_LAST = 1         # chunk-frame flag: final chunk of the stream
+WS_DIR_S2C = 1         # ws-frame flag bit0: bytes are server→client
+WS_END = 2             # ws-frame flag bit1: upgraded connection closed
 
 # Mode-byte bits 3-6: per-location parser disables (wallarm-parser-disable
 # → detect_tpu_parser_disable).  These ride the TRUSTED config plane
@@ -122,9 +145,7 @@ def encode_request(req: Request, req_id: int, mode: int = 2) -> bytes:
         mode |= PARSER_OFF_BITS.get(p, 0)
     method = req.method.encode()
     uri = req.uri.encode("utf-8", "surrogateescape")
-    hdr = b"\x1f".join(
-        ("%s: %s" % (k, v)).encode("utf-8", "surrogateescape")
-        for k, v in req.headers.items())
+    hdr = headers_blob(req.headers)
     payload = _REQ_HEAD.pack(req_id, req.tenant, mode, len(method),
                              len(uri), len(hdr), len(req.body))
     payload += method + uri + hdr + req.body
@@ -166,9 +187,7 @@ def decode_request(payload: bytes) -> Tuple[int, int, Request]:
 def encode_response_scan(resp: Response, req_id: int, mode: int = 2) -> bytes:
     for p in resp.parsers_off:
         mode |= PARSER_OFF_BITS.get(p, 0)
-    hdr = b"\x1f".join(
-        ("%s: %s" % (k, v)).encode("utf-8", "surrogateescape")
-        for k, v in resp.headers.items())
+    hdr = headers_blob(resp.headers)
     payload = _RSCAN_HEAD.pack(req_id, resp.tenant, mode,
                                resp.status & 0xFFFF, len(hdr),
                                len(resp.body))
@@ -200,6 +219,22 @@ def decode_response_scan(payload: bytes) -> Tuple[int, int, Response]:
     return req_id, mode & ~_PARSER_MASK, Response(
         status=status, headers=headers, body=body, tenant=tenant,
         request_id=str(req_id), parsers_off=parsers_off)
+
+
+def encode_ws(req_id: int, stream_id: int, data: bytes, tenant: int = 0,
+              mode: int = 2, s2c: bool = False, end: bool = False) -> bytes:
+    flags = (WS_DIR_S2C if s2c else 0) | (WS_END if end else 0)
+    payload = _WS_HEAD.pack(req_id, stream_id, tenant, mode, flags) + data
+    return WS_MAGIC + struct.pack("<I", len(payload)) + payload
+
+
+def decode_ws(payload: bytes) -> Tuple[int, int, int, int, int, bytes]:
+    """payload after magic+length.  Returns
+    (req_id, stream_id, tenant, mode, flags, data)."""
+    if len(payload) < _WS_HEAD.size:
+        raise ProtocolError("short ws frame")
+    req_id, stream_id, tenant, mode, flags = _WS_HEAD.unpack_from(payload)
+    return req_id, stream_id, tenant, mode, flags, payload[_WS_HEAD.size:]
 
 
 def encode_response(req_id: int, attack: bool, blocked: bool,

@@ -22,23 +22,31 @@ import time
 from pathlib import Path
 from typing import Optional
 
+from ingress_plus_tpu.models.pipeline import Verdict
 from ingress_plus_tpu.serve.batcher import Batcher
 from ingress_plus_tpu.serve.stream import StreamState
 from ingress_plus_tpu.serve.protocol import (
     CHUNK_MAGIC,
     MODE_STREAM,
+    PARSER_OFF_BITS,
     REQ_MAGIC,
     RSCAN_MAGIC,
+    WS_DIR_S2C,
+    WS_END,
+    WS_MAGIC,
     MultiFrameReader,
     ProtocolError,
     decode_chunk,
     decode_request,
     decode_response_scan,
+    decode_ws,
     encode_response,
 )
+from ingress_plus_tpu.serve.websocket import DIR_C2S, DIR_S2C, WSStream
 
 
 MAX_STREAMS_PER_CONN = 256  # bounded per-connection stream state
+MAX_WS_PER_CONN = 128       # bounded per-connection upgraded-conn state
 _OVERFLOW = object()        # sentinel: stream rejected by the cap
 
 
@@ -59,9 +67,10 @@ class ServeLoop:
                            writer: asyncio.StreamWriter) -> None:
         self.connections += 1
         frames = MultiFrameReader({REQ_MAGIC: "req", CHUNK_MAGIC: "chunk",
-                                   RSCAN_MAGIC: "rscan"})
+                                   RSCAN_MAGIC: "rscan", WS_MAGIC: "ws"})
         loop = asyncio.get_running_loop()
         streams = {}  # req_id → StreamState | None (None = mode-off stream)
+        ws_streams = {}  # stream_id → WSStream | None (off) | _OVERFLOW
         write_lock = asyncio.Lock()
         classes_index = {c: i for i, c in enumerate(
             self.batcher.pipeline.ruleset.classes)}
@@ -87,6 +96,15 @@ class ServeLoop:
                 pass  # client went away mid-verdict; nothing to deliver to
 
         pending = set()
+
+        def send_pass(req_id: int, fail_open: bool = False) -> None:
+            # clean pass verdict (mode off / overflow shed), unscanned
+            t = asyncio.ensure_future(respond(req_id, Verdict(
+                request_id=str(req_id), blocked=False, attack=False,
+                classes=[], rule_ids=[], score=0, fail_open=fail_open)))
+            pending.add(t)
+            t.add_done_callback(pending.discard)
+
         try:
             while True:
                 data = await reader.read(1 << 16)
@@ -110,18 +128,8 @@ class ServeLoop:
                         if last:
                             streams.pop(req_id)
                             if not isinstance(handle, StreamState):
-                                # mode off (clean pass) or overflow
-                                # (pass + fail-open flag), unscanned
-                                from ingress_plus_tpu.models.pipeline import \
-                                    Verdict
-                                t = asyncio.ensure_future(respond(
-                                    req_id, Verdict(
-                                        request_id=str(req_id),
-                                        blocked=False, attack=False,
-                                        classes=[], rule_ids=[], score=0,
-                                        fail_open=handle is _OVERFLOW)))
-                                pending.add(t)
-                                t.add_done_callback(pending.discard)
+                                send_pass(req_id,
+                                          fail_open=handle is _OVERFLOW)
                                 continue
                             fut = self.batcher.finish_stream(handle)
                             afut = asyncio.wrap_future(fut, loop=loop)
@@ -139,6 +147,83 @@ class ServeLoop:
                                     pending.add(rt)
                                     rt.add_done_callback(pending.discard)
                             task.add_done_callback(_sdone)
+                        continue
+                    if kind == "ws":
+                        # wallarm_parse_websocket analog: raw upgraded-
+                        # connection bytes; parse RFC 6455, scan messages
+                        # (serve/websocket.py), answer one RTPI per frame
+                        try:
+                            (req_id, stream_id, tenant, mode, wflags,
+                             wdata) = decode_ws(payload)
+                        except ProtocolError:
+                            continue
+                        ws = ws_streams.get(stream_id)
+                        if ws is None and stream_id not in ws_streams:
+                            eff_mode = mode & 0x03
+                            if eff_mode == 0:
+                                ws_streams[stream_id] = None
+                            elif (sum(1 for w in ws_streams.values()
+                                      if isinstance(w, WSStream))
+                                  >= MAX_WS_PER_CONN):
+                                ws_streams[stream_id] = _OVERFLOW
+                                self.batcher.pipeline.stats.fail_open += 1
+                            else:
+                                off = frozenset(
+                                    n for n, bit in PARSER_OFF_BITS.items()
+                                    if mode & bit)
+                                ws_streams[stream_id] = WSStream(
+                                    self.batcher, tenant, eff_mode,
+                                    stream_id, parsers_off=off)
+                            ws = ws_streams[stream_id]
+                        if not isinstance(ws, WSStream):
+                            # mode off or overflow — state-free
+                            if wflags & WS_END:
+                                ws_streams.pop(stream_id, None)
+                            send_pass(req_id, fail_open=ws is _OVERFLOW)
+                            continue
+                        direction = (DIR_S2C if wflags & WS_DIR_S2C
+                                     else DIR_C2S)
+                        pairs = ws.feed(direction, wdata)
+                        if wflags & WS_END:
+                            pairs += ws.close()
+                            ws_streams.pop(stream_id, None)
+
+                        prev_reply = getattr(ws, "_prev_reply", None)
+
+                        async def _ws_reply(req_id=req_id, ws=ws,
+                                            pairs=pairs, prev=prev_reply):
+                            # replies are serialized PER STREAM (frames
+                            # of one upgraded connection answer in
+                            # order, so the sticky verdict is monotonic
+                            # on the wire); streams stay concurrent
+                            if prev is not None:
+                                try:
+                                    await prev
+                                except Exception:
+                                    pass
+                            # fold completed-message verdicts into the
+                            # stream's sticky state, then answer with it;
+                            # each message is recorded to postanalytics
+                            # individually (the frame verdict is not)
+                            for msg, fut in pairs:
+                                try:
+                                    v = await asyncio.wrap_future(
+                                        fut, loop=loop)
+                                except Exception:
+                                    ws.sticky_fail_open = True
+                                    continue
+                                ws.merge(v)
+                                if self.post is not None:
+                                    try:
+                                        self.post.record(msg, v)
+                                    except Exception:
+                                        pass
+                            await respond(req_id, ws.verdict(req_id))
+
+                        t = asyncio.ensure_future(_ws_reply())
+                        ws._prev_reply = t
+                        pending.add(t)
+                        t.add_done_callback(pending.discard)
                         continue
                     try:
                         if kind == "rscan":
@@ -179,12 +264,7 @@ class ServeLoop:
                     if mode == 0:
                         # wallarm_mode off: no processing at all (reference
                         # semantics) — immediate pass, skip the engine
-                        from ingress_plus_tpu.models.pipeline import Verdict
-                        t = asyncio.ensure_future(respond(req_id, Verdict(
-                            request_id=request.request_id, blocked=False,
-                            attack=False, classes=[], rule_ids=[], score=0)))
-                        pending.add(t)
-                        t.add_done_callback(pending.discard)
+                        send_pass(req_id)
                         continue
                     request.mode = mode
                     fut = self.batcher.submit(request)
@@ -205,6 +285,9 @@ class ServeLoop:
             for handle in streams.values():
                 if isinstance(handle, StreamState):
                     self.batcher.abort_stream(handle)
+            for w in ws_streams.values():
+                if isinstance(w, WSStream):
+                    w.abort()
             for t in pending:
                 t.cancel()
             writer.close()

@@ -108,6 +108,32 @@ class DetectClient {
     return WaitVerdict(req_id, deadline, fail);
   }
 
+  // WebSocket capture (wallarm_parse_websocket analog): ship raw
+  // upgraded-connection bytes (either direction, any chunking) under a
+  // persistent stream id; each call returns this frame's verdict — the
+  // stream's sticky attack state, so the caller can kill the tunnel as
+  // soon as any message scanned as an attack.  Pass `end=true` when the
+  // connection closes so the serve side frees its parser state.  Same
+  // fail-open discipline as Detect.
+  Response DetectWsBytes(uint64_t req_id, uint64_t stream_id,
+                         const std::string& data, uint32_t tenant = 0,
+                         uint8_t mode = 2, bool server_to_client = false,
+                         bool end = false) {
+    Response fail;
+    fail.req_id = req_id;
+    fail.flags = kFailOpen;
+    uint64_t deadline = NowNs() + uint64_t(deadline_ms_ * 1e6);
+    if (fd_ < 0 && !Connect()) return fail;
+    uint8_t flags = (server_to_client ? kWsDirS2C : 0) | (end ? kWsEnd : 0);
+    std::string frame = EncodeWs(req_id, stream_id, data, tenant, mode,
+                                 flags);
+    if (!SendAll(frame.data(), frame.size(), deadline)) {
+      Close();
+      return fail;
+    }
+    return WaitVerdict(req_id, deadline, fail);
+  }
+
   bool connected() const { return fd_ >= 0; }
 
  private:

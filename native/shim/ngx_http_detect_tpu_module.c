@@ -77,6 +77,31 @@ extern ngx_int_t detect_tpu_response_roundtrip(
     const char *body, size_t body_len,
     /* out */ uint8_t *flags, uint32_t *score);
 
+/* WebSocket capture twin (shim_bridge.cc): ships raw upgraded-connection
+ * bytes under a persistent stream id; the returned flags are the
+ * stream's STICKY verdict (once any message scanned as an attack, every
+ * later call reports it), so the enforcement point closes the tunnel as
+ * soon as a block flag comes back.  detect_tpu_parse_websocket gates it.
+ *
+ * Where it hooks: upgraded connections bypass nginx's HTTP filter
+ * chain entirely (the proxy module tunnels at the event layer after the
+ * 101), so capture CANNOT ride this module's access/body-filter phases —
+ * the reference's module wraps the upgraded connection's read/write
+ * handlers inside its closed-source core†.  Our equivalent enforcement
+ * points are (a) the upgrade relay calling this bridge per tunnel read
+ * (ngx_http_upstream's upgraded r/w handlers wrapped the same way — a
+ * deeper nginx patch than the vendored API-subset headers model here),
+ * and (b) sidecar-level capture for deployments where the sidecar IS the
+ * relay.  The wire protocol, serve-side RFC 6455 parse/scan, sticky
+ * verdicts and teardown are complete and e2e-tested through (b)
+ * (tests/test_sidecar.py, tests/test_shim.py ws cases). */
+extern ngx_int_t detect_tpu_ws_roundtrip(
+    const char *socket_path, double timeout_ms, uint64_t req_id,
+    uint64_t stream_id, uint32_t tenant, uint8_t mode,
+    int server_to_client, int end,
+    const char *data, size_t data_len,
+    /* out */ uint8_t *flags, uint32_t *score);
+
 /* response bodies beyond this are scanned in their first megabyte only
  * (the serve loop's oversized reroute guards the request side; response
  * leak patterns — error pages, stack traces — sit at the front) */

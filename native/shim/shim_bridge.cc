@@ -58,6 +58,32 @@ extern "C" int detect_tpu_response_roundtrip(
   }
 }
 
+// WebSocket capture for upgraded connections (detect_tpu_parse_websocket
+// on): ships raw tunnel bytes (either direction), returns the stream's
+// sticky verdict flags — the caller closes the tunnel on a block flag.
+// `end` non-zero frees the serve-side stream state (connection closed).
+extern "C" int detect_tpu_ws_roundtrip(
+    const char* socket_path, double timeout_ms, uint64_t req_id,
+    uint64_t stream_id, uint32_t tenant, uint8_t mode,
+    int server_to_client, int end,
+    const char* data, size_t data_len,
+    uint8_t* flags, uint32_t* score) {
+  try {
+    ipt::DetectClient* client = ClientFor(socket_path, timeout_ms);
+    std::string bytes(data ? data : "", data_len);
+    ipt::Response r = client->DetectWsBytes(
+        req_id, stream_id, bytes, tenant, mode, server_to_client != 0,
+        end != 0);
+    *flags = r.flags;
+    *score = r.score;
+    return 0;  /* NGX_OK */
+  } catch (...) {
+    *flags = 4;  /* fail_open */
+    *score = 0;
+    return 0;
+  }
+}
+
 extern "C" int detect_tpu_roundtrip(
     const char* socket_path, double timeout_ms, uint64_t req_id,
     uint32_t tenant, uint8_t mode, const char* method, size_t method_len,

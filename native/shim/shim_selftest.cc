@@ -48,6 +48,33 @@ int main(int argc, char** argv) {
     printf("{\"case\": \"stream\", \"error\": true}\n");
   }
 
+  // websocket capture: masked client frame carrying an attack, split
+  // across two capture calls (the serve-side parser carries state), then
+  // a benign frame that must report the sticky verdict, then the end
+  {
+    // minimal RFC 6455 client frame: FIN|text, masked, payload<126
+    auto ws_frame = [](const std::string& payload, bool fin, bool cont) {
+      std::string f;
+      f.push_back(char((fin ? 0x80 : 0x00) | (cont ? 0x0 : 0x1)));
+      f.push_back(char(0x80 | payload.size()));
+      const char mask[4] = {0x21, 0x43, 0x65, 0x07};
+      f.append(mask, 4);
+      for (size_t i = 0; i < payload.size(); ++i)
+        f.push_back(char(payload[i] ^ mask[i & 3]));
+      return f;
+    };
+    ipt::Response r1 = client.DetectWsBytes(
+        5, 900, ws_frame("1 union ", false, false));
+    ipt::Response r2 = client.DetectWsBytes(
+        6, 900, ws_frame("select 2", true, true));
+    print_verdict("ws_attack", r2);
+    ipt::Response r3 = client.DetectWsBytes(
+        7, 900, ws_frame("benign chatter", true, false));
+    print_verdict("ws_sticky", r3);
+    client.DetectWsBytes(8, 900, "", 0, 2, false, /*end=*/true);
+    (void)r1;
+  }
+
   if (argc > 2) {
     ipt::DetectClient dead(argv[2], /*deadline_ms=*/100);
     ipt::Request r;

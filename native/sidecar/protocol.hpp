@@ -20,6 +20,10 @@ inline const char kChunkMagic[4] = {'K', 'T', 'P', 'I'};
 // Response-scan frame (upstream HTTP response → leak analysis; the
 // wallarm_parse_response analog).  Verdict returns as a normal RTPI frame.
 inline const char kRespScanMagic[4] = {'P', 'T', 'P', 'I'};
+// WebSocket capture frame (raw upgraded-connection bytes, either
+// direction; the wallarm_parse_websocket analog).  One RTPI verdict per
+// frame; `stream` keys persistent parser/scan state on the serve side.
+inline const char kWsMagic[4] = {'W', 'T', 'P', 'I'};
 
 enum Flags : uint8_t {
   kAttack = 1,
@@ -37,6 +41,10 @@ constexpr uint8_t kParserOffBase64 = 0x10;
 constexpr uint8_t kParserOffJson = 0x20;
 constexpr uint8_t kParserOffXml = 0x40;
 constexpr uint8_t kChunkLast = 1;
+
+// WS-frame flag bits (twin of protocol.py WS_DIR_S2C / WS_END).
+constexpr uint8_t kWsDirS2C = 1;  // bytes are server→client
+constexpr uint8_t kWsEnd = 2;     // upgraded connection closed
 
 struct Request {
   uint64_t req_id = 0;
@@ -151,6 +159,27 @@ inline std::string EncodeChunk(uint64_t req_id, const std::string& data,
   return frame;
 }
 
+// WebSocket capture frame (twin of protocol.py encode_ws: req_id u64,
+// stream u64, tenant u32, mode u8, flags u8, raw ws bytes).
+inline std::string EncodeWs(uint64_t req_id, uint64_t stream_id,
+                            const std::string& data, uint32_t tenant = 0,
+                            uint8_t mode = 2, uint8_t flags = 0) {
+  std::string payload;
+  payload.reserve(22 + data.size());
+  detail::put<uint64_t>(&payload, req_id);
+  detail::put<uint64_t>(&payload, stream_id);
+  detail::put<uint32_t>(&payload, tenant);
+  payload.push_back(static_cast<char>(mode));
+  payload.push_back(static_cast<char>(flags));
+  payload += data;
+  std::string frame;
+  frame.reserve(8 + payload.size());
+  frame.append(kWsMagic, 4);
+  detail::put<uint32_t>(&frame, static_cast<uint32_t>(payload.size()));
+  frame += payload;
+  return frame;
+}
+
 // Verdict frame, server → client.  The sidecar also synthesizes these for
 // fail-open verdicts (deadline exceeded / upstream down — SURVEY.md §5
 // "fail-open contract is load-bearing").
@@ -204,6 +233,7 @@ constexpr size_t kMinRequestPayload = 26;   // _REQ_HEAD: Q I B B I I I
 constexpr size_t kMinResponsePayload = 16;  // _RESP_HEAD + counts
 constexpr size_t kMinChunkPayload = 9;      // _CHUNK_HEAD: Q B
 constexpr size_t kMinRespScanPayload = 23;  // _RSCAN_HEAD: Q I B H I I
+constexpr size_t kMinWsPayload = 22;        // _WS_HEAD: Q Q I B B
 
 // Incremental splitter for a stream interleaving several frame kinds —
 // C++ twin of protocol.py's MultiFrameReader (the framing loop exists

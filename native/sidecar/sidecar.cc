@@ -81,6 +81,7 @@ struct Options {
 struct Counters {
   uint64_t requests_in = 0;
   uint64_t chunks_in = 0;
+  uint64_t ws_frames_in = 0;
   uint64_t forwarded = 0;
   uint64_t responses = 0;
   uint64_t fail_open_deadline = 0;
@@ -100,6 +101,7 @@ inline ipt::MultiFrameReader MakeDownReader() {
       {ipt::kReqMagic, 0, ipt::kMinRequestPayload},
       {ipt::kChunkMagic, 1, ipt::kMinChunkPayload},
       {ipt::kRespScanMagic, 2, ipt::kMinRespScanPayload},
+      {ipt::kWsMagic, 3, ipt::kMinWsPayload},
   });
 }
 
@@ -116,6 +118,10 @@ struct DownConn {
   // per-connection StreamState leaks on the long-lived mux connection
   // until its per-conn cap trips and streaming fails open permanently
   std::unordered_set<uint64_t> open_streams;
+  // orig stream ids of this conn's open WebSocket captures (same leak
+  // argument: the serve side holds parser + sticky-verdict state per
+  // upgraded connection until an end frame arrives)
+  std::unordered_set<uint64_t> open_ws;
 };
 
 struct Upstream {
@@ -446,6 +452,7 @@ class Sidecar {
                          [&](int kind, const uint8_t* p, size_t len) {
             if (kind == 0) OnRequest(c, p, len);
             else if (kind == 2) OnRespScan(c, p, len);
+            else if (kind == 3) OnWsFrame(c, p, len);
             else OnChunk(c, p, len);
           });
         } catch (const std::exception&) {
@@ -504,6 +511,93 @@ class Sidecar {
     pending_[up_id] = Pending{c->id, orig_id, dl, now, u};
     deadlines_.emplace(dl, up_id);
     AppendUpstream(u, ipt::kRespScanMagic, payload, len, up_id);
+  }
+
+  // WebSocket capture frames: routed like requests (pending entry per
+  // frame → one RTPI verdict each), but STICKY to one upstream per
+  // upgraded connection — the serve loop's RFC 6455 parser and sticky
+  // verdict live there, so a frame on another upstream would desync the
+  // byte stream.  The stream id is rewritten (like req_id) so captures
+  // from different downstream conns can't collide on the shared mux.
+  void OnWsFrame(DownConn* c, const uint8_t* payload, size_t len) {
+    ++counters_.ws_frames_in;
+    uint64_t orig_id = ipt::detail::get<uint64_t>(payload);
+    uint64_t orig_stream = ipt::detail::get<uint64_t>(payload + 8);
+    uint32_t tenant = ipt::detail::get<uint32_t>(payload + 16);
+    uint8_t flags = payload[21];
+    uint64_t key = StreamKey(c->id, orig_stream);
+    auto it = ws_streams_.find(key);
+    int u;
+    uint64_t up_stream;
+    if (it == ws_streams_.end()) {
+      u = PickUpstream(tenant);
+      if (u < 0) {
+        if (AnyReady()) ++counters_.fail_open_overload;
+        else ++counters_.fail_open_upstream;
+        SendFailOpenTo(c, orig_id);
+        return;
+      }
+      up_stream = ++next_up_id_;
+      ws_streams_[key] = WsBinding{u, up_stream};
+      c->open_ws.insert(orig_stream);
+    } else {
+      u = it->second.up_idx;
+      up_stream = it->second.up_stream_id;
+      if (!ups_[size_t(u)].Ready()) {
+        // the bound upstream died: its parser state died with it, so
+        // later bytes can't be scanned coherently — fail the stream
+        // open and drop the binding (a re-established upstream would
+        // see a mid-stream byte sequence it can't parse)
+        ws_streams_.erase(it);
+        c->open_ws.erase(orig_stream);
+        ++counters_.fail_open_upstream;
+        SendFailOpenTo(c, orig_id);
+        return;
+      }
+    }
+    if (ups_[size_t(u)].Backlog() > opt_.max_upstream_buf) {
+      // backlog shed (same cap as body chunks): end the capture — a
+      // gap in the byte stream would poison the serve-side parser
+      // anyway, so tell it to free state and fail this frame open
+      ws_streams_.erase(key);
+      c->open_ws.erase(orig_stream);
+      ++counters_.fail_open_overload;
+      SendFailOpenTo(c, orig_id);
+      EndWsUpstream(u, up_stream);
+      return;
+    }
+    uint64_t now = NowNs();
+    uint64_t up_id = ++next_up_id_;
+    uint64_t dl = now + uint64_t(opt_.deadline_ms * 1e6);
+    pending_[up_id] = Pending{c->id, orig_id, dl, now, u};
+    deadlines_.emplace(dl, up_id);
+    AppendUpstream(u, ipt::kWsMagic, payload, len, up_id, &up_stream);
+    if (flags & ipt::kWsEnd) {
+      ws_streams_.erase(key);
+      c->open_ws.erase(orig_stream);
+    }
+  }
+
+  // Synthesize an end frame so the serve loop frees the upgraded
+  // connection's parser/verdict state.  The serve loop answers EVERY
+  // WTPI frame, so the synthetic one gets a real pending entry under
+  // conn id 0 (no downstream conn ever has id 0): OnVerdict consumes it
+  // symmetrically (inflight/ewma) and finds no conn to deliver to —
+  // without the entry its guaranteed reply would count late_responses
+  // on every disconnect with open captures (round-3 review finding).
+  void EndWsUpstream(int u, uint64_t up_stream) {
+    if (!ups_[size_t(u)].Ready()) return;
+    std::string payload(22, '\0');
+    payload[20] = 2;  // mode: any non-zero; state-free end either way
+    payload[21] = char(ipt::kWsEnd);
+    uint64_t now = NowNs();
+    uint64_t up_id = ++next_up_id_;
+    uint64_t dl = now + uint64_t(opt_.deadline_ms * 1e6);
+    pending_[up_id] = Pending{/*conn_id=*/0, /*orig_id=*/0, dl, now, u};
+    deadlines_.emplace(dl, up_id);
+    AppendUpstream(u, ipt::kWsMagic,
+                   reinterpret_cast<const uint8_t*>(payload.data()),
+                   payload.size(), up_id, &up_stream);
   }
 
   void OnChunk(DownConn* c, const uint8_t* payload, size_t len) {
@@ -602,6 +696,14 @@ class Sidecar {
         streams_.erase(it);
       }
       c->open_streams.clear();
+      // same for ws captures: tell the serve loop to free parser state
+      for (uint64_t orig_stream : c->open_ws) {
+        auto it = ws_streams_.find(StreamKey(c->id, orig_stream));
+        if (it == ws_streams_.end()) continue;
+        EndWsUpstream(it->second.up_idx, it->second.up_stream_id);
+        ws_streams_.erase(it);
+      }
+      c->open_ws.clear();
     }
   }
 
@@ -625,16 +727,21 @@ class Sidecar {
   }
 
   void AppendUpstream(int u, const char magic[4], const uint8_t* payload,
-                      size_t len, uint64_t up_id) {
+                      size_t len, uint64_t up_id,
+                      const uint64_t* ws_stream = nullptr,
+                      bool count_inflight = true) {
     Upstream& up = ups_[size_t(u)];
     up.outbuf.append(magic, 4);
     ipt::detail::put<uint32_t>(&up.outbuf, uint32_t(len));
     size_t at = up.outbuf.size();
     up.outbuf.append(reinterpret_cast<const char*>(payload), len);
     std::memcpy(&up.outbuf[at], &up_id, 8);  // re-id for global uniqueness
-    if (std::memcmp(magic, ipt::kChunkMagic, 4) != 0) {
-      // requests AND response-scans count toward balancing state;
-      // chunks belong to an already-counted stream
+    if (ws_stream != nullptr)                // ws frames re-id the stream too
+      std::memcpy(&up.outbuf[at + 8], ws_stream, 8);
+    if (std::memcmp(magic, ipt::kChunkMagic, 4) != 0 && count_inflight) {
+      // requests, response-scans and ws frames count toward balancing
+      // state (each gets a verdict); chunks belong to an already-counted
+      // stream, and synthesized ws end frames get no tracked reply
       ++up.inflight;
       ++up.forwarded;
     }
@@ -837,6 +944,7 @@ class Sidecar {
     ups_json += "]";
     std::string body = item(
         "{\"requests_in\": %llu, \"chunks_in\": %llu, "
+        "\"ws_frames_in\": %llu, "
         "\"forwarded\": %llu, \"responses\": %llu, "
         "\"fail_open_deadline\": %llu, \"fail_open_upstream\": %llu, "
         "\"fail_open_overload\": %llu, \"late_responses\": %llu, "
@@ -845,6 +953,7 @@ class Sidecar {
         "\"upstream_connected\": %s, \"pending\": %zu, ",
         (unsigned long long)counters_.requests_in,
         (unsigned long long)counters_.chunks_in,
+        (unsigned long long)counters_.ws_frames_in,
         (unsigned long long)counters_.forwarded,
         (unsigned long long)counters_.responses,
         (unsigned long long)counters_.fail_open_deadline,
@@ -888,6 +997,12 @@ class Sidecar {
   uint64_t next_up_id_ = 0;
   std::unordered_map<uint64_t, Pending> pending_;
   std::unordered_map<uint64_t, uint64_t> streams_;  // (conn,orig) → up_id
+  // WebSocket capture bindings: (conn, orig stream) → sticky upstream +
+  // globally-unique rewritten stream id (the serve loop keys parser and
+  // sticky-verdict state by it, so every frame of one upgraded
+  // connection MUST reach the same upstream under the same id)
+  struct WsBinding { int up_idx; uint64_t up_stream_id; };
+  std::unordered_map<uint64_t, WsBinding> ws_streams_;
   // min-heap of (deadline, up_id); stale entries dropped lazily
   using DlEntry = std::pair<uint64_t, uint64_t>;
   std::priority_queue<DlEntry, std::vector<DlEntry>, std::greater<DlEntry>>

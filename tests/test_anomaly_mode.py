@@ -96,6 +96,26 @@ def test_two_warnings_accumulate_past_threshold():
     assert v.attack and v.score == 6
 
 
+def test_outbound_threshold_does_not_override_inbound():
+    """Real CRS has BOTH 949110 (TX:ANOMALY_SCORE @ge inbound=5) and a
+    959-style outbound rule (TX:OUTBOUND_ANOMALY_SCORE @ge outbound=4)
+    sorting after it, plus per-PL sub-score rules.  Only the inbound
+    selector may set the request-blocking threshold — last-wins over
+    every *ANOMALY_SCORE* target would silently lower the blocking bar
+    to 4 (round-3 review finding)."""
+    outbound = """
+SecRule TX:OUTBOUND_ANOMALY_SCORE "@ge %{tx.outbound_anomaly_score_threshold}" \\
+    "id:959100,phase:4,block,severity:'CRITICAL',tag:'attack-generic'"
+SecRule TX:ANOMALY_SCORE_PL1 "@ge 1" \\
+    "id:980130,phase:5,pass,tag:'reporting'"
+"""
+    cr = compile_ruleset(parse_seclang(CRS_SETUP + RULES + outbound))
+    assert cr.anomaly_threshold == 5
+    p = DetectionPipeline(cr, mode="block")
+    # one ERROR-severity hit (4) must NOT block under inbound=5
+    assert p.anomaly_threshold == 5
+
+
 def test_custom_threshold_honored():
     setup = CRS_SETUP.replace(
         "tx.inbound_anomaly_score_threshold=5",

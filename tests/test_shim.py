@@ -88,6 +88,11 @@ def test_shim_client_through_full_stack(stack):
     assert not cases["benign"]["attack"] and not cases["benign"]["blocked"]
     # streamed body: attack split across chunks, caught by carried state
     assert cases["stream"]["attack"] and cases["stream"]["blocked"]
+    # websocket capture: masked fragmented attack message caught at the
+    # completing frame; later frames report the sticky stream verdict
+    assert cases["ws_attack"]["attack"] and cases["ws_attack"]["blocked"]
+    assert not cases["ws_attack"]["fail_open"]
+    assert cases["ws_sticky"]["attack"]
     # dead socket: pass + fail-open, never an error or a hang
     assert cases["dead_socket"]["fail_open"]
     assert not cases["dead_socket"]["blocked"]

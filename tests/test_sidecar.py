@@ -216,11 +216,59 @@ def test_streaming_body_through_sidecar(sidecar):
     c.close()
 
 
+def test_websocket_through_sidecar(sidecar):
+    """WTPI frames route through the real sidecar binary: sticky to one
+    upstream per upgraded connection, stream id rewritten, one verdict
+    per frame, sticky attack state across frames."""
+    from ingress_plus_tpu.serve.protocol import encode_ws
+    from tests.test_websocket import ws_frame
+
+    c = Client(sidecar)
+    # fragmented masked attack across two capture frames
+    c.send(encode_ws(71, 9000, ws_frame(b"1 union ", fin=False,
+                                        mask=b"abcd")))
+    v = c.recv_verdict()
+    assert v["req_id"] == 71 and not v["attack"]  # mid-message
+    c.send(encode_ws(72, 9000, ws_frame(b"select 2", opcode=0,
+                                        mask=b"wxyz")))
+    v = c.recv_verdict()
+    assert v["req_id"] == 72
+    assert v["attack"] and v["blocked"] and not v["fail_open"]
+    # later frame of the same stream: sticky verdict
+    c.send(encode_ws(73, 9000, ws_frame(b"innocent chatter")))
+    v = c.recv_verdict()
+    assert v["req_id"] == 73 and v["attack"]
+    # end frame frees state on both sides
+    c.send(encode_ws(74, 9000, b"", end=True))
+    assert c.recv_verdict()["req_id"] == 74
+    c.close()
+
+
+def test_websocket_streams_isolated_across_conns(sidecar):
+    """Two downstream conns using the SAME stream id must not share
+    serve-side state (the sidecar rewrites stream ids globally unique)."""
+    from ingress_plus_tpu.serve.protocol import encode_ws
+    from tests.test_websocket import ws_frame
+
+    a, b = Client(sidecar), Client(sidecar)
+    a.send(encode_ws(81, 7700, ws_frame(b"1 union select 2",
+                                        mask=b"mmmm")))
+    v = a.recv_verdict()
+    assert v["req_id"] == 81 and v["attack"]
+    # same stream id on another conn: no sticky contamination
+    b.send(encode_ws(82, 7700, ws_frame(b"hello there")))
+    v = b.recv_verdict()
+    assert v["req_id"] == 82 and not v["attack"]
+    a.close()
+    b.close()
+
+
 def test_status_counters(sidecar):
     st = _status()
     assert st["upstream_connected"] is True
     assert st["requests_in"] >= 1
     assert st["responses"] >= 1
+    assert st["ws_frames_in"] >= 1
     assert st["bad_frames"] == 0
 
 

@@ -1,0 +1,352 @@
+"""WebSocket analysis path (wallarm_parse_websocket analog).
+
+Three tiers, mirroring SURVEY.md §4: pure-unit RFC 6455 parser tests,
+in-process WSStream ⇄ Batcher scanning tests, and a subprocess serve-loop
+e2e driving WTPI frames over a real UDS.
+"""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.batcher import Batcher
+from ingress_plus_tpu.serve.websocket import (
+    DIR_C2S,
+    DIR_S2C,
+    OP_BINARY,
+    OP_CLOSE,
+    OP_CONT,
+    OP_PING,
+    OP_TEXT,
+    WSError,
+    WSFrameParser,
+    WSStream,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+RULES = """
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)union\\s+select" \
+    "id:942100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-sqli'"
+SecRule REQUEST_BODY "@rx (?i)<script" \
+    "id:941100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-xss'"
+SecRule RESPONSE_BODY "@rx (?i)you have an error in your sql syntax" \
+    "id:951100,phase:4,block,t:lowercase,severity:CRITICAL,tag:'attack-leak'"
+"""
+
+
+def ws_frame(payload: bytes, opcode: int = OP_TEXT, fin: bool = True,
+             mask: bytes = b"", rsv: int = 0) -> bytes:
+    """Build one RFC 6455 wire frame (test-side encoder — the framework
+    deliberately only ships a parser; production frames come from real
+    ws peers through the capture point)."""
+    b0 = (0x80 if fin else 0) | (rsv << 4) | opcode
+    n = len(payload)
+    head = bytearray([b0])
+    m = 0x80 if mask else 0
+    if n < 126:
+        head.append(m | n)
+    elif n < 1 << 16:
+        head.append(m | 126)
+        head += n.to_bytes(2, "big")
+    else:
+        head.append(m | 127)
+        head += n.to_bytes(8, "big")
+    if mask:
+        assert len(mask) == 4
+        head += mask
+        payload = bytes(c ^ mask[i & 3] for i, c in enumerate(payload))
+    return bytes(head) + payload
+
+
+# ---------------------------------------------------------- parser unit
+
+def test_parser_masked_roundtrip():
+    p = WSFrameParser()
+    out = p.feed(ws_frame(b"hello world", mask=b"\x01\x02\x03\x04"))
+    assert out == [(True, OP_TEXT, b"hello world")]
+
+
+def test_parser_unmasked_and_binary():
+    p = WSFrameParser()
+    out = p.feed(ws_frame(b"\x00\xffdata", opcode=OP_BINARY))
+    assert out == [(True, OP_BINARY, b"\x00\xffdata")]
+
+
+def test_parser_byte_at_a_time():
+    wire = ws_frame(b"fragmented feed", mask=b"abcd")
+    p = WSFrameParser()
+    got = []
+    for i in range(len(wire)):
+        got += p.feed(wire[i:i + 1])
+    assert got == [(True, OP_TEXT, b"fragmented feed")]
+
+
+@pytest.mark.parametrize("n", [125, 126, 300, 65535, 65536, 70000])
+def test_parser_length_encodings(n):
+    payload = bytes(i & 0xFF for i in range(n))
+    p = WSFrameParser()
+    out = p.feed(ws_frame(payload, mask=b"\x10\x20\x30\x40"))
+    assert out == [(True, OP_TEXT, payload)]
+
+
+def test_parser_multiple_frames_one_feed():
+    wire = (ws_frame(b"one", fin=False)
+            + ws_frame(b"two", opcode=OP_CONT)
+            + ws_frame(b"", opcode=OP_PING))
+    assert WSFrameParser().feed(wire) == [
+        (False, OP_TEXT, b"one"), (True, OP_CONT, b"two"),
+        (True, OP_PING, b"")]
+
+
+@pytest.mark.parametrize("bad", [
+    ws_frame(b"x", rsv=4),                         # RSV1 (permessage-deflate)
+    ws_frame(b"x", opcode=OP_CLOSE, fin=False),    # fragmented control
+    bytes([0x81, 126, 0, 100]) + b"a" * 100,       # non-minimal 16-bit len
+    bytes([0x81, 127]) + (100).to_bytes(8, "big") + b"a" * 100,  # 64-bit
+    ws_frame(b"x", opcode=0x3),                    # reserved opcode
+])
+def test_parser_protocol_errors(bad):
+    with pytest.raises(WSError):
+        WSFrameParser().feed(bad)
+
+
+def test_parser_frame_size_bound():
+    head = bytes([0x81, 127]) + (1 << 30).to_bytes(8, "big")
+    with pytest.raises(WSError):
+        WSFrameParser(max_frame=1 << 20).feed(head)
+
+
+# ------------------------------------------------------ WSStream + scan
+
+@pytest.fixture(scope="module")
+def batcher():
+    pipeline = DetectionPipeline(compile_ruleset(parse_seclang(RULES)),
+                                 mode="block")
+    b = Batcher(pipeline, max_batch=32, max_delay_s=0.001)
+    yield b
+    b.close()
+
+
+def _verdicts(pairs, timeout=30):
+    return [fut.result(timeout=timeout) for _, fut in pairs]
+
+
+def test_ws_attack_message_fragmented(batcher):
+    """A masked sqli payload split across fragments AND feeds — carried
+    NFA state must still match the pattern spanning the split."""
+    ws = WSStream(batcher, tenant=0, mode=2, stream_id=1)
+    part1 = ws_frame(b'{"q": "1 union ', fin=False, mask=b"abcd")
+    part2 = ws_frame(b'select password"}', opcode=OP_CONT, mask=b"wxyz")
+    assert ws.feed(DIR_C2S, part1) == []
+    pairs = ws.feed(DIR_C2S, part2)
+    assert len(pairs) == 1
+    v = _verdicts(pairs)[0]
+    assert v.attack and v.blocked
+    assert "sqli" in v.classes
+    ws.merge(v)
+    assert ws.verdict(99).attack  # sticky on later frames
+
+
+def test_ws_benign_and_ping(batcher):
+    ws = WSStream(batcher, tenant=0, mode=2, stream_id=2)
+    wire = (ws_frame(b"", opcode=OP_PING)
+            + ws_frame(b"hello, perfectly normal chat message")
+            + ws_frame(b"", opcode=OP_CLOSE))
+    pairs = ws.feed(DIR_C2S, wire)
+    assert len(pairs) == 1
+    v = _verdicts(pairs)[0]
+    assert not v.attack and not v.fail_open
+    assert ws.dirs[DIR_C2S].closed
+
+
+def test_ws_server_to_client_leak(batcher):
+    """Response-direction messages scan the resp_body stream → 95x leak
+    families fire; request families must NOT (stream separation)."""
+    ws = WSStream(batcher, tenant=0, mode=2, stream_id=3)
+    pairs = ws.feed(DIR_S2C, ws_frame(
+        b"You have an error in your SQL syntax near 'x'"))
+    v = _verdicts(pairs)[0]
+    assert v.attack and "leak" in v.classes
+    # the same text client->server carries no leak rule target
+    ws2 = WSStream(batcher, tenant=0, mode=2, stream_id=4)
+    pairs2 = ws2.feed(DIR_C2S, ws_frame(
+        b"You have an error in your SQL syntax near 'x'"))
+    assert not _verdicts(pairs2)[0].attack
+
+
+def test_ws_monitoring_mode(batcher):
+    ws = WSStream(batcher, tenant=0, mode=1, stream_id=5)
+    pairs = ws.feed(DIR_C2S, ws_frame(b"1 union select 2", mask=b"mmmm"))
+    v = _verdicts(pairs)[0]
+    assert v.attack and not v.blocked
+
+
+def test_ws_poison_fails_open(batcher):
+    """Protocol violation → no more scanning, verdicts carry fail_open
+    (the tri-layer fail-open contract: never block on parser trouble)."""
+    ws = WSStream(batcher, tenant=0, mode=2, stream_id=6)
+    ws.feed(DIR_C2S, ws_frame(b"x", rsv=4))
+    assert ws.poisoned
+    v = ws.verdict(1)
+    assert v.fail_open and not v.blocked
+    assert ws.feed(DIR_C2S, ws_frame(b"1 union select 2")) == []
+
+
+def test_ws_interleaved_data_frame_poisons(batcher):
+    ws = WSStream(batcher, tenant=0, mode=2, stream_id=7)
+    ws.feed(DIR_C2S, ws_frame(b"start", fin=False))
+    ws.feed(DIR_C2S, ws_frame(b"new message mid-fragment"))  # RFC §5.4
+    assert ws.poisoned
+
+
+def test_ws_close_finalizes_open_message(batcher):
+    """An attacker must not escape scanning by withholding FIN."""
+    ws = WSStream(batcher, tenant=0, mode=2, stream_id=8)
+    ws.feed(DIR_C2S, ws_frame(b"1 union select 2", fin=False, mask=b"aaaa"))
+    pairs = ws.close()
+    assert len(pairs) == 1
+    v = _verdicts(pairs)[0]
+    assert v.attack and "sqli" in v.classes
+
+
+def test_ws_msg_cap_truncation_flags(batcher):
+    """Bytes beyond msg_cap pass unscanned but the verdict surfaces it
+    (pass-and-flag, never a silent miss)."""
+    ws = WSStream(batcher, tenant=0, mode=2, stream_id=9, msg_cap=64)
+    payload = b"A" * 80 + b"1 union select 2"
+    pairs = ws.feed(DIR_C2S, ws_frame(payload))
+    v = _verdicts(pairs)[0]
+    assert not v.attack      # pattern fell beyond the cap
+    assert v.fail_open       # truncation surfaced
+
+
+def test_ws_gzip_binary_message_unpacked(batcher):
+    """A gzip-wrapped attack in a binary message is inflated by the
+    stream engine's magic-byte sniff (unpack parity with HTTP bodies)."""
+    import gzip
+
+    ws = WSStream(batcher, tenant=0, mode=2, stream_id=10)
+    blob = gzip.compress(b'{"q": "1 union select password"}')
+    pairs = ws.feed(DIR_C2S, ws_frame(blob, opcode=OP_BINARY))
+    v = _verdicts(pairs)[0]
+    assert v.attack and "sqli" in v.classes
+
+
+# ------------------------------------------------------------- UDS e2e
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ws_serve")
+    rules_dir = tmp / "rules"
+    rules_dir.mkdir()
+    (rules_dir / "tiny.conf").write_text(RULES)
+    sock = str(tmp / "ipt.sock")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ingress_plus_tpu.serve",
+         "--socket", sock, "--rules-dir", str(rules_dir),
+         "--platform", "cpu", "--max-delay-us", "1000", "--no-warmup"],
+        cwd=str(REPO), env=env, stderr=subprocess.PIPE, text=True)
+    for _ in range(600):
+        if Path(sock).exists():
+            try:
+                s = socket.socket(socket.AF_UNIX)
+                s.connect(sock)
+                s.close()
+                break
+            except OSError:
+                pass
+        if proc.poll() is not None:
+            raise RuntimeError("server died: %s" % proc.stderr.read())
+        time.sleep(0.1)
+    else:
+        proc.kill()
+        raise RuntimeError("server socket never appeared")
+    yield sock
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def _drive(sock_path, frames, want_ids, timeout=30):
+    from ingress_plus_tpu.serve.protocol import (
+        RESP_MAGIC, FrameReader, decode_response)
+
+    s = socket.socket(socket.AF_UNIX)
+    s.settimeout(timeout)
+    s.connect(sock_path)
+    for f in frames:
+        s.sendall(f)
+    reader = FrameReader(RESP_MAGIC)
+    got = {}
+    while set(got) != set(want_ids):
+        data = s.recv(1 << 16)
+        assert data, "server closed early; got %s" % sorted(got)
+        for payload in reader.feed(data):
+            r = decode_response(payload)
+            got[r["req_id"]] = r
+    s.close()
+    return got
+
+
+def test_e2e_ws_attack_and_sticky(server):
+    """Full wire path: fragmented masked attack message; the completing
+    frame's verdict is the attack, and a later frame of the same stream
+    reports it again (sticky)."""
+    from ingress_plus_tpu.serve.protocol import encode_ws
+
+    frames = [
+        encode_ws(1, 500, ws_frame(b"1 union ", fin=False, mask=b"abcd")),
+        encode_ws(2, 500, ws_frame(b"select 2", opcode=OP_CONT,
+                                   mask=b"wxyz")),
+        encode_ws(3, 500, ws_frame(b"later benign message")),
+    ]
+    got = _drive(server, frames, [1, 2, 3])
+    assert not got[1]["attack"]          # mid-message: nothing completed
+    assert got[2]["attack"] and got[2]["blocked"]
+    assert "sqli" in got[2]["classes"]
+    assert got[3]["attack"]              # sticky stream verdict
+
+
+def test_e2e_ws_s2c_leak_and_end(server):
+    from ingress_plus_tpu.serve.protocol import WS_DIR_S2C, WS_END, encode_ws
+
+    frames = [
+        encode_ws(10, 600, ws_frame(
+            b"You have an error in your SQL syntax"), s2c=True),
+        encode_ws(11, 600, b"", end=True),
+    ]
+    got = _drive(server, frames, [10, 11])
+    assert got[10]["attack"] and "leak" in got[10]["classes"]
+    assert got[11]["attack"]             # end frame reports sticky state
+
+
+def test_e2e_ws_mode_off(server):
+    from ingress_plus_tpu.serve.protocol import encode_ws
+
+    frames = [encode_ws(20, 700, ws_frame(b"1 union select 2"), mode=0)]
+    got = _drive(server, frames, [20])
+    assert not got[20]["attack"] and not got[20]["fail_open"]
+
+
+def test_e2e_ws_poison_fail_open(server):
+    from ingress_plus_tpu.serve.protocol import encode_ws
+
+    frames = [
+        encode_ws(30, 800, ws_frame(b"x", rsv=4)),
+        encode_ws(31, 800, ws_frame(b"1 union select 2")),
+    ]
+    got = _drive(server, frames, [30, 31])
+    assert got[30]["fail_open"] or got[31]["fail_open"]
+    assert not got[31]["attack"]         # poisoned: scanning stopped
