@@ -41,7 +41,7 @@ from __future__ import annotations
 import hashlib
 import json
 import re
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -57,6 +57,7 @@ from ingress_plus_tpu.compiler.seclang import (
     Rule,
     STREAMS,
     STREAM_INDEX,
+    _id_matcher,
 )
 
 #: scan-row normalization variants (serve/normalize.py variant_chain).
@@ -308,6 +309,14 @@ class CompiledRuleset:
     #: anomaly mode; the pipeline then keeps its default threshold)
     anomaly_threshold: Optional[int] = None
     paranoia_hint: Optional[int] = None
+    #: runtime ctl exclusions (the CRS exclusion-package shape), resolved
+    #: to concrete rule ids at compile time: carrying rule INDEX →
+    #: {"remove_ids": [id, ...],              # ctl:ruleRemoveById/ByTag
+    #:  "target_excl": {str(id): [tok, ...]}, # ctl:ruleRemoveTargetById
+    #:  "engine_off": bool}                   # ctl:ruleEngine=Off
+    #: Applied per request by the confirm stage when the carrying rule
+    #: matches (models/pipeline.py finalize).
+    ctl_specs: Dict[int, Dict] = field(default_factory=dict)
 
     @property
     def n_rules(self) -> int:
@@ -353,6 +362,7 @@ class CompiledRuleset:
             "tags": [list(m.rule.tags) for m in self.rules],
             "anomaly_threshold": self.anomaly_threshold,
             "paranoia_hint": self.paranoia_hint,
+            "ctl_specs": {str(k): v for k, v in self.ctl_specs.items()},
         }
         path.with_suffix(".json").write_text(json.dumps(meta))
 
@@ -394,6 +404,8 @@ class CompiledRuleset:
             rule_ids=z["rule_ids"], version=meta["version"],
             anomaly_threshold=meta.get("anomaly_threshold"),
             paranoia_hint=meta.get("paranoia_hint"),
+            ctl_specs={int(k): v
+                       for k, v in meta.get("ctl_specs", {}).items()},
         )
 
 
@@ -596,12 +608,100 @@ def compile_ruleset(
         rule_ids[i] = rule.rule_id
 
     tables = pack_factors(groups, n_rules=len(scannable))
+    ctl_specs = _resolve_ctls(scannable, rule_ids)
     cr = CompiledRuleset(
         tables=tables, rules=metas, rule_sv_mask=sv_mask,
         rule_class=rule_class, rule_score=rule_score,
         rule_action=rule_action, rule_paranoia=rule_paranoia,
         rule_ids=rule_ids, anomaly_threshold=anomaly_threshold,
-        paranoia_hint=paranoia_hint,
+        paranoia_hint=paranoia_hint, ctl_specs=ctl_specs,
     )
     cr.version = cr.fingerprint()
     return cr
+
+
+def _resolve_ctls(scannable: List[Rule],
+                  rule_ids: np.ndarray) -> Dict[int, Dict]:
+    """Resolve each rule's ctl actions against the finished pack.
+
+    Id specs (single ids, "lo-hi" ranges) become the concrete rule ids
+    present in THIS pack, so the runtime applies plain masks with zero
+    parsing; tag/msg-based variants resolve their regex the same way.
+    Handled: ruleRemoveById/ByTag/ByMsg, ruleRemoveTargetById/ByTag/
+    ByMsg, ruleEngine=Off|DetectionOnly.  Other ctl keys (auditEngine,
+    requestBodyProcessor, ...) control ModSecurity plumbing we don't
+    model and are ignored — but EVERY ctl-carrying rule still gets a
+    spec entry (possibly empty), so the pipeline always knows it is
+    config machinery and never reports it as a detection hit."""
+    specs: Dict[int, Dict] = {}
+    all_ids = [int(r) for r in rule_ids]
+
+    def _ids_for_pattern(val: str, field: str):
+        try:
+            pat = re.compile(val)
+        except re.error:
+            return []
+        out = []
+        for j, r in enumerate(scannable):
+            hay = r.tags if field == "tags" else [r.msg]
+            if any(pat.search(t) for t in hay):
+                out.append(all_ids[j])
+        return out
+
+    for i, rule in enumerate(scannable):
+        remove: set = set()
+        target_excl: Dict[str, List[str]] = {}
+        engine = None            # None | "off" | "detection_only"
+        ctls = list(rule.ctls)
+        link = rule.chain
+        while link is not None:           # ctl may sit on a chain link
+            ctls.extend(link.ctls)
+            link = link.chain
+
+        def _add_target_excl(rids, target: str) -> None:
+            for rid in rids:
+                target_excl.setdefault(str(rid), [])
+                if target not in target_excl[str(rid)]:
+                    target_excl[str(rid)].append(target)
+
+        for c in ctls:
+            key, _, val = c.partition("=")
+            key, val = key.strip(), val.strip()
+            if key == "ruleEngine":
+                if val.lower() == "off":
+                    engine = "off"
+                elif val.lower() == "detectiononly" and engine != "off":
+                    # monitoring for this request: detect + log, never
+                    # block (ModSecurity's DetectionOnly transaction
+                    # semantics — round-3 review: silently ignoring it
+                    # over-blocked where ModSecurity would pass)
+                    engine = "detection_only"
+            elif key == "ruleRemoveById":
+                match = _id_matcher([val])
+                remove.update(rid for rid in all_ids if match(rid))
+            elif key == "ruleRemoveByTag":
+                remove.update(_ids_for_pattern(val, "tags"))
+            elif key == "ruleRemoveByMsg":
+                remove.update(_ids_for_pattern(val, "msg"))
+            elif key in ("ruleRemoveTargetById", "ruleRemoveTargetByTag",
+                         "ruleRemoveTargetByMsg"):
+                spec_txt, _, target = val.partition(";")
+                target = target.strip()
+                if not target:
+                    continue
+                if key == "ruleRemoveTargetById":
+                    match = _id_matcher([spec_txt])
+                    rids = [rid for rid in all_ids if match(rid)]
+                else:
+                    rids = _ids_for_pattern(
+                        spec_txt.strip(),
+                        "tags" if key.endswith("ByTag") else "msg")
+                _add_target_excl(rids, target)
+        if ctls:
+            specs[i] = {"remove_ids": sorted(remove),
+                        "target_excl": target_excl,
+                        "engine": engine,
+                        # legacy key, kept for checkpoints written by
+                        # earlier builds that read/wrote a bool
+                        "engine_off": engine == "off"}
+    return specs

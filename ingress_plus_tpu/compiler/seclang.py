@@ -143,6 +143,14 @@ class Rule:
     #: the runtime fully batched: anomaly accumulation IS the engine's
     #: score matmul)
     setvars: List[str] = field(default_factory=list)
+    #: raw ctl action values ("ruleRemoveById=942100",
+    #: "ruleRemoveTargetById=942100;ARGS:password") — runtime rule
+    #: exclusions conditioned on THIS rule matching (the CRS exclusion-
+    #: package shape: SecRule REQUEST_URI "@beginsWith /api" "...,pass,
+    #: nolog,ctl:...").  Resolved to static masks at compile time
+    #: (compiler/ruleset.py) and applied per request in the confirm
+    #: stage (models/pipeline.py).
+    ctls: List[str] = field(default_factory=list)
 
     @property
     def attack_class(self) -> str:
@@ -261,10 +269,40 @@ def _parse_targets(text: str) -> List[str]:
     return [] if saw_any else ["args"]
 
 
+def _id_matcher(specs: Sequence[str]):
+    """SecRuleRemoveById/UpdateTargetById id expressions → predicate.
+    Accepts space-separated ids and "lo-hi" ranges (quotes already
+    stripped by the directive tokenizer)."""
+    ids: set = set()
+    ranges: List[tuple] = []
+    for spec in specs:
+        for part in spec.split():
+            part = part.strip()
+            if not part:
+                continue
+            if "-" in part[1:]:
+                lo, _, hi = part.partition("-")
+                try:
+                    ranges.append((int(lo), int(hi)))
+                except ValueError:
+                    raise SecLangError("bad rule-id range %r" % part)
+            else:
+                try:
+                    ids.add(int(part))
+                except ValueError:
+                    raise SecLangError("bad rule id %r" % part)
+
+    def match(rid: int) -> bool:
+        return rid in ids or any(lo <= rid <= hi for lo, hi in ranges)
+
+    return match
+
+
 def parse_seclang(
     text: str,
     source: str = "<string>",
     base_dir: Optional[Path] = None,
+    rules: Optional[List[Rule]] = None,
 ) -> List[Rule]:
     """Parse SecLang text → list of top-level Rules (chains attached).
 
@@ -272,8 +310,16 @@ def parse_seclang(
     the .conf file): the operator is rewritten to ``pm`` with the file's
     phrases joined by newlines.  A missing file or missing base_dir is a
     hard SecLangError — a silently-empty word list would compile to a dead
-    rule whose misses the F1 gate would blame on the kernel."""
-    rules: List[Rule] = []
+    rule whose misses the F1 gate would blame on the kernel.
+
+    ``rules`` (optional accumulator): config-time exclusion directives
+    (SecRuleRemoveById/ByTag/ByMsg, SecRuleUpdateTargetById) apply to the
+    rules loaded SO FAR, in directive order — ModSecurity semantics, and
+    the CRS convention of exclusion files sorting after rule includes.
+    load_seclang_dir passes one shared list so exclusions in a later
+    .conf reach rules from earlier files."""
+    if rules is None:
+        rules = []
     pending_chain: Optional[Rule] = None
 
     for line in _logical_lines(text):
@@ -306,6 +352,57 @@ def parse_seclang(
                          "SecRuleEngine", "SecRequestBodyAccess",
                          "SecDefaultAction", "SecCollectionTimeout"):
             continue  # engine-control directives: no scan content
+        if directive == "SecRuleRemoveById":
+            # config-time removal (the FP-tuning workhorse of every real
+            # CRS deployment): drop already-loaded rules by id/range
+            match = _id_matcher(tokens[1:])
+            rules[:] = [r for r in rules if not match(r.rule_id)]
+            continue
+        if directive in ("SecRuleRemoveByTag", "SecRuleRemoveByMsg"):
+            if len(tokens) < 2:
+                raise SecLangError("%s: %s needs a pattern"
+                                   % (source, directive))
+            try:
+                pat = re.compile(tokens[1])
+            except re.error as e:
+                raise SecLangError("%s: bad %s pattern: %s"
+                                   % (source, directive, e))
+            if directive == "SecRuleRemoveByTag":
+                rules[:] = [r for r in rules
+                            if not any(pat.search(t) for t in r.tags)]
+            else:
+                rules[:] = [r for r in rules if not pat.search(r.msg)]
+            continue
+        if directive == "SecRuleUpdateTargetById":
+            # append targets (typically "!ARGS:password" exclusions) to
+            # already-loaded rules; the per-variable confirm honors the
+            # exclusion exactly, and the scan keeps its superset streams
+            # (sound: the confirm stage is what decides).  The 4-arg
+            # REPLACED_TARGETS form is not supported — replacing targets
+            # could only narrow the scan, and silently accepting it
+            # would widen detection instead of narrowing it.
+            if len(tokens) < 3:
+                raise SecLangError(
+                    "%s: SecRuleUpdateTargetById needs id + targets"
+                    % source)
+            if len(tokens) > 3:
+                raise SecLangError(
+                    "%s: SecRuleUpdateTargetById REPLACED_TARGETS form "
+                    "is not supported" % source)
+            match = _id_matcher([tokens[1]])
+            new_toks = [t.strip() for t in tokens[2].split("|")
+                        if t.strip()]
+            positive = [t for t in new_toks if not t.startswith("!")]
+            for r in rules:
+                if not match(r.rule_id):
+                    continue
+                r.raw_targets.extend(
+                    t for t in new_toks if t not in r.raw_targets)
+                if positive:
+                    for s in _parse_targets("|".join(positive)):
+                        if s not in r.targets:
+                            r.targets.append(s)
+            continue
         if directive != "SecRule":
             continue  # unknown directives are ignored (forward compat)
         if len(tokens) < 3:
@@ -386,6 +483,7 @@ def parse_seclang(
             negate=negate,
             setvars=[v.strip("'\"") for v in actions.get("setvar", [])
                      if v],
+            ctls=[v.strip("'\"") for v in actions.get("ctl", []) if v],
         )
 
         if pending_chain is not None:
@@ -408,9 +506,12 @@ def parse_seclang(
 
 
 def load_seclang_dir(path: str | Path) -> List[Rule]:
-    """Parse every ``*.conf`` under ``path`` (sorted, CRS-style file order)."""
+    """Parse every ``*.conf`` under ``path`` (sorted, CRS-style file
+    order).  One shared rules accumulator rides through all files so
+    exclusion directives in later files (the REQUEST-900/999-style
+    before/after convention) apply to rules from earlier ones."""
     rules: List[Rule] = []
     for conf in sorted(Path(path).glob("*.conf")):
-        rules.extend(parse_seclang(conf.read_text(), source=str(conf),
-                                   base_dir=conf.parent))
+        parse_seclang(conf.read_text(), source=str(conf),
+                      base_dir=conf.parent, rules=rules)
     return rules

@@ -234,6 +234,25 @@ _COLLECTION_BASES = {
 }
 
 
+def parse_exclusion_token(tok: str):
+    """"ARGS:password" → ("args", b"password") in the internal exclusion
+    form (ctl:ruleRemoveTargetById plumbing — compiler/ruleset.py stores
+    the raw token, the pipeline resolves it here once per install).
+    Returns None for tokens that aren't collection subfields — a
+    non-collection exclusion can't narrow per-variable iteration, so the
+    confirm keeps its (sound, wider) evaluation."""
+    tok = tok.strip().lstrip("!")
+    base, sep, sel = tok.partition(":")
+    cb = _COLLECTION_BASES.get(base.strip().upper())
+    if cb and sep and sel.strip():
+        # ARGS is the GET∪POST union: excluding ARGS:x must also reach
+        # rules that iterate the GET/POST-specific collections
+        kinds = (("args", "queryargs", "bodyargs") if cb[0] == "args"
+                 else (cb[0],))
+        return kinds, sel.strip().lower().encode()
+    return None
+
+
 def _looks_like_form(body: bytes) -> bool:
     """Heuristic for ARGS_POST when no content-type is available: a
     form-urlencoded body is k=v pairs with no raw control bytes.  A
@@ -439,13 +458,18 @@ class ConfirmRule:
         return plan, excl
 
     def _iter_entry(self, entry, streams: Dict[str, bytes],
-                    cache: Optional[Dict]):
+                    cache: Optional[Dict],
+                    extra_excl: Optional[Dict] = None):
         """Yield (text, exact, is_count) for one plan entry.
 
         exact=True: the text is one variable's value, exactly as
         ModSecurity would expose it (negation/numerics may consume it).
         exact=False: the text is the whole coarse stream blob — a sound
-        superset for positive pattern operators only."""
+        superset for positive pattern operators only.
+
+        ``extra_excl`` ({collection_kind: {selector, ...}}): request-time
+        target exclusions from a matched ctl:ruleRemoveTargetById rule,
+        merged with the rule's own compiled !VAR:x exclusions."""
         count, base, sel = entry
         if base == "#BLOB":   # legacy collection: whole stream, non-exact
             blob = streams.get(sel.decode())
@@ -470,8 +494,12 @@ class ConfirmRule:
                     if blob:
                         yield blob, False, False
                 return
-            exd = self._exclusions.get(kind, ())
+            exd = self._exclusions.get(kind, set())
+            if extra_excl:
+                exd = exd | extra_excl.get(kind, set())
             if sel is not None:
+                if sel in exd:
+                    return   # the named subfield itself is excluded
                 vals = [(n if part == "names" else v)
                         for lo, n, v in coll if lo == sel]
             else:
@@ -582,7 +610,8 @@ class ConfirmRule:
 
 
     def matches_streams(self, streams: Dict[str, bytes],
-                        cache: Optional[Dict] = None) -> bool:
+                        cache: Optional[Dict] = None,
+                        extra_excl: Optional[Dict] = None) -> bool:
         """Evaluate against raw streams (applies own transforms).
 
         Negated operators ("!@op") invert per VARIABLE VALUE, mirroring
@@ -602,7 +631,7 @@ class ConfirmRule:
         tkey = tuple(self.transforms)
         for entry in self._plan:
             for text, exact, is_count in self._iter_entry(
-                    entry, streams, cache):
+                    entry, streams, cache, extra_excl):
                 if restrict and not exact:
                     continue  # abstain: blob values can't drive negation
                 if is_count:
@@ -626,5 +655,5 @@ class ConfirmRule:
         if not hit:
             return False
         # chain: every link must also match (on its own targets/transforms)
-        return all(link.matches_streams(streams, cache)
+        return all(link.matches_streams(streams, cache, extra_excl)
                    for link in self.chain)

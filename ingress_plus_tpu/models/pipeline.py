@@ -24,7 +24,7 @@ import numpy as np
 
 from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, VARIANTS
 from ingress_plus_tpu.compiler.seclang import CLASSES, STREAMS
-from ingress_plus_tpu.models.confirm import ConfirmRule
+from ingress_plus_tpu.models.confirm import ConfirmRule, parse_exclusion_token
 from ingress_plus_tpu.models.engine import DetectionEngine
 from ingress_plus_tpu.ops.scan import pad_rows
 from ingress_plus_tpu.serve.normalize import (
@@ -113,6 +113,39 @@ class DetectionPipeline:
         self.paranoia_mask = ruleset.rule_paranoia <= paranoia_level
         self.needed_sv = set(
             int(sv) for sv in np.nonzero(ruleset.rule_sv_mask.any(axis=0))[0])
+        # runtime ctl exclusions (CRS exclusion-package shape): resolve
+        # the compile-time specs to index masks once per install —
+        # finalize then applies plain boolean ops per request
+        self.ctl_rules = []
+        self._ctl_pass_idx = set()
+        for ci, spec in sorted(getattr(ruleset, "ctl_specs", {}).items()):
+            remove_mask = np.isin(
+                ruleset.rule_ids, np.asarray(spec.get("remove_ids", []),
+                                             dtype=np.int64))
+            target_excl: dict = {}
+            for rid_str, toks in spec.get("target_excl", {}).items():
+                excl_map: dict = {}
+                for tok in toks:
+                    parsed = parse_exclusion_token(tok)
+                    if parsed is None:
+                        continue
+                    kinds, sel = parsed
+                    for kind in kinds:
+                        excl_map.setdefault(kind, set()).add(sel)
+                if not excl_map:
+                    continue
+                for idx in np.nonzero(
+                        ruleset.rule_ids == int(rid_str))[0]:
+                    merged = target_excl.setdefault(int(idx), {})
+                    for kind, sels in excl_map.items():
+                        merged.setdefault(kind, set()).update(sels)
+            engine = spec.get("engine")
+            if engine is None and spec.get("engine_off"):
+                engine = "off"                 # legacy checkpoint key
+            self.ctl_rules.append(
+                (int(ci), remove_mask, target_excl, engine))
+            if ruleset.rule_action[ci] == 0:   # pass-action config rule:
+                self._ctl_pass_idx.add(int(ci))  # never a detection hit
 
     def swap_ruleset(self, ruleset: CompiledRuleset,
                      paranoia_level: Optional[int] = None) -> None:
@@ -242,9 +275,41 @@ class DetectionPipeline:
             confirmed: List[int] = []
             streams = req.confirm_streams() if len(hit_rules) else {}
             cache: Dict = {}   # per-request transform memo across rules
+            # pass 1 — runtime ctl exclusions: a matched exclusion rule
+            # (ctl:ruleRemoveById / ruleRemoveTargetById / ruleEngine=
+            # Off) removes rules or target subfields for THIS request
+            # before detection rules are confirmed (ModSecurity's
+            # request-scoped ctl semantics, resolved statically at
+            # compile time — compiler/ruleset.py _resolve_ctls)
+            excluded = None          # (R,) bool or None
+            extra_excl: Dict = {}    # rule index → {kind: {selector}}
+            detection_only = False   # ctl:ruleEngine=DetectionOnly matched
+            for ci, remove_mask, target_excl, engine in self.ctl_rules:
+                if not rule_hits[qi, ci]:
+                    continue
+                if not self.confirms[ci].matches_streams(streams, cache):
+                    continue
+                if engine == "off":
+                    excluded = np.ones(rule_hits.shape[1], dtype=bool)
+                    break
+                if engine == "detection_only":
+                    detection_only = True
+                if remove_mask.any():
+                    excluded = (remove_mask if excluded is None
+                                else excluded | remove_mask)
+                for idx, excl_map in target_excl.items():
+                    merged = extra_excl.setdefault(idx, {})
+                    for kind, sels in excl_map.items():
+                        merged.setdefault(kind, set()).update(sels)
             for r in hit_rules:
-                if self.confirms[r].matches_streams(streams, cache):
-                    confirmed.append(int(r))
+                r = int(r)
+                if r in self._ctl_pass_idx:
+                    continue   # config machinery, never a detection hit
+                if excluded is not None and excluded[r]:
+                    continue
+                if self.confirms[r].matches_streams(
+                        streams, cache, extra_excl.get(r)):
+                    confirmed.append(r)
             score = int(rs.rule_score[confirmed].sum()) if confirmed else 0
             classes = sorted(
                 {CLASSES[rs.rule_class[r]] for r in confirmed})
@@ -254,7 +319,7 @@ class DetectionPipeline:
             # in the frame) can only weaken the global mode, mirroring
             # wallarm-mode-allow-override's default policy
             eff_block = self.mode == "block" and getattr(req, "mode", 2) >= 2
-            blocked = eff_block and (attack or deny)
+            blocked = eff_block and (attack or deny) and not detection_only
             verdicts.append(Verdict(
                 request_id=req.request_id,
                 blocked=blocked,

@@ -158,29 +158,29 @@ class ServeLoop:
                         except ProtocolError:
                             continue
                         ws = ws_streams.get(stream_id)
-                        if ws is None and stream_id not in ws_streams:
+                        if ws is None:
                             eff_mode = mode & 0x03
                             if eff_mode == 0:
-                                ws_streams[stream_id] = None
-                            elif (sum(1 for w in ws_streams.values()
-                                      if isinstance(w, WSStream))
-                                  >= MAX_WS_PER_CONN):
-                                ws_streams[stream_id] = _OVERFLOW
+                                # mode off: answered per frame, NO dict
+                                # entry — sentinel entries only freed on
+                                # WS_END accumulated unboundedly on the
+                                # long-lived mux conn (round-3 review)
+                                send_pass(req_id)
+                                continue
+                            if len(ws_streams) >= MAX_WS_PER_CONN:
+                                # over cap: per-frame fail-open, also
+                                # state-free.  If capacity frees later
+                                # the mid-stream bytes poison the fresh
+                                # parser → still fail-open, deterministic
                                 self.batcher.pipeline.stats.fail_open += 1
-                            else:
-                                off = frozenset(
-                                    n for n, bit in PARSER_OFF_BITS.items()
-                                    if mode & bit)
-                                ws_streams[stream_id] = WSStream(
-                                    self.batcher, tenant, eff_mode,
-                                    stream_id, parsers_off=off)
-                            ws = ws_streams[stream_id]
-                        if not isinstance(ws, WSStream):
-                            # mode off or overflow — state-free
-                            if wflags & WS_END:
-                                ws_streams.pop(stream_id, None)
-                            send_pass(req_id, fail_open=ws is _OVERFLOW)
-                            continue
+                                send_pass(req_id, fail_open=True)
+                                continue
+                            off = frozenset(
+                                n for n, bit in PARSER_OFF_BITS.items()
+                                if mode & bit)
+                            ws = WSStream(self.batcher, tenant, eff_mode,
+                                          stream_id, parsers_off=off)
+                            ws_streams[stream_id] = ws
                         direction = (DIR_S2C if wflags & WS_DIR_S2C
                                      else DIR_C2S)
                         pairs = ws.feed(direction, wdata)

@@ -728,8 +728,7 @@ class Sidecar {
 
   void AppendUpstream(int u, const char magic[4], const uint8_t* payload,
                       size_t len, uint64_t up_id,
-                      const uint64_t* ws_stream = nullptr,
-                      bool count_inflight = true) {
+                      const uint64_t* ws_stream = nullptr) {
     Upstream& up = ups_[size_t(u)];
     up.outbuf.append(magic, 4);
     ipt::detail::put<uint32_t>(&up.outbuf, uint32_t(len));
@@ -738,10 +737,11 @@ class Sidecar {
     std::memcpy(&up.outbuf[at], &up_id, 8);  // re-id for global uniqueness
     if (ws_stream != nullptr)                // ws frames re-id the stream too
       std::memcpy(&up.outbuf[at + 8], ws_stream, 8);
-    if (std::memcmp(magic, ipt::kChunkMagic, 4) != 0 && count_inflight) {
-      // requests, response-scans and ws frames count toward balancing
-      // state (each gets a verdict); chunks belong to an already-counted
-      // stream, and synthesized ws end frames get no tracked reply
+    if (std::memcmp(magic, ipt::kChunkMagic, 4) != 0) {
+      // requests, response-scans and ws frames (including the
+      // synthesized ws end frame — it has a pending entry consumed by
+      // OnVerdict/ExpireDeadlines like any other) count toward
+      // balancing state; chunks belong to an already-counted stream
       ++up.inflight;
       ++up.forwarded;
     }

@@ -1,0 +1,211 @@
+"""Rule exclusions — the FP-tuning surface of every real CRS deployment
+(SURVEY.md §2.2 libmodsecurity row).
+
+Config-time: SecRuleRemoveById/ByTag/ByMsg drop loaded rules;
+SecRuleUpdateTargetById appends target exclusions the per-variable
+confirm honors.  Runtime: ctl:ruleRemoveById / ctl:ruleRemoveTargetById /
+ctl:ruleEngine=Off on a matched (usually pass,nolog) exclusion rule apply
+per request — resolved to static masks at compile time, plain boolean
+ops in finalize.
+"""
+
+import numpy as np
+import pytest
+
+from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, compile_ruleset
+from ingress_plus_tpu.compiler.seclang import load_seclang_dir, parse_seclang
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.normalize import Request
+
+RULES = """
+SecRule ARGS "@rx (?i)union\\s+select" \\
+    "id:942100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-sqli'"
+SecRule ARGS "@rx (?i)<script" \\
+    "id:941100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-xss'"
+SecRule ARGS|REQUEST_URI "@rx /etc/passwd" \\
+    "id:930120,phase:2,block,severity:CRITICAL,tag:'attack-lfi'"
+"""
+
+SQLI = "/q?id=1 union select password"
+XSS = "/q?x=<script>alert(1)</script>"
+
+
+def _pipeline(text, **kw):
+    return DetectionPipeline(compile_ruleset(parse_seclang(text)),
+                             mode="block", **kw)
+
+
+# ---------------------------------------------------------- config-time
+
+def test_remove_by_id_single_and_range():
+    p = _pipeline(RULES + 'SecRuleRemoveById 942100 "930000-930999"\n')
+    assert 942100 not in p.ruleset.rule_ids
+    assert 930120 not in p.ruleset.rule_ids
+    assert 941100 in p.ruleset.rule_ids
+    assert not p.detect([Request(uri=SQLI)])[0].attack
+    assert p.detect([Request(uri=XSS)])[0].attack
+
+
+def test_remove_by_id_only_affects_prior_rules():
+    """ModSecurity order semantics: a removal sees only already-loaded
+    rules — one defined after the directive survives."""
+    text = ("SecRuleRemoveById 942100\n" + RULES)
+    p = _pipeline(text)
+    assert 942100 in p.ruleset.rule_ids
+    assert p.detect([Request(uri=SQLI)])[0].attack
+
+
+def test_remove_by_tag():
+    p = _pipeline(RULES + "SecRuleRemoveByTag attack-sqli\n")
+    assert 942100 not in p.ruleset.rule_ids
+    assert 941100 in p.ruleset.rule_ids
+
+
+def test_update_target_by_id_excludes_subfield():
+    text = RULES + 'SecRuleUpdateTargetById 942100 "!ARGS:trusted"\n'
+    p = _pipeline(text)
+    # the excluded parameter no longer fires the rule...
+    v = p.detect([Request(uri="/q?trusted=1 union select x")])[0]
+    assert not v.attack
+    # ...other parameters still do, and other rules are untouched
+    assert p.detect([Request(uri="/q?id=1 union select x")])[0].attack
+    assert p.detect([Request(uri=XSS)])[0].attack
+
+
+def test_cross_file_exclusion_order(tmp_path):
+    """load_seclang_dir shares one accumulator: an exclusion file sorting
+    after the rule files (the CRS 999 convention) reaches their rules."""
+    (tmp_path / "100-rules.conf").write_text(RULES)
+    (tmp_path / "999-exclusions.conf").write_text(
+        "SecRuleRemoveById 941100\n")
+    rules = load_seclang_dir(tmp_path)
+    assert 941100 not in [r.rule_id for r in rules]
+    assert 942100 in [r.rule_id for r in rules]
+
+
+# ------------------------------------------------------------- runtime
+
+CTL_REMOVE = RULES + """
+SecRule REQUEST_URI "@beginsWith /internal/" \\
+    "id:10001,phase:1,pass,nolog,ctl:ruleRemoveById=942100"
+"""
+
+
+def test_ctl_remove_by_id_is_request_scoped():
+    p = _pipeline(CTL_REMOVE)
+    # the exclusion path: sqli in ARGS under /internal/ passes
+    v = p.detect([Request(uri="/internal/q?id=1 union select x")])[0]
+    assert not v.attack
+    # the same payload anywhere else still blocks — request-scoped
+    v = p.detect([Request(uri=SQLI)])[0]
+    assert v.attack and v.blocked
+    # other rules still apply under the excluded prefix
+    v = p.detect([Request(uri="/internal/q?x=<script>x")])[0]
+    assert v.attack
+
+
+def test_ctl_rule_itself_never_scores():
+    """The pass-action carrier rule is config machinery: it must not
+    contribute score/classes even though it 'matches' every /internal/
+    request."""
+    p = _pipeline(CTL_REMOVE)
+    v = p.detect([Request(uri="/internal/healthz")])[0]
+    assert not v.attack and v.score == 0 and v.classes == []
+    assert 10001 not in v.rule_ids
+
+
+def test_ctl_remove_target_by_id():
+    text = RULES + """
+SecRule REQUEST_URI "@beginsWith /profile" \\
+    "id:10002,phase:1,pass,nolog,ctl:ruleRemoveTargetById=942100;ARGS:bio"
+"""
+    p = _pipeline(text)
+    # excluded subfield under the matching condition: passes
+    v = p.detect([Request(uri="/profile?bio=1 union select x")])[0]
+    assert not v.attack
+    # same subfield elsewhere: blocks (condition not met)
+    v = p.detect([Request(uri="/other?bio=1 union select x")])[0]
+    assert v.attack
+    # other subfields under the condition: block
+    v = p.detect([Request(uri="/profile?id=1 union select x")])[0]
+    assert v.attack
+
+
+def test_ctl_engine_off():
+    text = RULES + """
+SecRule REQUEST_URI "@streq /healthz" \\
+    "id:10003,phase:1,pass,nolog,ctl:ruleEngine=Off"
+"""
+    p = _pipeline(text)
+    v = p.detect([Request(uri="/healthz")])[0]
+    assert not v.attack and v.rule_ids == []
+    assert p.detect([Request(uri=SQLI)])[0].attack
+
+
+def test_ctl_specs_survive_checkpoint(tmp_path):
+    cr = compile_ruleset(parse_seclang(CTL_REMOVE))
+    assert cr.ctl_specs
+    cr.save(tmp_path / "ck")
+    cr2 = CompiledRuleset.load(tmp_path / "ck")
+    assert cr2.ctl_specs == {
+        int(k): v for k, v in cr.ctl_specs.items()}
+    p = DetectionPipeline(cr2, mode="block")
+    assert not p.detect(
+        [Request(uri="/internal/q?id=1 union select x")])[0].attack
+    assert p.detect([Request(uri=SQLI)])[0].attack
+
+
+def test_ctl_detection_only():
+    """ctl:ruleEngine=DetectionOnly → monitoring for that transaction:
+    the attack is detected and reported but never blocked (ignoring it
+    would over-block where ModSecurity log-onlys — review finding)."""
+    text = RULES + """
+SecRule REQUEST_URI "@beginsWith /staging/" \\
+    "id:10005,phase:1,pass,nolog,ctl:ruleEngine=DetectionOnly"
+"""
+    p = _pipeline(text)
+    v = p.detect([Request(uri="/staging/q?id=1 union select x")])[0]
+    assert v.attack and not v.blocked and 942100 in v.rule_ids
+    v = p.detect([Request(uri=SQLI)])[0]
+    assert v.attack and v.blocked
+
+
+def test_unresolved_ctl_carrier_still_inert():
+    """A pass carrier whose ctl resolves to nothing (id not in the pack)
+    must still never surface as a detection hit (review finding)."""
+    text = RULES + """
+SecRule REQUEST_URI "@beginsWith /api/" \\
+    "id:10006,phase:1,pass,nolog,ctl:ruleRemoveById=999999"
+"""
+    p = _pipeline(text)
+    v = p.detect([Request(uri="/api/ok")])[0]
+    assert not v.attack and v.rule_ids == [] and v.score == 0
+
+
+def test_ctl_remove_target_by_tag_and_remove_by_msg():
+    text = RULES + """
+SecRuleRemoveByMsg .*nothing-matches-this.*
+SecRule REQUEST_URI "@beginsWith /forms/" \\
+    "id:10007,phase:1,pass,nolog,ctl:ruleRemoveTargetByTag=attack-xss;ARGS:html"
+"""
+    p = _pipeline(text)
+    assert len(p.ruleset.rule_ids) == 4      # ByMsg removed nothing
+    assert not p.detect(
+        [Request(uri="/forms/x?html=<script>y")])[0].attack
+    assert p.detect(
+        [Request(uri="/forms/x?other=<script>y")])[0].attack
+
+
+def test_ctl_remove_by_tag_runtime():
+    text = RULES + """
+SecRule REQUEST_URI "@beginsWith /static/" \\
+    "id:10004,phase:1,pass,nolog,ctl:ruleRemoveByTag=attack-(sqli|xss)"
+"""
+    p = _pipeline(text)
+    assert not p.detect(
+        [Request(uri="/static/a?id=1 union select x")])[0].attack
+    assert not p.detect(
+        [Request(uri="/static/a?x=<script>y")])[0].attack
+    # lfi keeps its different tag → still fires under the prefix
+    assert p.detect(
+        [Request(uri="/static/a?f=/etc/passwd")])[0].attack
