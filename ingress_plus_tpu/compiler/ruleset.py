@@ -331,6 +331,16 @@ class CompiledRuleset:
         for a in (self.tables.byte_table, self.tables.init_mask,
                   self.tables.final_mask, self.rule_sv_mask):
             h.update(np.ascontiguousarray(a).tobytes())
+        # confirm descriptors and ctl exclusions change detection
+        # behavior WITHOUT touching any scan table (SecRuleUpdateTargetById
+        # edits, ctl:ruleRemoveById swaps...) — a fingerprint blind to
+        # them made the RulesetWatcher skip hot-swapping exclusion-only
+        # changes (round-3 review finding)
+        h.update(json.dumps(
+            [m.confirm for m in self.rules], sort_keys=True).encode())
+        h.update(json.dumps(
+            {str(k): v for k, v in self.ctl_specs.items()},
+            sort_keys=True).encode())
         return h.hexdigest()[:16]
 
     # ---------------------------------------------------------- serialize

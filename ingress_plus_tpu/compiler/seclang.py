@@ -19,9 +19,11 @@ from typing import Dict, List, Optional, Sequence
 
 # CRS-style rule-id range → attack class (verdict head).
 CLASS_RANGES = [
+    (911000, 911999, "protocol"),
     (913000, 913999, "scanner"),
     (920000, 920999, "protocol"),
     (921000, 921999, "protocol"),
+    (922000, 922999, "protocol"),
     (930000, 930999, "lfi"),
     (931000, 931999, "rfi"),
     (932000, 932999, "rce"),
@@ -373,7 +375,9 @@ def parse_seclang(
             else:
                 rules[:] = [r for r in rules if not pat.search(r.msg)]
             continue
-        if directive == "SecRuleUpdateTargetById":
+        if directive in ("SecRuleUpdateTargetById",
+                         "SecRuleUpdateTargetByTag",
+                         "SecRuleUpdateTargetByMsg"):
             # append targets (typically "!ARGS:password" exclusions) to
             # already-loaded rules; the per-variable confirm honors the
             # exclusion exactly, and the scan keeps its superset streams
@@ -383,18 +387,32 @@ def parse_seclang(
             # would widen detection instead of narrowing it.
             if len(tokens) < 3:
                 raise SecLangError(
-                    "%s: SecRuleUpdateTargetById needs id + targets"
-                    % source)
+                    "%s: %s needs selector + targets" % (source, directive))
             if len(tokens) > 3:
                 raise SecLangError(
-                    "%s: SecRuleUpdateTargetById REPLACED_TARGETS form "
-                    "is not supported" % source)
-            match = _id_matcher([tokens[1]])
+                    "%s: %s REPLACED_TARGETS form is not supported"
+                    % (source, directive))
+            if directive.endswith("ById"):
+                match = _id_matcher([tokens[1]])
+
+                def selected(r: Rule) -> bool:
+                    return match(r.rule_id)
+            else:
+                try:
+                    pat = re.compile(tokens[1])
+                except re.error as e:
+                    raise SecLangError("%s: bad %s pattern: %s"
+                                       % (source, directive, e))
+                by_tag = directive.endswith("ByTag")
+
+                def selected(r: Rule) -> bool:
+                    hay = r.tags if by_tag else [r.msg]
+                    return any(pat.search(t) for t in hay)
             new_toks = [t.strip() for t in tokens[2].split("|")
                         if t.strip()]
             positive = [t for t in new_toks if not t.startswith("!")]
             for r in rules:
-                if not match(r.rule_id):
+                if not selected(r):
                     continue
                 r.raw_targets.extend(
                     t for t in new_toks if t not in r.raw_targets)

@@ -432,11 +432,15 @@ class ConfirmRule:
             if not t:
                 continue
             if t.startswith("!"):
-                base, sep, sel = t[1:].partition(":")
-                cb = _COLLECTION_BASES.get(base.strip().upper())
-                if cb and sep:
-                    excl.setdefault(cb[0], set()).add(
-                        sel.strip().lower().encode())
+                parsed = parse_exclusion_token(t)
+                if parsed is not None:
+                    # same kinds expansion as the runtime ctl path: an
+                    # "!ARGS:x" exclusion must also reach rules iterating
+                    # the GET/POST-specific collections (round-3 review:
+                    # the two exclusion paths disagreed)
+                    kinds, sel = parsed
+                    for kind in kinds:
+                        excl.setdefault(kind, set()).add(sel)
                 continue
             count = t.startswith("&")
             if count:

@@ -70,7 +70,8 @@ class ServeLoop:
                                    RSCAN_MAGIC: "rscan", WS_MAGIC: "ws"})
         loop = asyncio.get_running_loop()
         streams = {}  # req_id → StreamState | None (None = mode-off stream)
-        ws_streams = {}  # stream_id → WSStream | None (off) | _OVERFLOW
+        ws_streams = {}  # stream_id → WSStream (live captures only)
+        ws_shed = set()  # over-cap stream ids already counted in stats
         write_lock = asyncio.Lock()
         classes_index = {c: i for i, c in enumerate(
             self.batcher.pipeline.ruleset.classes)}
@@ -168,11 +169,20 @@ class ServeLoop:
                                 send_pass(req_id)
                                 continue
                             if len(ws_streams) >= MAX_WS_PER_CONN:
-                                # over cap: per-frame fail-open, also
+                                # over cap: per-frame fail-open verdicts,
                                 # state-free.  If capacity frees later
                                 # the mid-stream bytes poison the fresh
-                                # parser → still fail-open, deterministic
-                                self.batcher.pipeline.stats.fail_open += 1
+                                # parser → still fail-open, deterministic.
+                                # The stats counter ticks once per SHED
+                                # STREAM, not per frame (bounded set; at
+                                # the cap it resets — slight over-count
+                                # beats unbounded growth)
+                                if stream_id not in ws_shed:
+                                    if len(ws_shed) >= 4096:
+                                        ws_shed.clear()
+                                    ws_shed.add(stream_id)
+                                    self.batcher.pipeline.stats.fail_open \
+                                        += 1
                                 send_pass(req_id, fail_open=True)
                                 continue
                             off = frozenset(

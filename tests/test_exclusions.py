@@ -196,6 +196,50 @@ SecRule REQUEST_URI "@beginsWith /forms/" \\
         [Request(uri="/forms/x?other=<script>y")])[0].attack
 
 
+def test_update_target_by_tag_and_msg():
+    """CRS application-exclusion packages lean on the ByTag form; silently
+    ignoring it kept rules firing on excluded params (review finding)."""
+    text = RULES + 'SecRuleUpdateTargetByTag attack-sqli "!ARGS:content"\n'
+    p = _pipeline(text)
+    assert not p.detect(
+        [Request(uri="/q?content=1 union select x")])[0].attack
+    assert p.detect([Request(uri="/q?id=1 union select x")])[0].attack
+    text2 = RULES.replace(
+        'id:941100,', "id:941100,msg:'XSS filter',") + \
+        'SecRuleUpdateTargetByMsg "XSS filter" "!ARGS:html"\n'
+    p2 = _pipeline(text2)
+    assert not p2.detect([Request(uri="/q?html=<script>y")])[0].attack
+    assert p2.detect([Request(uri="/q?other=<script>y")])[0].attack
+
+
+def test_args_exclusion_reaches_get_specific_collection():
+    """'!ARGS:x' (the GET∪POST union) must also narrow a rule iterating
+    ARGS_GET — config-time and runtime ctl exclusion paths must agree
+    (review finding)."""
+    text = """
+SecRule ARGS_GET "@rx (?i)union\\s+select" \\
+    "id:942900,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-sqli'"
+SecRuleUpdateTargetById 942900 "!ARGS:trusted"
+"""
+    p = _pipeline(text)
+    assert not p.detect(
+        [Request(uri="/q?trusted=1 union select x")])[0].attack
+    assert p.detect([Request(uri="/q?id=1 union select x")])[0].attack
+
+
+def test_fingerprint_covers_exclusions():
+    """Version must change when ONLY exclusion behavior changes, or the
+    RulesetWatcher never hot-swaps the new pack (review finding)."""
+    base = compile_ruleset(parse_seclang(RULES))
+    ctl = compile_ruleset(parse_seclang(CTL_REMOVE))
+    upd = compile_ruleset(parse_seclang(
+        RULES + 'SecRuleUpdateTargetById 942100 "!ARGS:trusted"\n'))
+    assert len({base.version, ctl.version, upd.version}) == 3
+    ctl2 = compile_ruleset(parse_seclang(CTL_REMOVE.replace(
+        "ruleRemoveById=942100", "ruleRemoveById=941100")))
+    assert ctl2.version != ctl.version
+
+
 def test_ctl_remove_by_tag_runtime():
     text = RULES + """
 SecRule REQUEST_URI "@beginsWith /static/" \\

@@ -1,0 +1,75 @@
+"""New CRS family coverage (911 method enforcement, 921 protocol attack,
+922 multipart, 934 Node.js) — each family's canonical payloads must
+verdict with the right class on the bundled pack, and benign shapes that
+brush the weak rules must stay under the anomaly threshold (the CRS
+PL2-noise-without-blocking behavior)."""
+
+import pytest
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.normalize import Request
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    return DetectionPipeline(compile_ruleset(load_bundled_rules()),
+                             mode="block")
+
+
+@pytest.mark.parametrize("want_class,want_rule,req", [
+    # 911: unknown method blocks; the scalar confirm sees the exact token
+    ("protocol", 911100, Request(method="TRACK", uri="/x")),
+    # 921: response splitting via encoded CRLF in a query arg
+    ("protocol", 921120,
+     Request(uri="/q?next=%0d%0aSet-Cookie:%20admin=1")),
+    # 921: smuggled request line in a body field
+    ("protocol", 921110,
+     Request(method="POST", uri="/c",
+             body=b"comment=GET /internal HTTP/1.1")),
+    # 921: raw CRLF inside a header value
+    ("protocol", 921140,
+     Request(uri="/x", headers={"X-Fwd": "a\r\nSet-Cookie: sess=evil"})),
+    # 922: duplicate multipart boundary parameters
+    ("protocol", 922110,
+     Request(method="POST", uri="/u", headers={
+         "Content-Type":
+             "multipart/form-data; boundary=a;b, boundary=c"})),
+    # 922: executable upload filename inside the multipart body
+    ("protocol", 922130,
+     Request(method="POST", uri="/u",
+             headers={"Content-Type": "multipart/form-data; boundary=X"},
+             body=b'--X\r\nContent-Disposition: form-data; name="f"; '
+                  b'filename="shell.php"\r\n\r\nhi\r\n--X--')),
+    # 934: child_process require / process access / proto pollution
+    ("nodejs", 934100,
+     Request(uri="/q?x=require('child_process').exec('id')")),
+    ("nodejs", 934110, Request(uri="/q?x=process.mainModule.require")),
+    ("nodejs", 934130, Request(uri="/q?__proto__[admin]=1")),
+])
+def test_family_payload_detected(pipeline, want_class, want_rule, req):
+    v = pipeline.detect([req])[0]
+    assert v.attack and v.blocked, (v.classes, v.rule_ids)
+    assert want_class in v.classes
+    assert want_rule in v.rule_ids
+
+
+@pytest.mark.parametrize("req", [
+    # ordinary multipart upload: ends with "--boundary--" which brushes
+    # the PL2 trailing-comment sqli rule — must stay under threshold
+    Request(method="POST", uri="/upload",
+            headers={"Content-Type": "multipart/form-data; "
+                     "boundary=----WebKitFormBoundary7MA4YWxk"},
+            body=b'------WebKitFormBoundary7MA4YWxk\r\n'
+                 b'Content-Disposition: form-data; name="photo"; '
+                 b'filename="me.jpg"\r\n\r\n...\r\n'
+                 b'------WebKitFormBoundary7MA4YWxk--'),
+    Request(uri="/blog?title=the spawn of a new era"),
+    Request(uri="/docs?path=constructors in java"),
+    Request(method="OPTIONS", uri="/api"),
+    Request(uri="/env?name=process improvement plan"),
+])
+def test_family_benign_not_blocked(pipeline, req):
+    v = pipeline.detect([req])[0]
+    assert not v.attack and not v.blocked, (v.classes, v.rule_ids)
