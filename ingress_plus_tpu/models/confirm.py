@@ -227,8 +227,12 @@ _COLLECTION_BASES = {
     "ARGS_GET_NAMES": ("queryargs", "names"),
     "ARGS_POST": ("bodyargs", "values"),
     "ARGS_POST_NAMES": ("bodyargs", "names"),
-    "FILES": ("bodyargs", "values"),
-    "FILES_NAMES": ("bodyargs", "names"),
+    # FILES shares the parsed-body collection but NOT the exclusion
+    # namespace: an "!ARGS:x" exclusion must never suppress an upload
+    # rule's match on a field of the same name (round-3 review —
+    # ModSecurity's ARGS exclusions don't touch FILES)
+    "FILES": ("files", "values"),
+    "FILES_NAMES": ("files", "names"),
     "RESPONSE_HEADERS": ("resp_headers", "values"),
     "RESPONSE_HEADERS_NAMES": ("resp_headers", "names"),
 }
@@ -356,6 +360,10 @@ def _parse_collection(kind: str, streams: Dict[str, bytes],
             # non-form body: ModSecurity's ARGS_POST is empty here (the
             # JSON/XML processors feed different collections)
             out = []
+    elif kind == "files":
+        # same parsed values as bodyargs, separate kind so ARGS-family
+        # exclusions can't reach it (see _COLLECTION_BASES note)
+        out = _parse_collection("bodyargs", streams, cache)
     elif kind == "args":
         # ModSecurity's ARGS is ARGS_GET ∪ ARGS_POST (round-3 review:
         # query-only counts fabricated '&ARGS @eq 0' hits on POSTs);
@@ -492,7 +500,7 @@ class ConfirmRule:
                 if not count and sel is None:
                     coarse = {"headers": "headers", "cookies": "headers",
                               "args": "args", "queryargs": "args",
-                              "bodyargs": "body",
+                              "bodyargs": "body", "files": "body",
                               "resp_headers": "resp_headers"}[kind]
                     blob = streams.get(coarse)
                     if blob:

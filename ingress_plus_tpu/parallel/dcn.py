@@ -51,8 +51,15 @@ def init_distributed(
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     if process_id is None and "JAX_PROCESS_ID" in os.environ:
         process_id = int(os.environ["JAX_PROCESS_ID"])
-    if jax.process_count() > 1:
-        return True  # already initialized by a launcher
+    # "already initialized by a launcher" must be detected WITHOUT
+    # touching the backend: jax.process_count() initializes XLA, after
+    # which jax.distributed.initialize refuses to run — the original
+    # check bricked every real multi-host bring-up through this helper
+    # (found by the two-process test)
+    state = getattr(getattr(jax._src, "distributed", None),
+                    "global_state", None)
+    if state is not None and getattr(state, "client", None) is not None:
+        return True  # a launcher already initialized the runtime
     if not coordinator_address or not num_processes or num_processes <= 1:
         return False
     jax.distributed.initialize(
@@ -111,6 +118,36 @@ def local_batch_bounds(mesh: Mesh, global_batch: int) -> Tuple[int, int]:
     if not rows:  # single-process meshes own everything
         return 0, global_batch
     return rows[0] * per_row, (rows[-1] + 1) * per_row
+
+
+def make_global(mesh: Mesh, spec, local_np: np.ndarray,
+                global_shape: Optional[Tuple[int, ...]] = None):
+    """Host-local numpy slice → global sharded jax.Array.
+
+    The multi-host ingestion step: each serve loop holds only its own
+    requests (local_batch_bounds slice); this assembles the global batch
+    array a multi-process ``shard_map`` step consumes, without any host
+    ever materializing another host's bytes.  Single-process meshes pass
+    through ``jax.device_put`` with the same sharding."""
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() <= 1:
+        return jax.device_put(np.asarray(local_np), sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(local_np), global_shape)
+
+
+def gather_global(x) -> np.ndarray:
+    """Global (possibly non-addressable) jax.Array → full numpy on every
+    process — the verdict fan-back of the multi-host step (a few bytes
+    per request over DCN; the reference ships verdicts over TCP the same
+    way).  Single-process arrays go straight to numpy."""
+    if jax.process_count() <= 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
 def device_duty_summary() -> dict:

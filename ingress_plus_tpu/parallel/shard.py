@@ -210,7 +210,13 @@ class ShardedEngine:
                num_requests: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """row_req must hold SHARD-LOCAL request indices (each data shard
         owns Q/n_data consecutive requests; the serve batcher lays batches
-        out this way).  num_requests is the global request count."""
+        out this way).  num_requests is the global request count.
+
+        Multi-process (DCN) meshes: pass GLOBAL arrays built with
+        ``parallel.dcn.make_global`` (each host contributes its
+        local_batch_bounds slice); outputs come back as full numpy on
+        every process via ``gather_global`` — tests/test_dcn.py drives
+        this with two real jax.distributed processes."""
         n_data = self.mesh.shape["data"]
         if num_requests % n_data != 0:
             raise ValueError(
@@ -220,4 +226,6 @@ class ShardedEngine:
             jnp.asarray(tokens), jnp.asarray(lengths),
             jnp.asarray(row_req), jnp.asarray(row_sv), jnp.asarray(tenants),
             num_requests)
-        return np.asarray(rh), np.asarray(ch), np.asarray(sc)
+        from ingress_plus_tpu.parallel.dcn import gather_global
+
+        return gather_global(rh), gather_global(ch), gather_global(sc)

@@ -21,7 +21,10 @@ int main(int argc, char** argv) {
     fprintf(stderr, "usage: shim_selftest <socket> [dead_socket]\n");
     return 2;
   }
-  ipt::DetectClient client(argv[1], /*deadline_ms=*/8000);
+  // generous deadline: the CI box is 1 vCPU and first-touch XLA compiles
+  // of a cold shape can take tens of seconds under full-suite load — a
+  // tight deadline here tests the scheduler, not the shim
+  ipt::DetectClient client(argv[1], /*deadline_ms=*/60000);
 
   ipt::Request attack;
   attack.req_id = 1;
@@ -52,6 +55,10 @@ int main(int argc, char** argv) {
   // across two capture calls (the serve-side parser carries state), then
   // a benign frame that must report the sticky verdict, then the end
   {
+    // warmup: compile the ws/stream-scan shapes on a throwaway stream so
+    // the asserted cases below measure behavior, not first-compile time
+    client.DetectWsBytes(100, 899, std::string("\x81\x02ok", 4));
+    client.DetectWsBytes(101, 899, "", 0, 2, false, /*end=*/true);
     // minimal RFC 6455 client frame: FIN|text, masked, payload<126
     auto ws_frame = [](const std::string& payload, bool fin, bool cont) {
       std::string f;

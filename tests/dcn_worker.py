@@ -1,0 +1,110 @@
+"""Worker process for the real multi-host (DCN) test — NOT a pytest file.
+
+Launched twice by tests/test_dcn.py::test_two_process_dcn_detect with a
+shared coordinator port.  Each process owns 4 virtual CPU devices and
+half of an 8-request batch; the hybrid mesh puts hosts on the data axis
+and the TP vote-merge psum on the host-local model axis.  Every process
+must end up with the SAME global verdicts, bit-identical to a
+single-device engine run over the full batch.
+
+Usage: python tests/dcn_worker.py <coordinator_port> <process_id>
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+from ingress_plus_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(4)   # before ANY jax backend touch
+
+import numpy as np  # noqa: E402
+
+from ingress_plus_tpu.compiler.ruleset import N_SV, VARIANTS, compile_ruleset  # noqa: E402
+from ingress_plus_tpu.compiler.seclang import STREAM_INDEX, parse_seclang  # noqa: E402
+from ingress_plus_tpu.models.engine import DetectionEngine  # noqa: E402
+from ingress_plus_tpu.ops.scan import pad_rows  # noqa: E402
+from ingress_plus_tpu.parallel import ShardedEngine  # noqa: E402
+from ingress_plus_tpu.parallel.dcn import (  # noqa: E402
+    hybrid_mesh,
+    init_distributed,
+    local_batch_bounds,
+    make_global,
+)
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+RULES = """
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)union\\s+select" \
+    "id:942100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-sqli'"
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)<script" \
+    "id:941100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-xss'"
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx /etc/passwd" \
+    "id:930120,phase:2,block,severity:CRITICAL,tag:'attack-lfi'"
+"""
+
+PAYLOADS = [
+    b"GET /search?q=1' UNION SELECT password FROM users--",
+    b"<script>alert(1)</script>",
+    b"; cat /etc/passwd",
+    b"plain benign text about shoes and prices",
+]
+
+
+def rows_for(requests):
+    """2 rows per request, request-major (the batcher's layout)."""
+    rows, row_req = [], []
+    for qi, q in enumerate(requests):
+        for r in range(2):
+            rows.append(PAYLOADS[(q + r) % len(PAYLOADS)])
+            row_req.append(qi)
+    tokens, lengths = pad_rows(rows, max_len=64, round_to=64)
+    sv = np.zeros((len(rows), N_SV), np.int8)
+    a = STREAM_INDEX["args"] * len(VARIANTS)
+    sv[:, a:a + len(VARIANTS)] = 1
+    return tokens.astype(np.int32), lengths, \
+        np.asarray(row_req, np.int32), sv
+
+
+def main() -> None:
+    port, pid = int(sys.argv[1]), int(sys.argv[2])
+    assert init_distributed("localhost:%d" % port, num_processes=2,
+                            process_id=pid), "distributed init failed"
+    import jax
+
+    assert jax.process_count() == 2 and len(jax.devices()) == 8
+
+    cr = compile_ruleset(parse_seclang(RULES))
+    mesh = hybrid_mesh()                      # (data=2 hosts, model=4)
+    assert mesh.shape == {"data": 2, "model": 4}, dict(mesh.shape)
+    n_req = 8
+    lo, hi = local_batch_bounds(mesh, n_req)
+    assert (lo, hi) == ((0, 4) if pid == 0 else (4, 8)), (pid, lo, hi)
+
+    # each host prepares ONLY its own requests (nginx-replica traffic
+    # locality); shard-local request ids within the slice
+    tokens, lengths, row_req, row_sv = rows_for(range(lo, hi))
+    eng = ShardedEngine(cr, mesh)
+    g = lambda spec, arr, shape: make_global(mesh, spec, arr, shape)
+    R = tokens.shape[0]                       # local rows (8) → global 16
+    rh, ch, sc = eng.detect(
+        g(P("data", None), tokens, (2 * R, tokens.shape[1])),
+        g(P("data"), lengths, (2 * R,)),
+        g(P("data"), row_req, (2 * R,)),
+        g(P("data", None), row_sv, (2 * R, row_sv.shape[1])),
+        g(P("data"), np.zeros((hi - lo,), np.int32), (n_req,)),
+        num_requests=n_req)
+
+    # reference: single-device engine over the FULL batch (deterministic
+    # on every host — no communication involved in checking)
+    ftok, flen, freq, fsv = rows_for(range(n_req))
+    single = DetectionEngine(cr)
+    rh1, ch1, sc1 = single.detect(ftok, flen, freq, fsv, n_req)
+    assert rh.shape == rh1.shape and (rh == rh1).all(), "rule hits differ"
+    assert (ch == ch1).all() and (sc == sc1).all()
+    assert rh1.any(), "reference found no hits — vacuous test"
+    print("P%d DCN DETECT OK (%d global hits)" % (pid, int(rh.sum())),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -227,6 +227,24 @@ SecRuleUpdateTargetById 942900 "!ARGS:trusted"
     assert p.detect([Request(uri="/q?id=1 union select x")])[0].attack
 
 
+def test_args_exclusion_does_not_reach_files():
+    """ModSecurity's ARGS exclusions never touch FILES: an '!ARGS:photo'
+    exclusion must not suppress an upload rule matching the form field
+    of the same name (review finding — FILES shared the bodyargs
+    exclusion namespace)."""
+    text = """
+SecRule FILES "@rx \\.php$" \\
+    "id:920460,phase:2,block,t:lowercase,severity:CRITICAL,tag:'attack-protocol'"
+SecRuleUpdateTargetById 920460 "!ARGS:photo"
+"""
+    p = _pipeline(text)
+    req = Request(
+        method="POST", uri="/up",
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+        body=b"photo=shell.php")
+    assert p.detect([req])[0].attack
+
+
 def test_fingerprint_covers_exclusions():
     """Version must change when ONLY exclusion behavior changes, or the
     RulesetWatcher never hot-swaps the new pack (review finding)."""

@@ -36,6 +36,10 @@ def pipeline():
      Request(method="POST", uri="/u", headers={
          "Content-Type":
              "multipart/form-data; boundary=a;b, boundary=c"})),
+    # 921: genuinely duplicated chunked coding still fires
+    ("protocol", 921160,
+     Request(method="POST", uri="/u",
+             headers={"Transfer-Encoding": "chunked, chunked"})),
     # 922: executable upload filename inside the multipart body
     ("protocol", 922130,
      Request(method="POST", uri="/u",
@@ -69,6 +73,15 @@ def test_family_payload_detected(pipeline, want_class, want_rule, req):
     Request(uri="/docs?path=constructors in java"),
     Request(method="OPTIONS", uri="/api"),
     Request(uri="/env?name=process improvement plan"),
+    # RFC 9112-legal: chunked as the FINAL coding after gzip — the
+    # duplicate-chunked smuggling rule must not fire (review finding)
+    Request(method="POST", uri="/u",
+            headers={"Transfer-Encoding": "gzip, chunked"}),
+    # RFC 2046-legal boundary chars ('=', '.', Java-mail style) — the
+    # invalid-boundary rule must not fire (review finding)
+    Request(method="POST", uri="/u", headers={
+        "Content-Type":
+            "multipart/form-data; boundary=----=_Part_5_123.456"}),
 ])
 def test_family_benign_not_blocked(pipeline, req):
     v = pipeline.detect([req])[0]

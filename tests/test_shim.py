@@ -60,7 +60,7 @@ def stack(tmp_path_factory):
     side_sock = str(tmp / "side.sock")
     side = subprocess.Popen(
         [str(SIDECAR), "--listen", side_sock, "--upstream", serve_sock,
-         "--deadline-ms", "8000"],
+         "--deadline-ms", "60000"],
         stderr=subprocess.PIPE, text=True)
     for _ in range(100):
         if Path(side_sock).exists():

@@ -252,12 +252,14 @@ def server(tmp_path_factory):
     rules_dir.mkdir()
     (rules_dir / "tiny.conf").write_text(RULES)
     sock = str(tmp / "ipt.sock")
+    spool = tmp / "spool"
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     proc = subprocess.Popen(
         [sys.executable, "-m", "ingress_plus_tpu.serve",
          "--socket", sock, "--rules-dir", str(rules_dir),
-         "--platform", "cpu", "--max-delay-us", "1000", "--no-warmup"],
+         "--platform", "cpu", "--max-delay-us", "1000", "--no-warmup",
+         "--spool-dir", str(spool), "--export-interval-s", "0.5"],
         cwd=str(REPO), env=env, stderr=subprocess.PIPE, text=True)
     for _ in range(600):
         if Path(sock).exists():
@@ -274,7 +276,13 @@ def server(tmp_path_factory):
     else:
         proc.kill()
         raise RuntimeError("server socket never appeared")
-    yield sock
+
+    class Srv(str):
+        pass
+
+    srv = Srv(sock)
+    srv.spool = spool
+    yield srv
     proc.terminate()
     proc.wait(timeout=10)
 
@@ -338,6 +346,30 @@ def test_e2e_ws_mode_off(server):
     frames = [encode_ws(20, 700, ws_frame(b"1 union select 2"), mode=0)]
     got = _drive(server, frames, [20])
     assert not got[20]["attack"] and not got[20]["fail_open"]
+
+
+def test_e2e_ws_attack_reaches_postanalytics(server):
+    """A flagged ws MESSAGE is recorded to the postanalytics channel
+    (wallarm's Tarantool-export analog): the spooled attack record
+    carries the per-message request id 'stream.msgIndex'."""
+    from ingress_plus_tpu.serve.protocol import encode_ws
+
+    got = _drive(server, [encode_ws(
+        40, 901, ws_frame(b"1 union select spooled", mask=b"pqrs"))], [40])
+    assert got[40]["attack"]
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        recs = []
+        for f in sorted(server.spool.glob("attacks*.jsonl")):
+            recs += [json.loads(l)
+                     for l in f.read_text().splitlines() if l.strip()]
+        hit = [r for r in recs
+               if r["class"] == "sqli" and "901.0" in r["sample_request_ids"]]
+        if hit:
+            assert hit[0]["count"] >= 1 and hit[0]["blocked"] >= 1
+            return
+        time.sleep(0.25)
+    raise AssertionError("ws attack never reached the spool: %s" % recs)
 
 
 def test_e2e_ws_poison_fail_open(server):
