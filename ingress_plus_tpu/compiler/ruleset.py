@@ -77,6 +77,11 @@ _DECODE_TRANSFORMS = {
 _HTML_TRANSFORMS = {"htmlEntityDecode"}
 _WS_COLLAPSE = {"compressWhitespace", "removeWhitespace", "cmdLine"}
 _PATH_TRANSFORMS = {"normalizePath", "normalisePath", "normalizePathWin"}
+#: comment transforms rewrite text in ways no scan variant models
+#: ("un/**/ion" → "un ion" resp. "union"): any factor extracted from the
+#: post-transform pattern could miss the pre-transform bytes, so rules
+#: carrying them compile always-confirm (sound; exact CPU evaluation)
+_COMMENT_TRANSFORMS = {"replaceComments", "removeCommentsChar"}
 _WS_BYTES = frozenset([0x20, 0x09, 0x0A, 0x0D, 0x0C, 0x0B])
 # Bytes deleted by the squash variants (stream side AND factor side).
 # Superset of what cmdLine deletes; whitespace covers compress/remove.
@@ -489,6 +494,8 @@ def _factor_group_for(rule: Rule) -> Tuple[F.Group, Dict]:
 
     # Soundness fix-ups for destructive transforms (see module docstring).
     t = set(rule.transforms)
+    if t & _COMMENT_TRANSFORMS:
+        return [], confirm
     if t & _PATH_TRANSFORMS and group:
         group = _split_at(group, _PATH_SEP_BYTES)
     if t & _WS_COLLAPSE and group:

@@ -38,11 +38,13 @@ CLASS_RANGES = [
     (950000, 954999, "leak"),
 ]
 
-# "leak" is appended LAST: class ids ride the wire as u8 indexes
-# (protocol.py / protocol.hpp) — existing ids must stay stable.
+# "leak"/"acl" are appended LAST: class ids ride the wire as u8 indexes
+# (protocol.py / protocol.hpp) — existing ids must stay stable.  "acl"
+# is the enforcement pseudo-class for wallarm-acl deny verdicts
+# (models/pipeline.py finalize), not a detection family.
 CLASSES = [
     "protocol", "scanner", "lfi", "rfi", "rce", "php", "nodejs",
-    "xss", "sqli", "session", "java", "generic", "leak",
+    "xss", "sqli", "session", "java", "generic", "leak", "acl",
 ]
 CLASS_INDEX = {c: i for i, c in enumerate(CLASSES)}
 

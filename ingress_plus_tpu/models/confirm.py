@@ -60,6 +60,19 @@ def t_trim(d: bytes) -> bytes:
     return d.strip(_WS)
 
 
+def t_replacecomments(d: bytes) -> bytes:
+    """ModSecurity replaceComments: each complete /*...*/ becomes one
+    space; an unterminated /* swallows the rest of the input."""
+    d = re.sub(rb"/\*.*?\*/", b" ", d, flags=re.S)
+    return re.sub(rb"/\*.*\Z", b" ", d, flags=re.S)
+
+
+def t_removecommentschar(d: bytes) -> bytes:
+    """ModSecurity removeCommentsChar: delete comment DELIMITERS
+    (/* */ -- #), keeping the commented text."""
+    return re.sub(rb"/\*|\*/|--|#", b"", d)
+
+
 def t_normalizepath(d: bytes) -> bytes:
     """Collapse //, remove /./, resolve seg/../ (keeps leading slash)."""
     prev = None
@@ -143,6 +156,8 @@ TRANSFORMS: Dict[str, Callable[[bytes], bytes]] = {
     "jsDecode": t_jsdecode,
     "cssDecode": t_cssdecode,
     "trim": t_trim,
+    "replaceComments": t_replacecomments,
+    "removeCommentsChar": t_removecommentschar,
     "utf8toUnicode": lambda d: d,  # no-op approximation
     "none": lambda d: d,
 }

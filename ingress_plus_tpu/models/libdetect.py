@@ -89,15 +89,61 @@ def _is_value(tok: Tuple[str, bytes]) -> bool:
     return tok[0] in _VALUE_KINDS
 
 
+def _no_word_run(tokens: List[Tuple[str, bytes]], lo: int, hi: int,
+                 run: int = 3) -> bool:
+    """True iff tokens[lo:hi] contains NO ``run`` consecutive bare words.
+
+    The strictness test separating SQL from prose: a select-list/table
+    reference is values separated by commas/operators/keywords, while
+    English ("select the best option from the union of both lists") runs
+    3+ unclassified words in a row.  (Round-4 fix: the round-3 grammar
+    accepted any co-occurrence of the keywords, which made the strict
+    confirm — whose entire job is killing false positives — fire on
+    ordinary sentences; wallarm/libdetection requires syntactic shape,
+    so must we.)"""
+    streak = 0
+    for k, _ in tokens[lo:hi]:
+        streak = streak + 1 if k == "word" else 0
+        if streak >= run:
+            return False
+    return True
+
+
 def _sqli_token_patterns(tokens: List[Tuple[str, bytes]]) -> bool:
     kinds = [k for k, _ in tokens]
 
-    # UNION ... SELECT (any gap)
-    if any(k == "kw:union" for k in kinds) and any(k == "kw:select" for k in kinds):
-        return True
-    # SELECT ... FROM
-    if any(k == "kw:select" for k in kinds) and any(k == "kw:from" for k in kinds):
-        return True
+    # UNION [ALL|DISTINCT] SELECT — structurally adjacent, not mere
+    # co-occurrence.  Comments and an opening paren between the keywords
+    # are the canonical obfuscations (`union/**/select`, `union(select`)
+    # and stay adjacent; arbitrary prose words do not.
+    for i, k in enumerate(kinds):
+        if k != "kw:union":
+            continue
+        j = i + 1
+        saw_modifier = False
+        while j < len(kinds):
+            kj = kinds[j]
+            if kj == "comment" or (kj == "op" and tokens[j][1] == b"("):
+                j += 1
+                continue
+            if not saw_modifier and kj == "word" and \
+                    tokens[j][1].lower() in (b"all", b"distinct"):
+                saw_modifier = True
+                j += 1
+                continue
+            break
+        if j < len(kinds) and kinds[j] == "kw:select":
+            return True
+    # SELECT <list> FROM <ref> — SQL-shaped list/ref (no prose word runs
+    # within the clause or the 3 tokens after FROM), bounded gap
+    for i, k in enumerate(kinds):
+        if k != "kw:select":
+            continue
+        for j in range(i + 1, min(i + 33, len(kinds))):
+            if kinds[j] == "kw:from":
+                if _no_word_run(tokens, i + 1, min(j + 4, len(tokens))):
+                    return True
+                break
     # stacked query: ';' followed by a statement keyword
     for i, k in enumerate(kinds):
         if k == "op" and tokens[i][1] == b";":

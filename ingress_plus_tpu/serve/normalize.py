@@ -41,8 +41,17 @@ _SQUASH = frozenset(SQUASH_BYTES)
 
 def url_decode_uni(data: bytes) -> bytes:
     """%XX and %uXXXX decoding (one pass, invalid sequences left intact),
-    plus '+' → space.  Mirrors ModSecurity urlDecodeUni closely enough for
-    the scan variant; the confirm stage uses this same function."""
+    plus '+' → space, plus overlong-UTF-8 folding.  Mirrors ModSecurity
+    urlDecodeUni (+t:utf8toUnicode) closely enough for the scan variant;
+    the confirm stage uses this same function."""
+    return fold_overlong_utf8(url_decode_uni_raw(data))
+
+
+def url_decode_uni_raw(data: bytes) -> bytes:
+    """The decode loop WITHOUT overlong folding — the streaming variant
+    decoder (serve/stream.py IncrementalVariant) needs the two stages
+    separate so an overlong pair split across chunks can be held and
+    folded when its continuation byte arrives."""
     out = bytearray()
     i, n = 0, len(data)
     while i < n:
@@ -76,6 +85,48 @@ def url_decode_uni(data: bytes) -> bytes:
         else:
             out.append(b)
             i += 1
+    return bytes(out)
+
+
+def fold_overlong_utf8(data: bytes) -> bytes:
+    """Fold OVERLONG UTF-8 encodings of ASCII to their codepoint.
+
+    The classic IIS/PHP-era evasion encodes ``'`` as C0 A7 (2-byte
+    overlong) or E0 80 A7 (3-byte): lenient decoders map it back to the
+    metacharacter while strict scanners see opaque high bytes.  Folding
+    here — inside the shared urldec step — makes the *payload* rules see
+    the real metacharacter on scan AND confirm identically (the
+    ModSecurity analog is t:utf8toUnicode plus 920250's
+    @validateUtf8Encoding flag).  VALID multi-byte UTF-8 (C2..DF lead)
+    is untouched: only overlong forms (C0/C1 lead; E0 80-9F lead pair)
+    are folded, so legitimate international text survives byte-exact.
+    """
+    # fast path (hot: every url-decoded stream passes here) — three
+    # C-level membership scans, no Python byte loop
+    if 0xC0 not in data and 0xC1 not in data and 0xE0 not in data:
+        return data
+    out = bytearray()
+    i, n = 0, len(data)
+    while i < n:
+        b = data[i]
+        if b in (0xC0, 0xC1) and i + 1 < n and 0x80 <= data[i + 1] <= 0xBF:
+            out.append(((b & 0x1F) << 6) | (data[i + 1] & 0x3F))
+            i += 2
+            continue
+        if (b == 0xE0 and i + 2 < n and 0x80 <= data[i + 1] <= 0x9F
+                and 0x80 <= data[i + 2] <= 0xBF):
+            code = ((b & 0x0F) << 12) | ((data[i + 1] & 0x3F) << 6) \
+                | (data[i + 2] & 0x3F)
+            if code < 0x100:
+                # overlong encoding of a byte-sized codepoint: fold.
+                # Larger codepoints (U+0100-U+07FF) are NOT folded —
+                # truncating them to a low byte would *invent*
+                # metacharacters the input never encoded.
+                out.append(code)
+                i += 3
+                continue
+        out.append(b)
+        i += 1
     return bytes(out)
 
 
@@ -170,11 +221,19 @@ class Request:
     body: bytes = b""
     tenant: int = 0          # EP routing: Ingress/namespace index
     request_id: str = ""
-    mode: int = 2            # wallarm_mode: 0 off, 1 monitoring, 2 block
-                             # (can only weaken the server's global mode)
+    mode: int = 2            # wallarm_mode: 0 off, 1 monitoring, 2 block,
+                             # 3 safe_blocking (wire value; precedence
+                             # order is models/pipeline.py MODE_STRENGTH
+                             # — can only weaken the server's global mode)
     parsers_off: frozenset = frozenset()   # wallarm-parser-disable analog;
                              # per-location disables also ride the
                              # x-detect-tpu-parser-disable header
+    client_ip: str = ""      # connection source IP from the TRUSTED plane
+                             # (shim-injected acl.CLIENT_IP_HEADER, popped
+                             # from headers at decode so it is never
+                             # scanned); "" = unknown → ACLs abstain
+    greylisted: bool = False  # safe_blocking input: source is greylisted
+                              # (frame MODE_GREYLIST bit or ACL greylist)
 
     #: which stream the StreamEngine chunk-scans (Response: "resp_body")
     body_stream = "body"

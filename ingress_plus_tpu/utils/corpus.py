@@ -150,14 +150,20 @@ def _benign(rng: random.Random, i: int) -> Request:
         path = path + "?" + qs
     method = "GET"
     body = b""
-    if rng.random() < 0.25:
-        method = "POST"
-        body = rng.choice(_BENIGN_BODIES)
     headers = {
         "host": "shop.example.com",
         "user-agent": rng.choice(_BENIGN_AGENTS),
         "accept": "*/*",
     }
+    if rng.random() < 0.25:
+        method = "POST"
+        body = rng.choice(_BENIGN_BODIES)
+        # real clients always frame the body (920180/920340 model the
+        # protocol violation; a synthetic corpus must not commit it)
+        headers["content-length"] = str(len(body))
+        headers["content-type"] = (
+            "application/json" if body[:1] in (b"{", b"[")
+            else "application/x-www-form-urlencoded")
     if rng.random() < 0.3:
         headers["cookie"] = "session=%032x" % rng.getrandbits(128)
     return Request(method=method, uri=path, headers=headers, body=body,
@@ -185,6 +191,8 @@ def _attack(rng: random.Random, i: int) -> LabeledRequest:
         method = "POST"
         uri = "/api/v1/comments"
         body = ("comment=" + payload).encode("utf-8", "surrogateescape")
+        headers["content-length"] = str(len(body))
+        headers["content-type"] = "application/x-www-form-urlencoded"
     elif slot < 0.9:  # uri path
         uri = "/files/" + payload
     else:  # header

