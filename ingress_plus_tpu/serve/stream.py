@@ -53,15 +53,18 @@ from ingress_plus_tpu.models.pipeline import DetectionPipeline, Verdict
 from ingress_plus_tpu.ops.scan import pad_rows, scan_bytes_jit
 from ingress_plus_tpu.serve.normalize import (
     Request,
+    fold_overlong_utf8,
     html_entity_decode,
     remove_nulls,
     squash,
-    url_decode_uni,
+    url_decode_uni_raw,
 )
 from ingress_plus_tpu.serve.unpack import (
     GZIP_MAGIC,
     IncrementalBase64,
+    IncrementalGrpc,
     IncrementalInflate,
+    grpc_content_kind,
     header_lookup,
 )
 
@@ -92,7 +95,19 @@ class IncrementalVariant:
     def __init__(self, variant: int):
         self.variant = variant
         self._url_tail = b""   # undecoded bytes (possible split escape)
+        self._fold_tail = b""  # decoded bytes (possible split overlong seq)
         self._ent_tail = b""   # url-decoded bytes (possible split entity)
+
+    @staticmethod
+    def _overlong_split(buf: bytes):
+        """Split off the longest suffix that could be an incomplete
+        overlong-UTF-8 sequence (C0/C1/E0 lead, or E0 80-9F pair) so
+        fold_overlong_utf8 over chunked input equals the one-shot fold."""
+        if buf and buf[-1] in (0xC0, 0xC1, 0xE0):
+            return buf[:-1], buf[-1:]
+        if len(buf) >= 2 and buf[-2] == 0xE0 and 0x80 <= buf[-1] <= 0x9F:
+            return buf[:-2], buf[-2:]
+        return buf, b""
 
     def feed(self, data: bytes) -> bytes:
         v = self.variant
@@ -101,7 +116,9 @@ class IncrementalVariant:
         if v == 3:
             return squash(data)
         safe, self._url_tail = _split_tail(self._url_tail + data, _URL_TAIL)
-        dec = remove_nulls(url_decode_uni(safe))
+        raw = self._fold_tail + url_decode_uni_raw(safe)
+        raw, self._fold_tail = self._overlong_split(raw)
+        dec = remove_nulls(fold_overlong_utf8(raw))
         if v == 1:
             return dec
         if v == 5:                   # squash(urldec) — NO html stage
@@ -114,8 +131,9 @@ class IncrementalVariant:
         v = self.variant
         if v in (0, 3):
             return b""
-        out = remove_nulls(url_decode_uni(self._url_tail))
-        self._url_tail = b""
+        raw = self._fold_tail + url_decode_uni_raw(self._url_tail)
+        self._url_tail, self._fold_tail = b"", b""
+        out = remove_nulls(fold_overlong_utf8(raw))
         if v == 1:
             return out
         if v == 5:
@@ -132,7 +150,8 @@ class StreamState:
     def __init__(self, request: Request,
                  variants: Sequence[Tuple[int, int, int]],
                  n_words: int, version: str, body_cap: int,
-                 scan_cap: int = DEFAULT_SCAN_CAP):
+                 scan_cap: int = DEFAULT_SCAN_CAP,
+                 pb_kind: Optional[str] = None):
         self.request = request          # body stays b"" (scanned separately)
         # [(variant_id, sv_id, src)] — src 0 scans the (inflated) body,
         # src 1 scans its incremental base64 decode (same sv ids: decoded
@@ -176,6 +195,16 @@ class StreamState:
         self.b64: Optional[IncrementalBase64] = (
             IncrementalBase64() if any(s == 1 for _, _, s in self.variants)
             else None)
+        # gRPC/protobuf extraction rows (src=2; BASELINE config #5):
+        # ``pb_kind`` comes from StreamEngine.begin's ONE
+        # grpc_content_kind call — the same decision that gated the
+        # src=2 rows, so gating and framing can never disagree.  Bare
+        # protobuf (x-protobuf, no gRPC framing) buffers and extracts at
+        # flush — the 5-byte-frame walker would go dead on its first
+        # tag byte.
+        self.grpc: Optional[IncrementalGrpc] = (
+            IncrementalGrpc(framed=(pb_kind != "bare"))
+            if any(s == 2 for _, _, s in self.variants) else None)
 
     def _unpack(self, data: bytes) -> bytes:
         """Raw chunk → scannable base bytes (inflate stage)."""
@@ -215,14 +244,15 @@ class StreamState:
             self.truncated = True
             base = base[:scan_room]
         b64_inc = self.b64.feed(base) if (self.b64 and base) else b""
-        # scan_cap bounds TOTAL scanned bytes — the base64-decoded
-        # duplicate rows (src=1) are scanned too, so they consume budget
-        # (round-2 advisor: counting only base understated the per-stream
-        # DoS scan bound by up to 1.75x)
-        self.scanned_len += len(base) + len(b64_inc)
+        grpc_inc = self.grpc.feed(base) if (self.grpc and base) else b""
+        # scan_cap bounds TOTAL scanned bytes — the base64-decoded and
+        # grpc-extracted duplicate rows (src=1/2) are scanned too, so
+        # they consume budget (round-2 advisor: counting only base
+        # understated the per-stream DoS scan bound)
+        self.scanned_len += len(base) + len(b64_inc) + len(grpc_inc)
         out = []
         for vi, (_v, _sv, src) in enumerate(self.variants):
-            inp = base if src == 0 else b64_inc
+            inp = (base, b64_inc, grpc_inc)[src]
             if inp and (inc := self.norms[vi].feed(inp)):
                 out.append((self, vi, inc))
         return out
@@ -239,6 +269,12 @@ class StreamState:
             # cut): only a prefix was scanned — surface at finish
             self.truncated = True
         b64_tail = self.b64.flush() if self.b64 is not None else b""
+        grpc_tail = b""
+        if self.grpc is not None:
+            grpc_tail = (self.grpc.feed(held) if held else b"") \
+                + self.grpc.flush()
+            # flush-time extraction consumes scan budget like feed-time
+            self.scanned_len += len(grpc_tail)
         out = []
         for vi, (_v, _sv, src) in enumerate(self.variants):
             inc = b""
@@ -246,6 +282,8 @@ class StreamState:
                 inc += self.norms[vi].feed(held)
             if src == 1 and b64_tail:
                 inc += self.norms[vi].feed(b64_tail)
+            if src == 2 and grpc_tail:
+                inc += self.norms[vi].feed(grpc_tail)
             inc += self.norms[vi].flush()
             if inc:
                 out.append((self, vi, inc))
@@ -278,10 +316,16 @@ class StreamEngine:
             # a second row group scanning the incremental base64 decode
             # of the body; costs nothing unless the body is base64-shaped
             variants += [(v, sv, 1) for v, sv, _ in base]
+        pb_kind = grpc_content_kind(
+            header_lookup(request.headers, "content-type"))
+        if "json" not in off and pb_kind is not None:
+            # gRPC text-field extraction rows (src=2; config #5) — same
+            # sv ids: extracted strings are another body normalization
+            variants += [(v, sv, 2) for v, sv, _ in base]
         return StreamState(request, variants, p.ruleset.tables.n_words,
                            p.ruleset.version,
                            body_cap if body_cap is not None
-                           else self.body_cap)
+                           else self.body_cap, pb_kind=pb_kind)
 
     # ------------------------------------------------------------ scan
 

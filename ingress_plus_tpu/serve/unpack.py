@@ -39,6 +39,7 @@ import base64
 import binascii
 import json
 import re
+import struct
 import zlib
 import xml.etree.ElementTree as ET
 from typing import Dict, FrozenSet, Optional
@@ -167,6 +168,139 @@ def extract_xml(data: bytes, max_out: int = DEFAULT_MAX_OUT
     return out[:max_out]
 
 
+def grpc_content_kind(content_type: str) -> Optional[str]:
+    """Shared gate for protobuf extraction: "framed" (gRPC 5-byte wire
+    framing), "bare" (raw protobuf message), or None.  Both the batch
+    unpack (unpack_body) and the streaming scan (stream.py
+    StreamEngine.begin / StreamState) MUST use this one predicate — if
+    they disagree, scan-stage prefilter hits get killed by a confirm
+    that never extracted."""
+    ct = content_type.lower()
+    if "grpc" in ct:
+        return "framed"
+    if "protobuf" in ct or "x-proto" in ct:
+        return "bare"
+    return None
+
+
+def split_grpc_frames(data: bytes, max_messages: int = 64):
+    """gRPC wire framing (BASELINE config #5 "gRPC/JSON API traffic"):
+    repeated ``[compressed u8][length u32 BE][message]``.  Returns the
+    (inflated) message payloads; tolerant of a truncated trailing frame
+    (streamed bodies may be capped mid-frame).  None when the body does
+    not parse as gRPC framing at all."""
+    out = []
+    i, n = 0, len(data)
+    while i + 5 <= n and len(out) < max_messages:
+        compressed = data[i]
+        if compressed not in (0, 1):
+            return out or None
+        (length,) = struct.unpack_from(">I", data, i + 1)
+        if length > MAX_GRPC_MESSAGE:
+            return out or None
+        msg = data[i + 5:i + 5 + length]
+        i += 5 + length
+        if compressed:
+            dec = inflate(msg)
+            if dec is None:
+                continue
+            msg = dec
+        out.append(msg)
+    return out or None
+
+
+MAX_GRPC_MESSAGE = 8 << 20
+
+
+def _read_varint(data: bytes, i: int):
+    """Protobuf varint at ``i`` → (value, next_index) or (None, i)."""
+    shift = 0
+    val = 0
+    start = i
+    while i < len(data) and i - start < 10:
+        b = data[i]
+        val |= (b & 0x7F) << shift
+        i += 1
+        if not b & 0x80:
+            return val, i
+        shift += 7
+    return None, start
+
+
+def _pb_walk(data: bytes, depth: int, segs: list, budget: list) -> bool:
+    """Strict protobuf wire walk: every field must parse to the end.
+    Length-delimited fields try nested-message first (bounded depth),
+    else are emitted as a text segment when they decode as mostly
+    printable UTF-8.  Returns False on any malformed field — the caller
+    treats the enclosing blob as opaque bytes."""
+    i, n = 0, len(data)
+    while i < n:
+        if budget[0] <= 0:
+            return True     # output budget hit: what we have is valid
+        tag, i2 = _read_varint(data, i)
+        if tag is None or i2 == i:
+            return False
+        field, wire = tag >> 3, tag & 7
+        if field == 0:
+            return False
+        i = i2
+        if wire == 0:       # varint
+            v, i = _read_varint(data, i)
+            if v is None:
+                return False
+        elif wire == 1:     # fixed64
+            if i + 8 > n:
+                return False
+            i += 8
+        elif wire == 5:     # fixed32
+            if i + 4 > n:
+                return False
+            i += 4
+        elif wire == 2:     # length-delimited
+            ln, i = _read_varint(data, i)
+            if ln is None or i + ln > n:
+                return False
+            blob = data[i:i + ln]
+            i += ln
+            if not blob:
+                continue
+            # speculative nested parse: roll back segments/budget on
+            # failure, or a half-parsed blob double-counts its strings
+            # AND burns max_out budget that later genuine fields need
+            mark, spent = len(segs), budget[0]
+            if depth > 0 and _pb_walk(blob, depth - 1, segs, budget):
+                continue    # parsed as a nested message
+            del segs[mark:]
+            budget[0] = spent
+            try:
+                txt = blob.decode("utf-8")
+                printable = sum(1 for c in txt if c.isprintable() or
+                                c in "\t\n\r")
+                if printable >= 0.8 * len(txt):
+                    segs.append(blob)
+                    budget[0] -= len(blob) + 1
+            except UnicodeDecodeError:
+                pass        # binary bytes field: nothing scannable
+        else:
+            return False    # wire types 3/4 (groups) unsupported = malformed
+    return True
+
+
+def extract_protobuf(data: bytes, max_out: int = 1 << 20,
+                     max_depth: int = 8) -> Optional[bytes]:
+    """String fields of a protobuf message (recursively, bounded depth
+    and output size), 0x1f-joined — the scannable text of a gRPC body."""
+    if not data:
+        return None
+    segs: list = []
+    budget = [max_out]
+    if not _pb_walk(data, max_depth, segs, budget):
+        return None
+    if not segs:
+        return None
+    return SEP.join(segs)[:max_out]
+
+
 # strict base64 shape: charset (std + urlsafe), optional padding, optional
 # interior whitespace; minimum length keeps short plain words from
 # decoding to noise rows
@@ -226,6 +360,16 @@ def unpack_body(body: bytes, headers: Dict[str, str],
         dec = decode_base64_like(base, max_out)
         if dec is not None:
             segs.append(dec)
+    # gRPC / protobuf (BASELINE config #5).  Gated under the "json"
+    # parser-disable bit (structured-body extraction family) — the wire
+    # mode byte has no spare flag bits.
+    pb_kind = grpc_content_kind(ct)
+    if "json" not in off and pb_kind is not None:
+        msgs = (split_grpc_frames(base) if pb_kind == "framed" else [base])
+        for msg in msgs or []:
+            ext = extract_protobuf(msg)
+            if ext is not None and ext != base:
+                segs.append(ext)
 
     if len(segs) == 1:
         return base
@@ -298,6 +442,70 @@ class IncrementalInflate:
         """True iff the compressed stream reached its end marker — an
         unfinished stream at body end means the scan saw only a prefix."""
         return self._d.eof and not self.error
+
+
+class IncrementalGrpc:
+    """Streaming gRPC-frame walker for the chunked-body path (BASELINE
+    config #5): buffers wire bytes, and for every COMPLETED message
+    yields its extracted protobuf text fields (0x1f-joined), which the
+    stream engine scans as an extra row group.
+
+    Bounded: one message is held at a time (≤ ``max_message``); framing
+    violations kill the decoder (``dead``) — already-emitted text can
+    only ever produce prefilter hits, which the confirm stage (whole-
+    body re-extract) decides."""
+
+    def __init__(self, max_message: int = MAX_GRPC_MESSAGE,
+                 framed: bool = True):
+        self._buf = bytearray()
+        self.max_message = max_message
+        self.framed = framed   # False: bare protobuf (application/
+        self.dead = False      # x-protobuf) — one unframed message,
+                               # buffered and extracted at flush()
+
+    def feed(self, data: bytes) -> bytes:
+        if self.dead or not data:
+            return b""
+        if not self.framed:
+            room = self.max_message - len(self._buf)
+            if room > 0:
+                self._buf += data[:room]
+            return b""
+        self._buf += data
+        out = []
+        while len(self._buf) >= 5:
+            compressed = self._buf[0]
+            if compressed not in (0, 1):
+                self.dead = True
+                break
+            (length,) = struct.unpack_from(">I", self._buf, 1)
+            if length > self.max_message:
+                self.dead = True
+                break
+            if len(self._buf) < 5 + length:
+                break
+            msg = bytes(self._buf[5:5 + length])
+            del self._buf[:5 + length]
+            if compressed:
+                dec = inflate(msg)
+                if dec is None:
+                    continue
+                msg = dec
+            ext = extract_protobuf(msg)
+            if ext:
+                out.append(ext)
+        if self.dead:
+            self._buf.clear()
+        return SEP.join(out) + SEP if out else b""
+
+    def flush(self) -> bytes:
+        """End of stream: bare-protobuf mode extracts its buffered
+        message now (framed mode discards a trailing partial frame)."""
+        if self.framed or self.dead or not self._buf:
+            return b""
+        ext = extract_protobuf(bytes(self._buf))
+        self._buf.clear()
+        return ext + SEP if ext else b""
 
 
 class IncrementalBase64:

@@ -215,11 +215,18 @@ def test_parser_disable_suppresses_detection(pipeline):
 
 
 def test_benign_json_still_passes(pipeline):
+    # well-formed client headers: the round-4 920 protocol-hygiene
+    # ladder correctly scores requests that omit Host/UA/Content-Length
+    # (that's CRS behavior, not an FP), so the benign request must not
+    # commit protocol violations the test doesn't mean to test
+    body = json.dumps({"name": "Alice", "bio": "likes SQL courses"}).encode()
     v = pipeline.detect([Request(
         method="POST", uri="/api/v1/users",
-        headers={"Content-Type": "application/json"},
-        body=json.dumps({"name": "Alice", "bio": "likes SQL courses"})
-        .encode())])[0]
+        headers={"Content-Type": "application/json",
+                 "Content-Length": str(len(body)),
+                 "Host": "shop.example.com",
+                 "User-Agent": "Mozilla/5.0 (X11; Linux x86_64)"},
+        body=body)])[0]
     assert not v.blocked
 
 
@@ -267,7 +274,10 @@ def test_streaming_corrupt_gzip_fails_open(pipeline):
     blob = bytes(rng.randrange(0x20, 0x7f) for _ in range(20000))
     payload = gzip.compress(blob)[:100] + b"\xff" * 200
     req = Request(method="POST", uri="/up", body=b"",
-                  headers={"Content-Encoding": "gzip"})
+                  headers={"Content-Encoding": "gzip",
+                           "Content-Length": str(len(payload)),
+                           "Host": "shop.example.com",
+                           "User-Agent": "Mozilla/5.0 (X11; Linux x86_64)"})
     v = _stream_verdict(pipeline, req, payload)
     assert not v.attack and v.fail_open   # truncated scan is surfaced
 
@@ -281,3 +291,95 @@ def test_streaming_parser_disable_carries_to_confirm(pipeline):
                   parsers_off=frozenset(["base64"]))
     v = _stream_verdict(pipeline, req, payload)
     assert not v.attack
+
+
+# ------------------------------------------------------ gRPC / protobuf
+
+def _pb_string(field: int, data: bytes) -> bytes:
+    """Encode one length-delimited protobuf field (wire type 2)."""
+    def varint(v):
+        out = bytearray()
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            out.append(b | (0x80 if v else 0))
+            if not v:
+                return bytes(out)
+    return varint((field << 3) | 2) + varint(len(data)) + data
+
+
+def _grpc_frame(msg: bytes, compressed: bool = False) -> bytes:
+    if compressed:
+        msg = gzip.compress(msg)
+    return bytes([1 if compressed else 0]) + len(msg).to_bytes(4, "big") + msg
+
+
+def test_grpc_injected_payload_detected(pipeline):
+    """BASELINE config #5: a SQLi payload inside a nested protobuf string
+    field of a gRPC-framed body must be extracted and detected."""
+    inner = _pb_string(1, b"user_42") + _pb_string(2, SQLI)
+    msg = _pb_string(1, b"query") + _pb_string(3, inner)
+    body = _grpc_frame(msg)
+    v = pipeline.detect([Request(
+        method="POST", uri="/api.Search/Query",
+        headers={"Content-Type": "application/grpc",
+                 "Content-Length": str(len(body)),
+                 "Host": "shop.example.com",
+                 "User-Agent": "grpc-go/1.60"},
+        body=body)])[0]
+    assert v.attack and "sqli" in v.classes, (v.classes, v.rule_ids)
+
+
+def test_grpc_streaming_injected_payload_detected(pipeline):
+    """Chunked gRPC body (multiple frames, one compressed) through the
+    stream path: the injected payload sits in frame 2."""
+    benign = _pb_string(1, b"hello") + _pb_string(2, b"world " * 200)
+    attack = _pb_string(1, _pb_string(4, b"q=" + SQLI))
+    payload = (_grpc_frame(benign) + _grpc_frame(attack, compressed=True)
+               + _grpc_frame(benign))
+    req = Request(method="POST", uri="/api.Search/Stream", body=b"",
+                  headers={"Content-Type": "application/grpc",
+                           "Host": "shop.example.com",
+                           "User-Agent": "grpc-go/1.60"})
+    v = _stream_verdict(pipeline, req, payload, chunk=97)
+    assert v.attack and "sqli" in v.classes, (v.classes, v.rule_ids)
+
+
+def test_grpc_benign_passes(pipeline):
+    msg = _pb_string(1, b"profile") + _pb_string(2, b"I like cats") + \
+        _pb_string(3, (7).to_bytes(1, "little"))
+    body = _grpc_frame(msg)
+    v = pipeline.detect([Request(
+        method="POST", uri="/api.Profile/Get",
+        headers={"Content-Type": "application/grpc",
+                 "Content-Length": str(len(body)),
+                 "Host": "shop.example.com",
+                 "User-Agent": "grpc-java/1.58"},
+        body=body)])[0]
+    assert not v.attack, (v.classes, v.rule_ids)
+
+
+def test_grpc_malformed_framing_tolerated(pipeline):
+    """Garbage after a valid frame: decoder goes dead, valid prefix still
+    scanned, no crash."""
+    msg = _pb_string(2, SQLI)
+    payload = _grpc_frame(msg) + b"\xff\xfe garbage not a frame"
+    req = Request(method="POST", uri="/api.X/Y", body=b"",
+                  headers={"Content-Type": "application/grpc",
+                           "Host": "shop.example.com",
+                           "User-Agent": "grpc-go/1.60"})
+    v = _stream_verdict(pipeline, req, payload, chunk=13)
+    assert v.attack and "sqli" in v.classes, (v.classes, v.rule_ids)
+
+
+def test_bare_protobuf_streaming_extracted(pipeline):
+    """application/x-protobuf (no gRPC framing) through the stream path:
+    buffered and extracted at flush — the frame walker must not go dead
+    on the first tag byte."""
+    msg = _pb_string(1, b"profile") + _pb_string(5, b"q=" + SQLI)
+    req = Request(method="POST", uri="/api/pb", body=b"",
+                  headers={"Content-Type": "application/x-protobuf",
+                           "Host": "shop.example.com",
+                           "User-Agent": "proto-client/1"})
+    v = _stream_verdict(pipeline, req, msg, chunk=11)
+    assert v.attack and "sqli" in v.classes, (v.classes, v.rule_ids)
