@@ -50,6 +50,10 @@ class GlobalConfig:
     paranoia_level: int = 2
     ruleset_path: str = ""               # compiled-ruleset artifact dir
     ruleset_sync_interval_s: int = 120   # sync-node† pull cadence
+    #: wallarm-acl CONTENT (the reference syncs lists from its cloud; the
+    #: open analog is the ConfigMap): JSON string
+    #: {"name": {"allow": [cidr], "deny": [...], "greylist": [...]}}
+    acls: str = ""
 
     # ---- representative core keys the template consumes
     server_tokens: bool = False

@@ -48,6 +48,30 @@ class Configuration:
     def tenant_tags(self) -> Dict[int, Tuple[str, ...]]:
         return {t: tags for t, (_, tags) in self.tenants.items()}
 
+    def tenant_acls(self) -> Dict[int, str]:
+        """tenant id → wallarm-acl name (the per-Ingress annotation
+        carried to the serve loop's ACL binding — models/acl.py).  An
+        Ingress's locations share its tenant id, so the first non-empty
+        acl per tenant wins; conflicting names are a model error."""
+        out: Dict[int, str] = {}
+        errs = set()
+        for server in self.servers:
+            for loc in server.locations:
+                det = loc.detection
+                t = det.tenant
+                if not det.acl:
+                    continue
+                if t in out and out[t] != det.acl:
+                    key = (t, out[t], det.acl)
+                    if key not in errs:
+                        errs.add(key)
+                        self.errors.append(
+                            "tenant %d: conflicting wallarm-acl %r vs %r"
+                            % (t, out[t], det.acl))
+                    continue
+                out[t] = det.acl
+        return out
+
 
 def _apply_globals(cfg: DetectionConfig, g: GlobalConfig) -> DetectionConfig:
     """Tier merge: ConfigMap sets the defaults annotations did not touch,

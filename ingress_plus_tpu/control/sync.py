@@ -66,6 +66,7 @@ class SyncResult:
     rendered: str
     configuration: Configuration
     pushed_tenants: bool = False
+    pushed_acls: bool = False
     errors: List[str] = field(default_factory=list)
 
 
@@ -76,18 +77,44 @@ class SyncController:
         self.serve_http = serve_http or self.global_config.sidecar_http
         self.last_rendered: Optional[str] = None
         self.last_tenants: Optional[Dict[int, Tuple[str, ...]]] = None
+        self.last_acls: Optional[dict] = None
 
-    def _push_tenants(self, tags: Dict[int, Tuple[str, ...]]) -> bool:
-        body = json.dumps({str(t): list(v) for t, v in tags.items()})
-        url = "http://%s/configuration/tenants" % self.serve_http
+    def _post(self, path: str, obj) -> bool:
+        url = "http://%s%s" % (self.serve_http, path)
         try:
             req = urllib.request.Request(
-                url, data=body.encode(), method="POST",
+                url, data=json.dumps(obj).encode(), method="POST",
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=5) as resp:
                 return 200 <= resp.status < 300
         except OSError:
             return False
+
+    def _push_tenants(self, tags: Dict[int, Tuple[str, ...]]) -> bool:
+        return self._post("/configuration/tenants",
+                          {str(t): list(v) for t, v in tags.items()})
+
+    def _acl_payload(self, cfg: Configuration) -> dict:
+        """wallarm-acl push body: ACL content from the ConfigMap tier
+        (GlobalConfig.acls JSON), bindings from per-Ingress annotations.
+        Bindings naming an ACL with no content are dropped with a model
+        error (the serve endpoint would reject the whole push)."""
+        try:
+            specs = json.loads(self.global_config.acls) \
+                if self.global_config.acls else {}
+            if not isinstance(specs, dict):
+                raise ValueError("acls must be a JSON object")
+        except (ValueError, TypeError) as e:
+            cfg.errors.append("acls configmap value: %s" % e)
+            specs = {}
+        binding = {}
+        for t, name in sorted(cfg.tenant_acls().items()):
+            if name in specs:
+                binding[str(t)] = name
+            else:
+                cfg.errors.append(
+                    "tenant %d: wallarm-acl %r has no list content" % (t, name))
+        return {"acls": specs, "tenant_acl": binding}
 
     def sync(self, ingresses: List[Ingress],
              configmap: Optional[ConfigMap] = None,
@@ -98,30 +125,37 @@ class SyncController:
         cfg = build_configuration(ingresses, self.global_config)
         text = render(cfg, self.global_config)
         tags = cfg.tenant_tags()
+        acls = self._acl_payload(cfg)
 
         if text != self.last_rendered:
             action = "reload"
-        elif tags != self.last_tenants:
+        elif tags != self.last_tenants or acls != self.last_acls:
             action = "dynamic"
         else:
             action = "noop"
 
-        pushed = False
+        pushed = pushed_acls = False
+        errors = []
         if push and tags != self.last_tenants:
             pushed = self._push_tenants(tags)
             if not pushed:
                 # leave last_tenants stale so the next sync retries the
                 # push (a restarting serve loop must not be skipped as
                 # "noop" forever)
-                self.last_rendered = text
-                return SyncResult(
-                    action=action, rendered=text, configuration=cfg,
-                    pushed_tenants=False,
-                    errors=list(cfg.errors) + list(self.global_config.errors)
-                    + ["tenant push to %s failed" % self.serve_http])
+                errors.append("tenant push to %s failed" % self.serve_http)
+        if push and acls != self.last_acls:
+            pushed_acls = self._post("/configuration/acl", acls)
+            if not pushed_acls:
+                errors.append("acl push to %s failed" % self.serve_http)
         self.last_rendered = text
-        self.last_tenants = tags
+        if push and not errors or not push:
+            self.last_tenants = tags
+            self.last_acls = acls
+        elif pushed:           # tenants landed, acls did not
+            self.last_tenants = tags
+        elif pushed_acls:      # acls landed, tenants did not
+            self.last_acls = acls
         return SyncResult(action=action, rendered=text, configuration=cfg,
-                          pushed_tenants=pushed,
+                          pushed_tenants=pushed, pushed_acls=pushed_acls,
                           errors=list(cfg.errors)
-                          + list(self.global_config.errors))
+                          + list(self.global_config.errors) + errors)

@@ -24,8 +24,17 @@ import numpy as np
 
 from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, VARIANTS
 from ingress_plus_tpu.compiler.seclang import CLASSES, STREAMS
+from ingress_plus_tpu.models.acl import AclStore
 from ingress_plus_tpu.models.confirm import ConfirmRule, parse_exclusion_token
 from ingress_plus_tpu.models.engine import DetectionEngine
+
+#: wallarm_mode precedence (weakest → strongest).  Wire values (frame
+#: mode bits 0-1) are historical — safe_blocking arrived round 4 as
+#: value 3, BETWEEN monitoring and block in strength — so strength is a
+#: lookup, not the numeric order.
+MODE_STRENGTH = {0: 0, 1: 1, 3: 2, 2: 3}   # off, monitoring, safe_blocking, block
+MODE_NAME_STRENGTH = {"off": 0, "monitoring": 1, "safe_blocking": 2,
+                      "block": 3}
 from ingress_plus_tpu.ops.scan import pad_rows
 from ingress_plus_tpu.serve.normalize import (
     Request,
@@ -85,9 +94,18 @@ class DetectionPipeline:
         paranoia_level: Optional[int] = None,
         tenant_rule_mask: Optional[np.ndarray] = None,  # (T, R) bool
         scan_impl: str = "pair",
+        acl_store: Optional[AclStore] = None,
+        tenant_acl: Optional[Dict[int, str]] = None,
+        default_acl: str = "",
     ):
         self.engine = DetectionEngine(ruleset, scan_impl=scan_impl)
         self.mode = mode
+        # wallarm-acl enforcement (VERDICT r03 missing #4): hot-swappable
+        # store + per-tenant ACL binding (the annotation is per-Ingress =
+        # per-tenant); default_acl applies when a tenant has no binding
+        self.acl_store = acl_store if acl_store is not None else AclStore()
+        self.tenant_acl: Dict[int, str] = dict(tenant_acl or {})
+        self.default_acl = default_acl
         # precedence for both knobs: explicit arg > the pack's compiled
         # CRS config (SecAction setvars / 949-style rule) > classic
         # defaults (threshold 5, PL2)
@@ -315,11 +333,33 @@ class DetectionPipeline:
                 {CLASSES[rs.rule_class[r]] for r in confirmed})
             attack = bool(confirmed) and score >= self.anomaly_threshold
             deny = any(rs.rule_action[r] == 2 for r in confirmed)
-            # per-request mode (the wallarm_mode location directive shipped
-            # in the frame) can only weaken the global mode, mirroring
-            # wallarm-mode-allow-override's default policy
-            eff_block = self.mode == "block" and getattr(req, "mode", 2) >= 2
-            blocked = eff_block and (attack or deny) and not detection_only
+            # --- ACL evaluation (wallarm-acl): longest-prefix decision
+            # over the tenant-bound (or default) list.  deny blocks
+            # outright (subject to mode), allow exempts the source from
+            # detection blocking (still monitored), greylist feeds
+            # safe_blocking below.  Unknown ACL/IP → None → no effect
+            # (fail-open, like wallarm-fallback).
+            acl_name = self.tenant_acl.get(
+                getattr(req, "tenant", 0), self.default_acl)
+            decision = self.acl_store.evaluate(
+                acl_name, getattr(req, "client_ip", ""))
+            greylisted = getattr(req, "greylisted", False) or \
+                decision == "greylist"
+            # per-request mode (the wallarm_mode location directive
+            # shipped in the frame) can only weaken the global mode,
+            # mirroring wallarm-mode-allow-override's default policy.
+            # safe_blocking (strength 2) blocks only greylisted sources.
+            eff = min(MODE_NAME_STRENGTH.get(self.mode, 3),
+                      MODE_STRENGTH.get(getattr(req, "mode", 2), 3))
+            mode_blocks = eff >= 3 or (eff == 2 and greylisted)
+            blocked = (mode_blocks and (attack or deny)
+                       and not detection_only and decision != "allow")
+            if decision == "deny" and eff >= 1:
+                # ACL denies are enforcement, not detection: any
+                # non-off mode blocks them (monitoring only flags)
+                classes = sorted(set(classes) | {"acl"})
+                blocked = blocked or eff >= 2
+                attack = True
             verdicts.append(Verdict(
                 request_id=req.request_id,
                 blocked=blocked,

@@ -89,6 +89,7 @@ from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 from ingress_plus_tpu.compiler.seclang import CLASSES
+from ingress_plus_tpu.models.acl import CLIENT_IP_HEADER
 from ingress_plus_tpu.serve.normalize import Request, Response, headers_blob
 
 REQ_MAGIC = b"QTPI"
@@ -108,6 +109,10 @@ FLAG_BLOCKED = 2
 FLAG_FAIL_OPEN = 4
 
 MODE_STREAM = 0x80     # request-frame mode bit: body arrives chunked
+MODE_GREYLIST = 0x04   # request-frame mode bit: source IP is greylisted
+                       # (trusted plane: shim/sidecar set it from their
+                       # own greylist knowledge; safe_blocking blocks
+                       # only these — models/pipeline.py finalize)
 CHUNK_LAST = 1         # chunk-frame flag: final chunk of the stream
 WS_DIR_S2C = 1         # ws-frame flag bit0: bytes are server→client
 WS_END = 2             # ws-frame flag bit1: upgraded connection closed
@@ -143,6 +148,8 @@ def decode_chunk(payload: bytes) -> Tuple[int, bool, bytes]:
 def encode_request(req: Request, req_id: int, mode: int = 2) -> bytes:
     for p in req.parsers_off:
         mode |= PARSER_OFF_BITS.get(p, 0)
+    if req.greylisted:
+        mode |= MODE_GREYLIST
     method = req.method.encode()
     uri = req.uri.encode("utf-8", "surrogateescape")
     hdr = headers_blob(req.headers)
@@ -179,9 +186,16 @@ def decode_request(payload: bytes) -> Tuple[int, int, Request]:
     body = payload[off:off + body_len]
     parsers_off = frozenset(
         name for name, bit in PARSER_OFF_BITS.items() if mode & bit)
-    return req_id, mode & ~_PARSER_MASK, Request(
+    # client IP rides the trusted plane as a shim-injected header; pop it
+    # so ACLs see it and the scanner never does
+    client_ip = ""
+    for k in list(headers):
+        if k.lower() == CLIENT_IP_HEADER:
+            client_ip = headers.pop(k)
+    return req_id, mode & ~(_PARSER_MASK | MODE_GREYLIST), Request(
         method=method, uri=uri, headers=headers, body=body, tenant=tenant,
-        request_id=str(req_id), parsers_off=parsers_off)
+        request_id=str(req_id), parsers_off=parsers_off,
+        client_ip=client_ip, greylisted=bool(mode & MODE_GREYLIST))
 
 
 def encode_response_scan(resp: Response, req_id: int, mode: int = 2) -> bytes:

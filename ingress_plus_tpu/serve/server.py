@@ -474,6 +474,40 @@ class ServeLoop:
                         json.dumps({"error": str(e)}).encode())
             return "200 OK", "application/json", json.dumps(
                 {"ruleset": cr.version, "rules": cr.n_rules}).encode()
+        if path == "/configuration/acl" and method == "POST":
+            # wallarm-acl push (no-reload lane): {"acls": {name: {allow:
+            # [cidr], deny: [...], greylist: [...]}}, "tenant_acl":
+            # {"<tenant>": name}, "default": name}.  Validated fully
+            # before the atomic swap — a bad spec changes nothing.
+            from ingress_plus_tpu.models.acl import AclError
+
+            def _swap_acls():
+                spec = json.loads(payload or b"{}")
+                if not isinstance(spec, dict):
+                    raise ValueError("payload must be a JSON object")
+                acl_specs = spec.get("acls", {})
+                names = set(acl_specs)
+                binding = {int(k): str(v)
+                           for k, v in spec.get("tenant_acl", {}).items()}
+                default = str(spec.get("default", ""))
+                missing = sorted((set(binding.values()) - names)
+                                 | ({default} - names if default else set()))
+                if missing:   # validate BEFORE any mutation: atomic swap
+                    raise ValueError("unknown acl(s) bound: %s" % missing)
+                loaded = pipeline.acl_store.swap(acl_specs)
+                pipeline.tenant_acl = binding
+                pipeline.default_acl = default
+                return loaded
+
+            try:
+                names = await loop.run_in_executor(None, _swap_acls)
+            except (AclError, ValueError, TypeError, KeyError,
+                    json.JSONDecodeError) as e:
+                return ("400 Bad Request", "application/json",
+                        json.dumps({"error": str(e)}).encode())
+            return "200 OK", "application/json", json.dumps(
+                {"acls": names,
+                 "tenant_bindings": len(pipeline.tenant_acl)}).encode()
         if path.startswith("/configuration"):
             # dbg CLI inspection (cmd/dbg† analog)
             tm = pipeline.tenant_rule_mask
@@ -484,6 +518,7 @@ class ServeLoop:
                 "scan_impl": pipeline.engine.scan_impl,
                 "anomaly_threshold": pipeline.anomaly_threshold,
                 "tenants": 1 if tm is None else int(tm.shape[0]),
+                "acls": pipeline.acl_store.names(),
                 "batch": {"max": self.batcher.max_batch,
                           "window_us": int(self.batcher.max_delay_s * 1e6)},
             }).encode()
@@ -577,7 +612,7 @@ def main(argv=None) -> None:
     ap.add_argument("--socket", default="/tmp/ingress_plus_tpu.sock")
     ap.add_argument("--http-port", type=int, default=9901)
     ap.add_argument("--mode", default="block",
-                    choices=["off", "monitoring", "block"])
+                    choices=["off", "monitoring", "safe_blocking", "block"])
     ap.add_argument("--rules-dir", default=None)
     ap.add_argument("--max-batch", type=int, default=256)
     ap.add_argument("--max-delay-us", type=int, default=500)

@@ -95,12 +95,19 @@ typedef struct {
 typedef void (*ngx_http_event_handler_pt)(ngx_http_request_t *r);
 typedef void (*ngx_http_client_body_handler_pt)(ngx_http_request_t *r);
 
+/* connection subset: only the member the module reads (the textual
+ * source address, nginx fills it at accept time) */
+typedef struct {
+    ngx_str_t                   addr_text;
+} ngx_connection_t;
+
 struct ngx_http_request_s {
     void                      **ctx;
     void                      **main_conf;
     void                      **srv_conf;
     void                      **loc_conf;
 
+    ngx_connection_t           *connection;
     ngx_pool_t                 *pool;
     ngx_http_request_t         *main;
     ngx_http_request_t         *parent;

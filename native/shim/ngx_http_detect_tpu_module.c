@@ -118,6 +118,11 @@ typedef struct {
     ngx_uint_t   timeout_ms;       /* detect_tpu_timeout_ms   */
     ngx_flag_t   fail_open;        /* detect_tpu_fail_open    */
     ngx_uint_t   tenant;           /* detect_tpu_tenant       */
+    ngx_str_t    acl;              /* detect_tpu_acl: informational at
+                                    * the data plane — enforcement runs
+                                    * serve-side via the tenant→acl
+                                    * binding the sync loop pushes;
+                                    * declared so rendered configs parse */
     ngx_str_t    block_page;       /* detect_tpu_block_page   */
     /* response/websocket scanning + parser toggles are captured from the
      * rendered config for parity with the reference's wallarm_* set; the
@@ -188,7 +193,10 @@ static ngx_int_t ngx_http_detect_tpu_init(ngx_conf_t *cf);
 static ngx_conf_enum_t ngx_http_detect_tpu_modes[] = {
     { ngx_string("off"), 0 },
     { ngx_string("monitoring"), 1 },
-    { ngx_string("safe_blocking"), 1 },
+    /* wire value 3; strength sits BETWEEN monitoring and block (the
+     * serve pipeline's MODE_STRENGTH lookup) — blocks only greylisted
+     * sources (frame greylist bit / server-side ACL greylist) */
+    { ngx_string("safe_blocking"), 3 },
     { ngx_string("block"), 2 },
     { ngx_null_string, 0 }
 };
@@ -235,6 +243,13 @@ static ngx_command_t ngx_http_detect_tpu_commands[] = {
       ngx_conf_set_num_slot,
       NGX_HTTP_LOC_CONF_OFFSET,
       offsetof(ngx_http_detect_tpu_loc_conf_t, tenant),
+      NULL },
+
+    { ngx_string("detect_tpu_acl"),
+      NGX_HTTP_MAIN_CONF|NGX_HTTP_SRV_CONF|NGX_HTTP_LOC_CONF|NGX_CONF_TAKE1,
+      ngx_conf_set_str_slot,
+      NGX_HTTP_LOC_CONF_OFFSET,
+      offsetof(ngx_http_detect_tpu_loc_conf_t, acl),
       NULL },
 
     { ngx_string("detect_tpu_block_page"),
@@ -293,12 +308,31 @@ ngx_module_t ngx_http_detect_tpu_module = {
     NGX_MODULE_V1_PADDING
 };
 
+/* the trusted client-ip header the serve-side ACL engine consumes
+ * (models/acl.py CLIENT_IP_HEADER): the shim OWNS this name — any
+ * inbound copy is dropped (it would be attacker-controlled) and the
+ * connection's source address is appended in its place */
+#define DETECT_TPU_CLIENT_IP_HDR      "x-detect-tpu-client-ip"
+#define DETECT_TPU_CLIENT_IP_HDR_LEN  (sizeof(DETECT_TPU_CLIENT_IP_HDR) - 1)
+
+static ngx_int_t
+ngx_http_detect_tpu_hdr_is_client_ip(ngx_table_elt_t *h)
+{
+    return h->key.len == DETECT_TPU_CLIENT_IP_HDR_LEN
+           && ngx_strncasecmp(h->key.data,
+                              (u_char *) DETECT_TPU_CLIENT_IP_HDR,
+                              DETECT_TPU_CLIENT_IP_HDR_LEN) == 0;
+}
+
 /* join a header list as "k: v\x1f k: v" — the wire blob the serve
  * loop's normalizer splits back into per-header match units (used for
- * headers_in on the request path, headers_out on the response path) */
+ * headers_in on the request path, headers_out on the response path).
+ * ``client_ip`` non-NULL (request path): strip any inbound
+ * DETECT_TPU_CLIENT_IP_HDR and append the trusted connection address
+ * under that name. */
 static ngx_int_t
 ngx_http_detect_tpu_headers_blob(ngx_http_request_t *r, ngx_list_t *list,
-                                 ngx_str_t *out)
+                                 ngx_str_t *client_ip, ngx_str_t *out)
 {
     size_t            len = 0;
     ngx_uint_t        i;
@@ -309,8 +343,15 @@ ngx_http_detect_tpu_headers_blob(ngx_http_request_t *r, ngx_list_t *list,
     for (part = &list->part; part; part = part->next) {
         h = part->elts;
         for (i = 0; i < part->nelts; i++) {
+            if (client_ip != NULL
+                && ngx_http_detect_tpu_hdr_is_client_ip(&h[i])) {
+                continue;   /* forged/forwarded copy: never shipped */
+            }
             len += h[i].key.len + 2 + h[i].value.len + 1;
         }
+    }
+    if (client_ip != NULL && client_ip->len) {
+        len += DETECT_TPU_CLIENT_IP_HDR_LEN + 2 + client_ip->len + 1;
     }
     if (len == 0) {
         ngx_str_null(out);
@@ -324,11 +365,22 @@ ngx_http_detect_tpu_headers_blob(ngx_http_request_t *r, ngx_list_t *list,
     for (part = &list->part; part; part = part->next) {
         h = part->elts;
         for (i = 0; i < part->nelts; i++) {
+            if (client_ip != NULL
+                && ngx_http_detect_tpu_hdr_is_client_ip(&h[i])) {
+                continue;
+            }
             p = ngx_cpymem(p, h[i].key.data, h[i].key.len);
             *p++ = ':'; *p++ = ' ';
             p = ngx_cpymem(p, h[i].value.data, h[i].value.len);
             *p++ = 0x1f;
         }
+    }
+    if (client_ip != NULL && client_ip->len) {
+        p = ngx_cpymem(p, DETECT_TPU_CLIENT_IP_HDR,
+                       DETECT_TPU_CLIENT_IP_HDR_LEN);
+        *p++ = ':'; *p++ = ' ';
+        p = ngx_cpymem(p, client_ip->data, client_ip->len);
+        *p++ = 0x1f;
     }
     out->len = p - out->data - 1;   /* drop the trailing separator */
     return NGX_OK;
@@ -534,6 +586,7 @@ ngx_http_detect_tpu_handler(ngx_http_request_t *r)
                                    : NGX_HTTP_SERVICE_UNAVAILABLE;
         }
         if (ngx_http_detect_tpu_headers_blob(r, &r->headers_in.headers,
+                                             &r->connection->addr_text,
                                              &ctx->headers_blob) != NGX_OK
             || ngx_http_detect_tpu_capture_body(r, &ctx->body) != NGX_OK)
         {
@@ -569,7 +622,10 @@ ngx_http_detect_tpu_handler(ngx_http_request_t *r)
     }
 
     /* entry 3: verdict available — apply it (event-loop thread only) */
-    if ((ctx->flags & DETECT_TPU_FLAG_BLOCKED) && conf->mode == 2) {
+    /* modes 2 (block) and 3 (safe_blocking) both enforce; the serve
+     * pipeline already restricted safe_blocking blocks to greylisted
+     * sources, so the shim only honors the verdict bit */
+    if ((ctx->flags & DETECT_TPU_FLAG_BLOCKED) && conf->mode >= 2) {
         if (conf->block_page.len) {
             /* the read-body refcount was balanced at entry 1, so the
              * redirect target's normal content path owns the remaining
@@ -626,6 +682,7 @@ ngx_http_detect_tpu_merge_loc_conf(ngx_conf_t *cf, void *parent, void *child)
     ngx_conf_merge_uint_value(conf->timeout_ms, prev->timeout_ms, 30);
     ngx_conf_merge_value(conf->fail_open, prev->fail_open, 1);
     ngx_conf_merge_uint_value(conf->tenant, prev->tenant, 0);
+    ngx_conf_merge_str_value(conf->acl, prev->acl, "");
     ngx_conf_merge_str_value(conf->block_page, prev->block_page, "");
     ngx_conf_merge_value(conf->parse_response, prev->parse_response, 0);
     ngx_conf_merge_value(conf->parse_websocket, prev->parse_websocket, 0);
@@ -663,7 +720,7 @@ ngx_http_detect_tpu_resp_headers_blob(ngx_http_request_t *r, ngx_str_t *out)
     ngx_str_t  list_blob;
 
     if (ngx_http_detect_tpu_headers_blob(r, &r->headers_out.headers,
-                                         &list_blob) != NGX_OK)
+                                         NULL, &list_blob) != NGX_OK)
     {
         return NGX_ERROR;
     }

@@ -371,3 +371,71 @@ def test_configuration_endpoints_and_dbg(server, tmp_path):
     s.close()
     assert got[9001]["attack"] and 955000 in got[9001]["rule_ids"]
     assert not got[9002]["attack"]
+
+
+def test_acl_hot_swap_over_wire(server):
+    """wallarm-acl enforcement e2e (VERDICT r03 item #6): push an ACL via
+    the dynamic-config lane, then verify deny / greylist+safe_blocking /
+    allow decisions change live verdicts with no restart."""
+    from ingress_plus_tpu.models.acl import CLIENT_IP_HEADER
+    from ingress_plus_tpu.serve.normalize import Request
+    from ingress_plus_tpu.serve.protocol import (
+        RESP_MAGIC, FrameReader, decode_response, encode_request)
+
+    req = urllib.request.Request(
+        "http://127.0.0.1:19901/configuration/acl",
+        data=json.dumps({
+            "acls": {"edge": {"deny": ["203.0.113.0/24"],
+                              "greylist": ["198.51.100.0/24"]}},
+            "default": "edge",
+        }).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    assert json.loads(urllib.request.urlopen(req, timeout=10).read())[
+        "acls"] == ["edge"]
+
+    def verdict(uri, ip, mode=2, rid=8101):
+        s = socket.socket(socket.AF_UNIX)
+        s.connect(server)
+        s.sendall(encode_request(Request(
+            uri=uri, headers={"host": "h", CLIENT_IP_HEADER: ip}),
+            req_id=rid, mode=mode))
+        reader = FrameReader(RESP_MAGIC)
+        s.settimeout(120)
+        got = None
+        while got is None:
+            for f in reader.feed(s.recv(65536)):
+                got = decode_response(f)
+        s.close()
+        return got
+
+    # denied source: blocked even on a benign request, class "acl"
+    r = verdict("/benign", "203.0.113.50")
+    assert r["blocked"] and "acl" in r["classes"], r
+    # neutral source, benign: untouched
+    r = verdict("/benign", "192.0.2.1", rid=8102)
+    assert not r["blocked"], r
+    # greylisted source + safe_blocking location mode: attack blocks
+    # (the suite's earlier hot-swap test left the 1-rule "drop table"
+    # pack live — use its payload)
+    r = verdict("/q?a=1;drop+table+users", "198.51.100.9", mode=3, rid=8103)
+    assert r["attack"] and r["blocked"], r
+    # non-greylisted source + safe_blocking: attack monitored only
+    r = verdict("/q?a=1;drop+table+users", "192.0.2.9", mode=3, rid=8104)
+    assert r["attack"] and not r["blocked"], r
+
+    # swap to an allowlist: the same attack source is now exempt
+    req = urllib.request.Request(
+        "http://127.0.0.1:19901/configuration/acl",
+        data=json.dumps({"acls": {"edge": {"allow": ["192.0.2.0/24"]}},
+                         "default": "edge"}).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=10)
+    r = verdict("/q?a=1;drop+table+users", "192.0.2.9", rid=8105)
+    assert r["attack"] and not r["blocked"], r
+
+    # clear ACLs so later tests see the original behavior
+    req = urllib.request.Request(
+        "http://127.0.0.1:19901/configuration/acl",
+        data=json.dumps({"acls": {}}).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=10)
