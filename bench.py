@@ -40,7 +40,7 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def probe_backend(timeouts=(120, 120, 180)):
+def probe_backend(timeouts=(60, 90, 120, 120), waits=(30, 45, 60)):
     """Decide which backend to use WITHOUT risking the parent process.
 
     Round-1 failure modes of the axon (remote-TPU-tunnel) backend, both
@@ -58,10 +58,12 @@ def probe_backend(timeouts=(120, 120, 180)):
     last_err = "unknown"
     for attempt, tmo in enumerate(timeouts):
         if attempt:
-            # spaced backoff (VERDICT round-2 item 1b): the r01/r02 hangs
-            # were transient tunnel states — give it time to recover.
-            # The watchdog is re-armed after the probe, so budget exists.
-            wait = 5 * (4 ** (attempt - 1))  # 5s, 20s, ...
+            # spread retries across the full watchdog budget (VERDICT
+            # round-3 item 1a): the r01-r03 hangs were transient tunnel
+            # states lasting minutes — probes land at t≈0/90/225/405s of
+            # the 540s budget (worst case 525s), so an outage that
+            # clears mid-bench still gets a live chip.
+            wait = waits[min(attempt - 1, len(waits) - 1)]
             log("TPU probe retry %d/%d in %ds (last: %s)"
                 % (attempt, len(timeouts) - 1, wait, last_err[:200]))
             time.sleep(wait)
