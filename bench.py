@@ -55,6 +55,10 @@ def probe_backend(timeouts=(60, 90, 120, 120), waits=(30, 45, 60)):
     """
     import subprocess
 
+    # the ladder's worst case (525s) nearly fills the 540s budget, and
+    # jax + module imports already ran inside the armed window — re-arm
+    # here so the final probe attempt cannot be killed by the watchdog
+    _arm_watchdog()
     last_err = "unknown"
     for attempt, tmo in enumerate(timeouts):
         if attempt:

@@ -1,0 +1,37 @@
+/* detect_tpu_conf.h — the module's location-conf layout, shared with the
+ * phase-machine harness (shim_harness.c) so the two can never drift: a
+ * field reorder that would silently corrupt a hand-mirrored copy is a
+ * compile-visible change here. */
+#ifndef DETECT_TPU_CONF_H
+#define DETECT_TPU_CONF_H
+
+#include <ngx_config.h>
+#include <ngx_core.h>
+
+typedef struct {
+    ngx_flag_t   enabled;          /* detect_tpu              */
+    ngx_str_t    socket_path;      /* detect_tpu_socket       */
+    ngx_uint_t   mode;             /* 0 off 1 monitoring 2 block
+                                    * 3 safe_blocking (wire values;
+                                    * strength order lives serve-side) */
+    ngx_uint_t   timeout_ms;       /* detect_tpu_timeout_ms   */
+    ngx_flag_t   fail_open;        /* detect_tpu_fail_open    */
+    ngx_uint_t   tenant;           /* detect_tpu_tenant       */
+    ngx_str_t    acl;              /* detect_tpu_acl: informational at
+                                    * the data plane — enforcement runs
+                                    * serve-side via the tenant→acl
+                                    * binding the sync loop pushes;
+                                    * declared so rendered configs parse */
+    ngx_str_t    block_page;       /* detect_tpu_block_page   */
+    /* response/websocket scanning + parser toggles are captured from the
+     * rendered config for parity with the reference's wallarm_* set; the
+     * response side hooks a body filter in a later phase of the build */
+    ngx_flag_t   parse_response;   /* detect_tpu_parse_response  */
+    ngx_flag_t   parse_websocket;  /* detect_tpu_parse_websocket */
+    ngx_array_t *parser_disable;   /* detect_tpu_parser_disable  */
+    ngx_str_t    metrics_addr;     /* detect_tpu_metrics: the serve loop's
+                                    * HTTP config/metrics plane (rendered
+                                    * at server scope by the template) */
+} ngx_http_detect_tpu_loc_conf_t;
+
+#endif /* DETECT_TPU_CONF_H */

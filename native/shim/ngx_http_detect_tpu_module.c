@@ -111,29 +111,8 @@ extern ngx_int_t detect_tpu_ws_roundtrip(
 #define DETECT_TPU_FLAG_BLOCKED   0x02
 #define DETECT_TPU_FLAG_FAIL_OPEN 0x04
 
-typedef struct {
-    ngx_flag_t   enabled;          /* detect_tpu              */
-    ngx_str_t    socket_path;      /* detect_tpu_socket       */
-    ngx_uint_t   mode;             /* 0 off 1 monitoring 2 block */
-    ngx_uint_t   timeout_ms;       /* detect_tpu_timeout_ms   */
-    ngx_flag_t   fail_open;        /* detect_tpu_fail_open    */
-    ngx_uint_t   tenant;           /* detect_tpu_tenant       */
-    ngx_str_t    acl;              /* detect_tpu_acl: informational at
-                                    * the data plane — enforcement runs
-                                    * serve-side via the tenant→acl
-                                    * binding the sync loop pushes;
-                                    * declared so rendered configs parse */
-    ngx_str_t    block_page;       /* detect_tpu_block_page   */
-    /* response/websocket scanning + parser toggles are captured from the
-     * rendered config for parity with the reference's wallarm_* set; the
-     * response side hooks a body filter in a later phase of the build */
-    ngx_flag_t   parse_response;   /* detect_tpu_parse_response  */
-    ngx_flag_t   parse_websocket;  /* detect_tpu_parse_websocket */
-    ngx_array_t *parser_disable;   /* detect_tpu_parser_disable  */
-    ngx_str_t    metrics_addr;     /* detect_tpu_metrics: the serve loop's
-                                    * HTTP config/metrics plane (rendered
-                                    * at server scope by the template) */
-} ngx_http_detect_tpu_loc_conf_t;
+#include "detect_tpu_conf.h"   /* ngx_http_detect_tpu_loc_conf_t — shared
+                                * with the phase-machine harness */
 
 /* response-scan task context: lives in r->pool; the request is pinned
  * (r->main->count++) until the completion event finalizes it, so the

@@ -1,0 +1,613 @@
+/*
+ * ngx_test_double — a RUNTIME implementation of the nginx_compat API
+ * subset, so ngx_http_detect_tpu_module.c's phase state machine can
+ * EXECUTE in CI (VERDICT r03 item #5: 881 LoC of re-entry/refcount/
+ * verdict logic had only ever been compile-checked).
+ *
+ * Faithful to the semantics the module depends on:
+ *   - pools: malloc arena, freed wholesale at destroy;
+ *   - event loop: a FIFO the driver drains single-threaded — thread-pool
+ *     completions enqueue here exactly like nginx's notify event, so the
+ *     handler can never observe a half-done ctx from the pool thread;
+ *   - thread pool: one real pthread running task->handler, then posting
+ *     task->event (mutex-protected handoff);
+ *   - ngx_http_read_client_request_body: takes the body preset by the
+ *     driver, r->main->count++ (the refcount the module must balance),
+ *     defers the continuation through the event queue (async path);
+ *   - ngx_http_core_run_phases: the access-phase walk with nginx's rc
+ *     contract (DECLINED → next phase/200, AGAIN/DONE → suspend,
+ *     status → finalize with it);
+ *   - ngx_http_finalize_request: refcount bookkeeping the driver asserts.
+ *
+ * The roundtrip itself is the REAL shim_bridge.cc → DetectClient → UDS →
+ * Python serve loop: these scenarios execute the same wire path
+ * production does, not a stubbed verdict.
+ */
+
+#define _POSIX_C_SOURCE 200809L
+
+#include <ngx_config.h>
+#include <ngx_core.h>
+#include <ngx_http.h>
+
+#include <pthread.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <strings.h>
+#include <time.h>
+
+#include "ngx_test_double.h"
+
+/* ------------------------------------------------------------- pools */
+
+typedef struct td_block_s {
+    struct td_block_s *next;
+} td_block_t;
+
+struct ngx_pool_s {
+    td_block_t *blocks;
+};
+
+ngx_pool_t *
+td_pool_create(void)
+{
+    ngx_pool_t *p = calloc(1, sizeof(ngx_pool_t));
+    return p;
+}
+
+void
+td_pool_destroy(ngx_pool_t *pool)
+{
+    td_block_t *b, *next;
+
+    if (pool == NULL) {
+        return;
+    }
+    for (b = pool->blocks; b; b = next) {
+        next = b->next;
+        free(b);
+    }
+    free(pool);
+}
+
+void *
+ngx_pnalloc(ngx_pool_t *pool, size_t size)
+{
+    td_block_t *b = malloc(sizeof(td_block_t) + size);
+
+    if (b == NULL) {
+        return NULL;
+    }
+    b->next = pool->blocks;
+    pool->blocks = b;
+    return (void *) (b + 1);
+}
+
+void *
+ngx_pcalloc(ngx_pool_t *pool, size_t size)
+{
+    void *p = ngx_pnalloc(pool, size);
+
+    if (p != NULL) {
+        memset(p, 0, size);
+    }
+    return p;
+}
+
+/* ------------------------------------------------------------ strings */
+
+ngx_int_t
+ngx_strncasecmp(u_char *s1, u_char *s2, size_t n)
+{
+    return (ngx_int_t) strncasecmp((const char *) s1, (const char *) s2, n);
+}
+
+u_char *
+ngx_snprintf(u_char *buf, size_t max, const char *fmt, ...)
+{
+    /* the module uses only "%O" (off_t) — translate to %lld */
+    va_list ap;
+    int     n;
+    char    tmp[64];
+
+    va_start(ap, fmt);
+    if (strcmp(fmt, "%O") == 0) {
+        long long v = (long long) va_arg(ap, off_t);
+        n = snprintf(tmp, sizeof(tmp), "%lld", v);
+    } else {
+        n = vsnprintf(tmp, sizeof(tmp), fmt, ap);
+    }
+    va_end(ap);
+    if (n < 0) {
+        n = 0;
+    }
+    if ((size_t) n > max) {
+        n = (int) max;
+    }
+    memcpy(buf, tmp, (size_t) n);
+    return buf + n;
+}
+
+/* -------------------------------------------------------- array, list */
+
+ngx_int_t
+td_array_init(ngx_array_t *a, ngx_pool_t *pool, ngx_uint_t n, size_t size)
+{
+    a->elts = ngx_pnalloc(pool, n * size);
+    if (a->elts == NULL) {
+        return NGX_ERROR;
+    }
+    a->nelts = 0;
+    a->size = size;
+    a->nalloc = n;
+    a->pool = pool;
+    return NGX_OK;
+}
+
+void *
+ngx_array_push(ngx_array_t *a)
+{
+    if (a->nelts == a->nalloc) {
+        void *n = ngx_pnalloc(a->pool, 2 * a->size * a->nalloc);
+        if (n == NULL) {
+            return NULL;
+        }
+        memcpy(n, a->elts, a->size * a->nelts);
+        a->elts = n;
+        a->nalloc *= 2;
+    }
+    return (u_char *) a->elts + a->size * a->nelts++;
+}
+
+ngx_int_t
+td_list_init(ngx_list_t *l, ngx_pool_t *pool, ngx_uint_t n, size_t size)
+{
+    l->part.elts = ngx_pnalloc(pool, n * size);
+    if (l->part.elts == NULL) {
+        return NGX_ERROR;
+    }
+    l->part.nelts = 0;
+    l->part.next = NULL;
+    l->last = &l->part;
+    l->size = size;
+    l->nalloc = n;
+    l->pool = pool;
+    return NGX_OK;
+}
+
+void *
+ngx_list_push(ngx_list_t *l)
+{
+    ngx_list_part_t *last = l->last;
+
+    if (last->nelts == l->nalloc) {
+        last = ngx_pcalloc(l->pool, sizeof(ngx_list_part_t));
+        if (last == NULL) {
+            return NULL;
+        }
+        last->elts = ngx_pnalloc(l->pool, l->nalloc * l->size);
+        if (last->elts == NULL) {
+            return NULL;
+        }
+        l->last->next = last;
+        l->last = last;
+    }
+    return (u_char *) last->elts + l->size * last->nelts++;
+}
+
+ssize_t
+ngx_read_file(ngx_file_t *file, u_char *buf, size_t size, off_t offset)
+{
+    (void) file; (void) buf; (void) size; (void) offset;
+    return -1;   /* the double presents bodies as memory buffers only */
+}
+
+/* -------------------------------------------------- conf slot setters
+ * (referenced by the module's command table; the driver fills conf
+ * structs directly, so these can never be reached at runtime) */
+
+char *ngx_conf_set_flag_slot(ngx_conf_t *cf, ngx_command_t *cmd, void *conf)
+{ (void) cf; (void) cmd; (void) conf; return NGX_CONF_ERROR; }
+char *ngx_conf_set_str_slot(ngx_conf_t *cf, ngx_command_t *cmd, void *conf)
+{ (void) cf; (void) cmd; (void) conf; return NGX_CONF_ERROR; }
+char *ngx_conf_set_str_array_slot(ngx_conf_t *cf, ngx_command_t *cmd,
+                                  void *conf)
+{ (void) cf; (void) cmd; (void) conf; return NGX_CONF_ERROR; }
+char *ngx_conf_set_num_slot(ngx_conf_t *cf, ngx_command_t *cmd, void *conf)
+{ (void) cf; (void) cmd; (void) conf; return NGX_CONF_ERROR; }
+char *ngx_conf_set_enum_slot(ngx_conf_t *cf, ngx_command_t *cmd, void *conf)
+{ (void) cf; (void) cmd; (void) conf; return NGX_CONF_ERROR; }
+
+/* ---------------------------------------------------------- event loop */
+
+#define TD_MAX_EVENTS 256
+
+static struct {
+    ngx_event_t    *q[TD_MAX_EVENTS];
+    int             head, tail;
+    pthread_mutex_t mu;
+    pthread_cond_t  cv;
+} td_events = { {0}, 0, 0, PTHREAD_MUTEX_INITIALIZER,
+                PTHREAD_COND_INITIALIZER };
+
+void
+td_post_event(ngx_event_t *ev)
+{
+    pthread_mutex_lock(&td_events.mu);
+    td_events.q[td_events.tail % TD_MAX_EVENTS] = ev;
+    td_events.tail++;
+    pthread_cond_signal(&td_events.cv);
+    pthread_mutex_unlock(&td_events.mu);
+}
+
+/* drain one event, waiting up to ms; 1 = ran one, 0 = timed out */
+int
+td_run_one_event(int timeout_ms)
+{
+    ngx_event_t     *ev = NULL;
+    struct timespec  ts;
+
+    clock_gettime(CLOCK_REALTIME, &ts);
+    ts.tv_sec += timeout_ms / 1000;
+    ts.tv_nsec += (long) (timeout_ms % 1000) * 1000000L;
+    if (ts.tv_nsec >= 1000000000L) {
+        ts.tv_sec++;
+        ts.tv_nsec -= 1000000000L;
+    }
+    pthread_mutex_lock(&td_events.mu);
+    while (td_events.head == td_events.tail) {
+        if (pthread_cond_timedwait(&td_events.cv, &td_events.mu, &ts) != 0) {
+            pthread_mutex_unlock(&td_events.mu);
+            return 0;
+        }
+    }
+    ev = td_events.q[td_events.head % TD_MAX_EVENTS];
+    td_events.head++;
+    pthread_mutex_unlock(&td_events.mu);
+    ev->handler(ev);
+    return 1;
+}
+
+/* --------------------------------------------------------- thread pool */
+
+struct ngx_thread_pool_s {
+    int dummy;
+};
+
+static ngx_thread_pool_t td_pool_obj;
+static ngx_str_t         td_pool_name_configured;
+volatile ngx_cycle_t    *ngx_cycle;
+static ngx_cycle_t       td_cycle;
+
+typedef struct {
+    ngx_thread_task_t *task;
+} td_thread_arg_t;
+
+static void *
+td_thread_main(void *arg)
+{
+    td_thread_arg_t   *a = arg;
+    ngx_thread_task_t *task = a->task;
+
+    free(a);
+    task->handler(task->ctx, NULL);
+    td_post_event(&task->event);   /* the notify-event handoff */
+    return NULL;
+}
+
+ngx_thread_pool_t *
+ngx_thread_pool_get(ngx_cycle_t *cycle, ngx_str_t *name)
+{
+    (void) cycle;
+    if (td_pool_name_configured.len == 0
+        || name->len != td_pool_name_configured.len
+        || memcmp(name->data, td_pool_name_configured.data, name->len) != 0)
+    {
+        return NULL;   /* scenario: no thread_pool block configured */
+    }
+    return &td_pool_obj;
+}
+
+void
+td_configure_thread_pool(const char *name)
+{
+    if (name == NULL) {
+        td_pool_name_configured.len = 0;
+        td_pool_name_configured.data = NULL;
+        return;
+    }
+    td_pool_name_configured.len = strlen(name);
+    td_pool_name_configured.data = (u_char *) name;
+}
+
+ngx_thread_task_t *
+ngx_thread_task_alloc(ngx_pool_t *pool, size_t size)
+{
+    ngx_thread_task_t *task;
+
+    task = ngx_pcalloc(pool, sizeof(ngx_thread_task_t) + size);
+    if (task == NULL) {
+        return NULL;
+    }
+    if (size) {
+        task->ctx = task + 1;
+    }
+    return task;
+}
+
+ngx_int_t
+ngx_thread_task_post(ngx_thread_pool_t *tp, ngx_thread_task_t *task)
+{
+    pthread_t        th;
+    td_thread_arg_t *a;
+
+    (void) tp;
+    a = malloc(sizeof(*a));
+    if (a == NULL) {
+        return NGX_ERROR;
+    }
+    a->task = task;
+    if (pthread_create(&th, NULL, td_thread_main, a) != 0) {
+        free(a);
+        return NGX_ERROR;
+    }
+    pthread_detach(th);
+    return NGX_OK;
+}
+
+/* --------------------------------------------- request state + phases */
+
+/* per-request driver state, reachable from the ngx_http_request_t the
+ * module sees (container pattern: the request is embedded) */
+
+td_request_t *
+td_from_request(ngx_http_request_t *r)
+{
+    return (td_request_t *) ((char *) r - offsetof(td_request_t, r));
+}
+
+void
+ngx_http_finalize_request(ngx_http_request_t *r, ngx_int_t rc)
+{
+    td_request_t *td = td_from_request(r->main);
+
+    if (rc == NGX_DONE) {
+        td->r.count--;
+        return;
+    }
+    if (rc >= NGX_HTTP_SPECIAL_RESPONSE) {
+        td->final_status = (int) rc;
+        td->done = 1;
+        return;
+    }
+    td->done = 1;
+}
+
+ngx_int_t
+ngx_http_internal_redirect(ngx_http_request_t *r, ngx_str_t *uri,
+                           ngx_str_t *args)
+{
+    td_request_t *td = td_from_request(r->main);
+
+    (void) args;
+    snprintf(td->redirect, sizeof(td->redirect), "%.*s",
+             (int) uri->len, (const char *) uri->data);
+    td->final_status = 302;   /* marker: internal redirect taken */
+    td->done = 1;
+    return NGX_OK;
+}
+
+/* continuation posted by the body-read double */
+static void
+td_body_ready_event(ngx_event_t *ev)
+{
+    td_request_t *td = ev->data;
+
+    td->body_post_handler(&td->r);
+}
+
+ngx_int_t
+ngx_http_read_client_request_body(ngx_http_request_t *r,
+                                  ngx_http_client_body_handler_pt handler)
+{
+    td_request_t *td = td_from_request(r);
+
+    r->main->count++;           /* what the module must balance */
+    td->body_post_handler = handler;
+
+    if (td->body_len) {
+        td->body_buf.pos = (u_char *) td->body;
+        td->body_buf.last = (u_char *) td->body + td->body_len;
+        td->body_buf.memory = 1;
+        td->body_chain.buf = &td->body_buf;
+        td->body_chain.next = NULL;
+        td->request_body.bufs = &td->body_chain;
+    } else {
+        td->request_body.bufs = NULL;
+    }
+    r->request_body = &td->request_body;
+
+    /* async path: the continuation fires from the event loop, like a
+     * client still streaming the body in */
+    td->body_ready_ev.data = td;
+    td->body_ready_ev.handler = td_body_ready_event;
+    td_post_event(&td->body_ready_ev);
+    return NGX_AGAIN;
+}
+
+/* the access-phase walk (subset: the handlers registered at init) */
+
+static ngx_http_core_main_conf_t *td_cmcf;
+
+void
+ngx_http_core_run_phases(ngx_http_request_t *r)
+{
+    td_request_t       *td = td_from_request(r);
+    ngx_http_handler_pt *h;
+    ngx_uint_t           i;
+    ngx_int_t            rc;
+
+    if (td->done) {
+        return;
+    }
+    h = td_cmcf->phases[NGX_HTTP_ACCESS_PHASE].handlers.elts;
+    for (i = 0; i < td_cmcf->phases[NGX_HTTP_ACCESS_PHASE].handlers.nelts;
+         i++)
+    {
+        rc = h[i](r);
+        td->last_rc = (int) rc;
+        if (rc == NGX_DECLINED) {
+            continue;            /* next handler / next phase */
+        }
+        if (rc == NGX_AGAIN || rc == NGX_DONE) {
+            return;              /* suspended: wait for an event */
+        }
+        ngx_http_finalize_request(r, rc);
+        return;
+    }
+    /* all access handlers declined: request proceeds (content phase) */
+    td->final_status = 200;
+    td->done = 1;
+}
+
+/* -------------------------------------------------------- module setup */
+
+static ngx_module_t *td_modules[2];
+
+ngx_module_t ngx_http_core_module;   /* index only */
+
+int
+td_setup(td_setup_result_t *out)
+{
+    ngx_http_module_t *mctx;
+    ngx_conf_t         cf;
+    ngx_http_conf_ctx_t conf_ctx;
+    static void       *main_confs[2];
+    static ngx_http_core_main_conf_t cmcf_storage;
+
+    ngx_http_detect_tpu_module.ctx_index = 0;
+    ngx_http_core_module.ctx_index = 1;
+    td_modules[0] = &ngx_http_detect_tpu_module;
+    td_modules[1] = &ngx_http_core_module;
+
+    out->pool = td_pool_create();
+    if (out->pool == NULL) {
+        return -1;
+    }
+    td_cmcf = &cmcf_storage;
+    if (td_array_init(&td_cmcf->phases[NGX_HTTP_ACCESS_PHASE].handlers,
+                      out->pool, 4, sizeof(ngx_http_handler_pt)) != NGX_OK) {
+        return -1;
+    }
+    main_confs[1] = td_cmcf;
+    conf_ctx.main_conf = main_confs;
+    conf_ctx.srv_conf = NULL;
+    conf_ctx.loc_conf = NULL;
+
+    memset(&cf, 0, sizeof(cf));
+    cf.pool = out->pool;
+    cf.ctx = &conf_ctx;
+
+    ngx_cycle = &td_cycle;
+
+    mctx = ngx_http_detect_tpu_module.ctx;
+    out->loc_conf = mctx->create_loc_conf(&cf);
+    if (out->loc_conf == NULL) {
+        return -1;
+    }
+    /* merge against an empty parent applies the documented defaults */
+    {
+        void *parent = mctx->create_loc_conf(&cf);
+        if (parent == NULL
+            || mctx->merge_loc_conf(&cf, parent, out->loc_conf)
+               != NGX_CONF_OK) {
+            return -1;
+        }
+    }
+    if (mctx->postconfiguration(&cf) != NGX_OK) {
+        return -1;
+    }
+    return 0;
+}
+
+int
+td_request_init(td_request_t *td, ngx_pool_t *pool, void *loc_conf,
+                const char *method, const char *uri,
+                const char *addr_text)
+{
+    memset(td, 0, sizeof(*td));
+    td->r.pool = pool;
+    td->r.main = &td->r;
+    td->r.count = 1;
+    td->ctxs[0] = NULL;
+    td->loc_confs[0] = loc_conf;
+    td->r.ctx = td->ctxs;
+    td->r.loc_conf = td->loc_confs;
+    td->r.method_name.data = (u_char *) method;
+    td->r.method_name.len = strlen(method);
+    td->r.unparsed_uri.data = (u_char *) uri;
+    td->r.unparsed_uri.len = strlen(uri);
+    td->conn.addr_text.data = (u_char *) addr_text;
+    td->conn.addr_text.len = strlen(addr_text);
+    td->r.connection = &td->conn;
+    td->r.headers_out.content_length_n = -1;
+    if (td_list_init(&td->r.headers_in.headers, pool, 8,
+                     sizeof(ngx_table_elt_t)) != NGX_OK
+        || td_list_init(&td->r.headers_out.headers, pool, 8,
+                        sizeof(ngx_table_elt_t)) != NGX_OK) {
+        return -1;
+    }
+    return 0;
+}
+
+int
+td_add_header_in(td_request_t *td, const char *key, const char *value)
+{
+    ngx_table_elt_t *h = ngx_list_push(&td->r.headers_in.headers);
+
+    if (h == NULL) {
+        return -1;
+    }
+    h->hash = 1;
+    h->key.data = (u_char *) key;
+    h->key.len = strlen(key);
+    h->value.data = (u_char *) value;
+    h->value.len = strlen(value);
+    return 0;
+}
+
+int
+td_find_header_out(td_request_t *td, const char *key, const char *value)
+{
+    ngx_list_part_t *part;
+    ngx_table_elt_t *h;
+    ngx_uint_t       i;
+
+    for (part = &td->r.headers_out.headers.part; part; part = part->next) {
+        h = part->elts;
+        for (i = 0; i < part->nelts; i++) {
+            if (h[i].key.len == strlen(key)
+                && strncasecmp((const char *) h[i].key.data, key,
+                               h[i].key.len) == 0
+                && h[i].value.len == strlen(value)
+                && memcmp(h[i].value.data, value, h[i].value.len) == 0) {
+                return 1;
+            }
+        }
+    }
+    return 0;
+}
+
+/* ----------------------------------------------------- filter chain */
+
+static ngx_int_t
+td_terminal_body_filter(ngx_http_request_t *r, ngx_chain_t *in)
+{
+    (void) r; (void) in;
+    return NGX_OK;
+}
+
+ngx_http_output_header_filter_pt ngx_http_top_header_filter;
+ngx_http_output_body_filter_pt   ngx_http_top_body_filter =
+    td_terminal_body_filter;

@@ -1,0 +1,60 @@
+/* ngx_test_double — driver-facing API of the runtime nginx double.
+ * See ngx_test_double.c; used by shim_harness.c. */
+#ifndef NGX_TEST_DOUBLE_H
+#define NGX_TEST_DOUBLE_H
+
+#include <ngx_config.h>
+#include <ngx_core.h>
+#include <ngx_http.h>
+
+/* the module under test */
+extern ngx_module_t ngx_http_detect_tpu_module;
+
+typedef struct {
+    ngx_pool_t *pool;
+    void       *loc_conf;   /* ngx_http_detect_tpu_loc_conf_t, defaults
+                             * merged; the driver overrides fields */
+} td_setup_result_t;
+
+typedef struct {
+    ngx_http_request_t r;        /* what the module sees (embedded) */
+    ngx_connection_t   conn;
+    void              *ctxs[1];
+    void              *loc_confs[1];
+
+    /* driver-preset body (memory buf) */
+    const char        *body;
+    size_t             body_len;
+    ngx_buf_t          body_buf;
+    ngx_chain_t        body_chain;
+    ngx_http_request_body_t request_body;
+    ngx_http_client_body_handler_pt body_post_handler;
+    ngx_event_t        body_ready_ev;
+
+    /* outcome */
+    int  done;
+    int  final_status;     /* 200 pass, 403, 503, 302=block-page redirect */
+    int  last_rc;
+    char redirect[256];
+} td_request_t;
+
+ngx_pool_t *td_pool_create(void);
+void td_pool_destroy(ngx_pool_t *pool);
+ngx_int_t td_array_init(ngx_array_t *a, ngx_pool_t *pool, ngx_uint_t n,
+                        size_t size);
+ngx_int_t td_list_init(ngx_list_t *l, ngx_pool_t *pool, ngx_uint_t n,
+                       size_t size);
+
+void td_post_event(ngx_event_t *ev);
+int  td_run_one_event(int timeout_ms);
+void td_configure_thread_pool(const char *name);   /* NULL = none */
+
+int td_setup(td_setup_result_t *out);
+int td_request_init(td_request_t *td, ngx_pool_t *pool, void *loc_conf,
+                    const char *method, const char *uri,
+                    const char *addr_text);
+int td_add_header_in(td_request_t *td, const char *key, const char *value);
+int td_find_header_out(td_request_t *td, const char *key, const char *value);
+td_request_t *td_from_request(ngx_http_request_t *r);
+
+#endif /* NGX_TEST_DOUBLE_H */

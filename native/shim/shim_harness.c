@@ -1,0 +1,267 @@
+/*
+ * shim_harness — drives ngx_http_detect_tpu_module.c's access-phase
+ * state machine end to end against a REAL serve loop over UDS
+ * (VERDICT r03 item #5).
+ *
+ * Each scenario runs the full entry-1 (body read kickoff) →
+ * continuation → entry-2 (capture + thread-pool post) → completion
+ * event → entry-3 (verdict application) walk, through the production
+ * shim_bridge/DetectClient wire path, and asserts the final status,
+ * response headers, the internal-redirect target, and — after every
+ * scenario — the request refcount invariants (count back to 1,
+ * blocked==0, aio==0) that leak keepalive connections when wrong.
+ *
+ * Usage: shim_harness <serve-socket-path>
+ * Output: one "ok <name>" / "FAIL <name>: ..." line per scenario;
+ * exit 0 iff all pass.  tests/test_shim.py builds and runs it.
+ */
+
+#include <ngx_config.h>
+#include <ngx_core.h>
+#include <ngx_http.h>
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "detect_tpu_conf.h"
+#include "ngx_test_double.h"
+
+typedef ngx_http_detect_tpu_loc_conf_t td_loc_conf_t;
+
+static int g_failures;
+
+#define CHECK(name, cond, fmt, ...)                                        \
+    do {                                                                   \
+        if (cond) {                                                        \
+            printf("ok %s\n", name);                                       \
+        } else {                                                           \
+            printf("FAIL %s: " fmt "\n", name, __VA_ARGS__);               \
+            g_failures++;                                                  \
+        }                                                                  \
+    } while (0)
+
+/* run one request to completion: start the phase walk, then drain
+ * events until the request resolves (or times out) */
+static int
+run_request(td_request_t *td, int timeout_ms)
+{
+    int waited = 0;
+
+    ngx_http_core_run_phases(&td->r);
+    while (!td->done && waited < timeout_ms) {
+        if (!td_run_one_event(50)) {
+            waited += 50;
+        }
+    }
+    return td->done;
+}
+
+static int
+refcounts_ok(td_request_t *td)
+{
+    return td->r.count == 1 && td->r.blocked == 0 && td->r.aio == 0;
+}
+
+int
+main(int argc, char **argv)
+{
+    td_setup_result_t  setup;
+    td_loc_conf_t     *conf;
+    td_request_t       td;
+    ngx_pool_t        *rp;
+
+    if (argc < 2) {
+        fprintf(stderr, "usage: shim_harness <serve-socket>\n");
+        return 2;
+    }
+    if (td_setup(&setup) != 0) {
+        fprintf(stderr, "setup failed\n");
+        return 2;
+    }
+    conf = setup.loc_conf;
+    conf->enabled = 1;
+    conf->socket_path.data = (u_char *) argv[1];
+    conf->socket_path.len = strlen(argv[1]);
+    conf->timeout_ms = 10000;
+    conf->mode = 2;
+    conf->fail_open = 1;
+    td_configure_thread_pool("detect_tpu");
+
+    /* 1. benign pass: full 3-entry walk, DECLINED at the end */
+    rp = td_pool_create();
+    td_request_init(&td, rp, conf, "GET", "/products?page=2", "192.0.2.10");
+    td_add_header_in(&td, "Host", "shop.example.com");
+    td_add_header_in(&td, "User-Agent", "Mozilla/5.0 (X11; Linux) Chrome");
+    run_request(&td, 15000);
+    CHECK("benign_pass", td.done && td.final_status == 200,
+          "done=%d status=%d rc=%d", td.done, td.final_status, td.last_rc);
+    CHECK("benign_pass_refcount", refcounts_ok(&td),
+          "count=%d blocked=%d aio=%d", td.r.count, td.r.blocked, td.r.aio);
+    td_pool_destroy(rp);
+
+    /* 2. attack in block mode: 403 */
+    rp = td_pool_create();
+    td_request_init(&td, rp, conf, "GET",
+                    "/q?a=1'+union+select+password+from+users--",
+                    "192.0.2.10");
+    td_add_header_in(&td, "Host", "shop.example.com");
+    td_add_header_in(&td, "User-Agent", "Mozilla/5.0 (X11; Linux) Chrome");
+    run_request(&td, 15000);
+    CHECK("attack_block_403", td.done && td.final_status == 403,
+          "done=%d status=%d", td.done, td.final_status);
+    CHECK("attack_block_refcount", refcounts_ok(&td),
+          "count=%d blocked=%d aio=%d", td.r.count, td.r.blocked, td.r.aio);
+    td_pool_destroy(rp);
+
+    /* 3. attack with a block page: internal redirect, not bare 403 */
+    ngx_str_set(&conf->block_page, "/blocked.html");
+    rp = td_pool_create();
+    td_request_init(&td, rp, conf, "POST", "/c", "192.0.2.10");
+    td_add_header_in(&td, "Host", "shop.example.com");
+    td_add_header_in(&td, "User-Agent", "Mozilla/5.0 (X11; Linux) Chrome");
+    td.body = "comment=<script>alert(document.cookie)</script>";
+    td.body_len = strlen(td.body);
+    td_add_header_in(&td, "Content-Type",
+                     "application/x-www-form-urlencoded");
+    td_add_header_in(&td, "Content-Length", "47");
+    run_request(&td, 15000);
+    CHECK("attack_block_page",
+          td.done && td.final_status == 302
+          && strcmp(td.redirect, "/blocked.html") == 0,
+          "done=%d status=%d redirect=%s", td.done, td.final_status,
+          td.redirect);
+    CHECK("attack_block_page_refcount", refcounts_ok(&td),
+          "count=%d blocked=%d aio=%d", td.r.count, td.r.blocked, td.r.aio);
+    td_pool_destroy(rp);
+    conf->block_page.len = 0;
+    conf->block_page.data = NULL;
+
+    /* 4. monitoring mode: attack detected but forwarded */
+    conf->mode = 1;
+    rp = td_pool_create();
+    td_request_init(&td, rp, conf, "GET",
+                    "/q?a=1'+union+select+password+from+users--",
+                    "192.0.2.10");
+    td_add_header_in(&td, "Host", "shop.example.com");
+    td_add_header_in(&td, "User-Agent", "Mozilla/5.0 (X11; Linux) Chrome");
+    run_request(&td, 15000);
+    CHECK("monitoring_forwards", td.done && td.final_status == 200,
+          "done=%d status=%d", td.done, td.final_status);
+    CHECK("monitoring_forwards_refcount", refcounts_ok(&td),
+          "count=%d blocked=%d aio=%d", td.r.count, td.r.blocked, td.r.aio);
+    td_pool_destroy(rp);
+    conf->mode = 2;
+
+    /* 5. fail-open: serve loop unreachable → pass + marker header */
+    {
+        ngx_str_t saved = conf->socket_path;
+        ngx_str_set(&conf->socket_path, "/nonexistent/ipt.sock");
+        rp = td_pool_create();
+        td_request_init(&td, rp, conf, "GET", "/x", "192.0.2.10");
+        td_add_header_in(&td, "Host", "shop.example.com");
+        run_request(&td, 15000);
+        CHECK("fail_open_pass",
+              td.done && td.final_status == 200
+              && td_find_header_out(&td, "X-Detect-TPU", "fail-open"),
+              "done=%d status=%d hdr=%d", td.done, td.final_status,
+              td_find_header_out(&td, "X-Detect-TPU", "fail-open"));
+        CHECK("fail_open_refcount", refcounts_ok(&td),
+              "count=%d blocked=%d aio=%d", td.r.count, td.r.blocked,
+              td.r.aio);
+        td_pool_destroy(rp);
+
+        /* 6. fail-closed: same outage, operator chose fail_open off */
+        conf->fail_open = 0;
+        rp = td_pool_create();
+        td_request_init(&td, rp, conf, "GET", "/x", "192.0.2.10");
+        td_add_header_in(&td, "Host", "shop.example.com");
+        run_request(&td, 15000);
+        CHECK("fail_closed_503", td.done && td.final_status == 503,
+              "done=%d status=%d", td.done, td.final_status);
+        CHECK("fail_closed_503_refcount", refcounts_ok(&td),
+          "count=%d blocked=%d aio=%d", td.r.count, td.r.blocked, td.r.aio);
+    td_pool_destroy(rp);
+        conf->fail_open = 1;
+        conf->socket_path = saved;
+    }
+
+    /* 7. no thread_pool block configured: fail-open DECLINED at entry 2 */
+    td_configure_thread_pool(NULL);
+    rp = td_pool_create();
+    td_request_init(&td, rp, conf, "GET", "/x", "192.0.2.10");
+    td_add_header_in(&td, "Host", "shop.example.com");
+    run_request(&td, 15000);
+    CHECK("no_thread_pool_fail_open", td.done && td.final_status == 200,
+          "done=%d status=%d", td.done, td.final_status);
+    CHECK("no_thread_pool_fail_open_refcount", refcounts_ok(&td),
+          "count=%d blocked=%d aio=%d", td.r.count, td.r.blocked, td.r.aio);
+    td_pool_destroy(rp);
+    td_configure_thread_pool("detect_tpu");
+
+    /* 8. safe_blocking (mode 3) + greylisted source: the serve-side ACL
+     * greylists 203.0.113.0/24; the module ships the connection address
+     * and must enforce the returned BLOCKED verdict under mode 3 */
+    conf->mode = 3;
+    rp = td_pool_create();
+    td_request_init(&td, rp, conf, "GET",
+                    "/q?a=1'+union+select+password+from+users--",
+                    "203.0.113.9");
+    td_add_header_in(&td, "Host", "shop.example.com");
+    td_add_header_in(&td, "User-Agent", "Mozilla/5.0 (X11; Linux) Chrome");
+    run_request(&td, 15000);
+    CHECK("safe_blocking_greylisted_403",
+          td.done && td.final_status == 403,
+          "done=%d status=%d", td.done, td.final_status);
+    CHECK("safe_blocking_greylisted_403_refcount", refcounts_ok(&td),
+          "count=%d blocked=%d aio=%d", td.r.count, td.r.blocked, td.r.aio);
+    td_pool_destroy(rp);
+
+    /* 9. safe_blocking, NON-greylisted source: monitored, forwarded */
+    rp = td_pool_create();
+    td_request_init(&td, rp, conf, "GET",
+                    "/q?a=1'+union+select+password+from+users--",
+                    "192.0.2.10");
+    td_add_header_in(&td, "Host", "shop.example.com");
+    td_add_header_in(&td, "User-Agent", "Mozilla/5.0 (X11; Linux) Chrome");
+    run_request(&td, 15000);
+    CHECK("safe_blocking_neutral_forwards",
+          td.done && td.final_status == 200,
+          "done=%d status=%d", td.done, td.final_status);
+    CHECK("safe_blocking_neutral_forwards_refcount", refcounts_ok(&td),
+          "count=%d blocked=%d aio=%d", td.r.count, td.r.blocked, td.r.aio);
+    td_pool_destroy(rp);
+    conf->mode = 2;
+
+    /* 10. client-ip spoof: the forged trusted header names a DENYLISTED
+     * ip; the module must strip it and ship the (neutral) connection
+     * address instead → request passes */
+    rp = td_pool_create();
+    td_request_init(&td, rp, conf, "GET", "/benign", "192.0.2.10");
+    td_add_header_in(&td, "Host", "shop.example.com");
+    td_add_header_in(&td, "User-Agent", "Mozilla/5.0 (X11; Linux) Chrome");
+    td_add_header_in(&td, "X-Detect-TPU-Client-IP", "10.66.66.66");
+    run_request(&td, 15000);
+    CHECK("client_ip_spoof_stripped", td.done && td.final_status == 200,
+          "done=%d status=%d", td.done, td.final_status);
+    CHECK("client_ip_spoof_stripped_refcount", refcounts_ok(&td),
+          "count=%d blocked=%d aio=%d", td.r.count, td.r.blocked, td.r.aio);
+    td_pool_destroy(rp);
+
+    /* 11. denied source address: serve ACL denies 10.66.66.0/24; with
+     * the REAL connection address in that range the verdict blocks */
+    rp = td_pool_create();
+    td_request_init(&td, rp, conf, "GET", "/benign", "10.66.66.66");
+    td_add_header_in(&td, "Host", "shop.example.com");
+    td_add_header_in(&td, "User-Agent", "Mozilla/5.0 (X11; Linux) Chrome");
+    run_request(&td, 15000);
+    CHECK("acl_denied_source_403", td.done && td.final_status == 403,
+          "done=%d status=%d", td.done, td.final_status);
+    CHECK("acl_denied_refcount", refcounts_ok(&td),
+          "count=%d blocked=%d aio=%d", td.r.count, td.r.blocked, td.r.aio);
+    td_pool_destroy(rp);
+
+    td_pool_destroy(setup.pool);
+    printf("%s\n", g_failures ? "HARNESS-FAIL" : "HARNESS-OK");
+    return g_failures ? 1 : 0;
+}

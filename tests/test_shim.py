@@ -146,3 +146,81 @@ def test_nginx_module_compiles():
         capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stdout + out.stderr
     assert obj.exists()
+
+
+HARNESS = REPO / "native" / "shim" / "shim_harness"
+
+HARNESS_RULES = """
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)union\\s+select" \
+    "id:942100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-sqli'"
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)<script" \
+    "id:941100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-xss'"
+"""
+
+
+@pytest.fixture(scope="module")
+def harness_stack(tmp_path_factory):
+    """Serve loop (block mode, ACLs pushed over the config plane) for the
+    nginx phase-machine harness — the module talks STRAIGHT to serve
+    (the shim's DetectClient speaks the same frame protocol as the
+    sidecar's upstream side)."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    subprocess.run(["make", "-s", "-C", str(REPO / "native" / "shim")],
+                   check=True)
+    tmp = tmp_path_factory.mktemp("harness")
+    rules_dir = tmp / "rules"
+    rules_dir.mkdir()
+    (rules_dir / "tiny.conf").write_text(HARNESS_RULES)
+    serve_sock = str(tmp / "serve.sock")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    serve = subprocess.Popen(
+        [sys.executable, "-m", "ingress_plus_tpu.serve",
+         "--socket", serve_sock, "--rules-dir", str(rules_dir),
+         "--platform", "cpu", "--max-delay-us", "1000", "--no-warmup",
+         "--http-port", "19907"],
+        cwd=str(REPO), env=env, stderr=subprocess.PIPE, text=True)
+    for _ in range(600):
+        if Path(serve_sock).exists():
+            try:
+                s = socket.socket(socket.AF_UNIX)
+                s.connect(serve_sock)
+                s.close()
+                break
+            except OSError:
+                pass
+        if serve.poll() is not None:
+            raise RuntimeError("server died: %s" % serve.stderr.read())
+        time.sleep(0.1)
+    # ACLs for the safe_blocking / deny / spoof scenarios
+    import urllib.request
+    req = urllib.request.Request(
+        "http://127.0.0.1:19907/configuration/acl",
+        data=json.dumps({
+            "acls": {"edge": {"greylist": ["203.0.113.0/24"],
+                              "deny": ["10.66.66.0/24"]}},
+            "default": "edge",
+        }).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    assert json.loads(urllib.request.urlopen(req, timeout=10).read())[
+        "acls"] == ["edge"]
+    yield serve_sock
+    serve.terminate()
+    serve.wait(timeout=10)
+
+
+def test_phase_state_machine_scenarios(harness_stack):
+    """VERDICT r03 item #5: execute the module's access-phase re-entry /
+    refcount / verdict machine under the nginx test double, against a
+    live serve loop.  13 checks across 11 scenarios: pass, 403,
+    block-page redirect, monitoring, fail-open (+marker header),
+    fail-closed 503, missing thread pool, safe_blocking greylist/neutral,
+    client-ip spoof stripping, ACL deny — with refcount invariants."""
+    out = subprocess.run([str(HARNESS), harness_stack],
+                         capture_output=True, text=True, timeout=120)
+    sys.stderr.write(out.stdout)
+    assert out.returncode == 0, out.stdout + out.stderr
+    lines = [l for l in out.stdout.splitlines() if l]
+    assert lines[-1] == "HARNESS-OK"
+    assert sum(1 for l in lines if l.startswith("ok ")) >= 20

@@ -85,6 +85,13 @@ def ruleset_fingerprint() -> dict:
         return {"error": (out.stderr or "")[-300:]}
 
 
+def _safe_fingerprint() -> dict:
+    try:
+        return ruleset_fingerprint()
+    except Exception as e:  # artifact must survive a fingerprint failure
+        return {"error": repr(e)[:300]}
+
+
 def run_bench(tag: str, extra_args: list[str], env_extra: dict,
               timeout_s: int = BENCH_TIMEOUT_S):
     env = dict(os.environ)
@@ -117,12 +124,23 @@ def main() -> None:
             time.sleep(SLEEP_BETWEEN_PROBES_S)
             continue
         log("probe %d: LIVE %s" % (attempt, info))
-        head = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
-                              capture_output=True, text=True,
-                              cwd=REPO).stdout.strip()
+        try:
+            head = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                                  capture_output=True, text=True,
+                                  cwd=REPO).stdout.strip()
+        except Exception as e:
+            head = "unknown"
+            log("git head lookup failed: %r" % (e,))
         stamp = datetime.datetime.utcnow().strftime("%Y%m%dT%H%M%S")
         base = os.path.join(REPORTS, "TPU_BENCH_%sZ_%s" % (stamp, head))
-        result, stderr, dt, rc = run_bench("tpu", [], {})
+        try:
+            result, stderr, dt, rc = run_bench("tpu", [], {})
+        except Exception as e:
+            # a mid-bench tunnel outage (incl. subprocess timeout) must
+            # not kill the hunt loop — that outage is WHY it exists
+            log("bench attempt failed: %r; continuing hunt" % (e,))
+            time.sleep(SLEEP_BETWEEN_PROBES_S)
+            continue
         with open(base + ".stderr.txt", "w") as f:
             f.write(stderr)
         artifact = {
@@ -132,7 +150,7 @@ def main() -> None:
             "bench_wall_s": round(dt, 1),
             "bench_rc": rc,
             "result": result,
-            "ruleset": ruleset_fingerprint(),
+            "ruleset": _safe_fingerprint(),
             "raw_stderr_file": os.path.relpath(base + ".stderr.txt", REPO),
             "method": ("bench.py end-to-end: probe ladder -> compile "
                        "bundled ruleset -> K-diff-timed state-chained "
