@@ -179,11 +179,15 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
 
     from ingress_plus_tpu.models.engine import detect_rows, map_match_words
 
-    scanner = None
+    scanner = scanner2 = None
     if platform != "cpu":
-        from ingress_plus_tpu.ops.pallas_scan import PallasScanner
+        from ingress_plus_tpu.ops.pallas_scan import (
+            PallasPairScanner,
+            PallasScanner,
+        )
 
         scanner = PallasScanner(tables.scan)
+        scanner2 = PallasPairScanner(tables.scan)
 
     def make_detect_k(impl: str):
         """K state-chained repetitions of the full multi-bucket batch for
@@ -212,6 +216,12 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
                     if impl == "pallas":
                         match, state = scanner(tok, lens, state=state,
                                                match=match)
+                        rule_hits, _, _ = map_match_words(
+                            tabs, match, rreq, rsv, n_req)
+                    elif impl == "pallas2":
+                        # pair-kernel contract: sticky match chains; the
+                        # dead-class-padded state is not a byte carry
+                        match, state = scanner2(tok, lens, match=match)
                         rule_hits, _, _ = map_match_words(
                             tabs, match, rreq, rsv, n_req)
                     elif impl == "pair":
@@ -243,13 +253,15 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
 
     log("backend: %s, devices: %s" % (jax.default_backend(), jax.devices()))
     global _HEADLINE
-    impls = ["take", "pair"] + (["pallas"] if scanner is not None else [])
+    impls = ["take", "pair"] + (
+        ["pallas", "pallas2"] if scanner is not None else [])
     only = [a.split("=", 1)[1] for a in sys.argv if a.startswith("--impl=")]
     if only:
-        bad = [i for i in only if i not in ("take", "pair", "pallas")]
+        bad = [i for i in only
+               if i not in ("take", "pair", "pallas", "pallas2")]
         if bad:
             raise SystemExit("unknown --impl value(s) %s (choose from "
-                             "take/pair/pallas)" % bad)
+                             "take/pair/pallas/pallas2)" % bad)
         impls = only
     impl_stats: dict = {}
     best_impl, best_rps = None, -1.0

@@ -80,21 +80,13 @@ class ScanTables:
             final_mask=jnp.asarray(t.final_mask, dtype=jnp.uint32),
         )
         if classes:
-            uniq, inv = np.unique(bt, axis=0, return_inverse=True)
-            inv = inv.ravel()  # numpy <2.0 returns (256, 1) with axis=0
-            k = uniq.shape[0]
-            T = np.vstack([uniq, np.zeros((1, bt.shape[1]), np.uint32)])
-            byte_class = np.concatenate(
-                [inv.astype(np.int32), np.asarray([k], np.int32)])
-            init = t.init_mask.astype(np.uint32)[None, None, :]
-            pair = ((T[:, None, :] << np.uint32(1)) | init) & T[None, :, :]
+            byte_class, T, pair_reach, pair_final, k = \
+                build_class_pair_tables(bt, t.init_mask, t.final_mask)
             fields.update(
                 byte_class=jnp.asarray(byte_class),
                 class_table=jnp.asarray(T),
-                pair_reach=jnp.asarray(
-                    pair.reshape((k + 1) * (k + 1), -1)),
-                pair_final=jnp.asarray(
-                    T & t.final_mask.astype(np.uint32)[None, :]),
+                pair_reach=jnp.asarray(pair_reach),
+                pair_final=jnp.asarray(pair_final),
             )
         return cls(**fields)
 
@@ -115,6 +107,57 @@ class ScanTables:
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(*children)
+
+
+def build_class_pair_tables(byte_table: np.ndarray, init_mask: np.ndarray,
+                            final_mask: np.ndarray,
+                            k_pad: Optional[int] = None,
+                            uniq_inv=None):
+    """Byte-class compression + folded pair recurrence tables — the ONE
+    construction shared by the single-chip tables (ScanTables.from_bitap)
+    and the per-shard sharded tables (parallel/shard.py), so the
+    recurrence can never diverge between paths (round-4 review).
+
+    Returns (byte_class (257,), class_table (K+1, W), pair_reach
+    ((K+1)^2, W), pair_final (K+1, W), k) as numpy; the DEAD class (zero
+    reach) sits at index K = ``k_pad or k`` and byte_class[256] maps to
+    it.  ``k_pad`` ≥ k pads the class axis (sharded paths need a uniform
+    K across shards); padding rows keep all-zero reach.  ``uniq_inv``
+    lets a caller that already ran the axis-0 unique (the sharded k_max
+    pre-pass) hand the (uniq, inv) pair in instead of paying it twice."""
+    bt = byte_table.astype(np.uint32)
+    if uniq_inv is None:
+        uniq, inv = np.unique(bt, axis=0, return_inverse=True)
+    else:
+        uniq, inv = uniq_inv
+    inv = np.asarray(inv).ravel()  # numpy <2.0 returns (256, 1), axis=0
+    k = int(uniq.shape[0])
+    K = k_pad if k_pad is not None else k
+    if K < k:
+        raise ValueError("k_pad=%d < actual class count %d" % (K, k))
+    T = np.zeros((K + 1, bt.shape[1]), np.uint32)
+    T[:k] = uniq
+    byte_class = np.concatenate(
+        [inv.astype(np.int32), np.asarray([K], np.int32)])
+    init = init_mask.astype(np.uint32)[None, None, :]
+    pair = ((T[:, None, :] << np.uint32(1)) | init) & T[None, :, :]
+    pair_reach = pair.reshape((K + 1) * (K + 1), -1)
+    pair_final = T & final_mask.astype(np.uint32)[None, :]
+    return byte_class, T, pair_reach, pair_final, k
+
+
+def classes_for(byte_class: jax.Array, tokens: jax.Array,
+                lengths: jax.Array) -> jax.Array:
+    """(B, L) byte rows → (B, L) class ids with padding (pos ≥ length)
+    mapped to the DEAD class via the 256 sentinel — the one byte→class
+    mapping shared by scan_pairs and the Pallas pair kernel so the
+    dead-class convention cannot diverge between them (round-4 review)."""
+    L = tokens.shape[1]
+    toks = jnp.where(
+        jnp.arange(L, dtype=jnp.int32)[None, :]
+        < lengths.astype(jnp.int32)[:, None],
+        jnp.asarray(tokens).astype(jnp.int32), jnp.int32(256))
+    return jnp.take(byte_class, toks, axis=0).astype(jnp.int32)
 
 
 def _reach_take(tables: ScanTables, bytes_t: jax.Array) -> jax.Array:
@@ -221,10 +264,7 @@ def scan_pairs(
 
     # byte → class, with padding mapped to the dead class (reach 0): the
     # scan needs no per-step validity selects at all
-    toks = jnp.where(
-        jnp.arange(L, dtype=jnp.int32)[None, :] < lengths.astype(jnp.int32)[:, None],
-        tokens.astype(jnp.int32), jnp.int32(256))
-    cls = jnp.take(tables.byte_class, toks, axis=0)       # (B, L)
+    cls = classes_for(tables.byte_class, tokens, lengths)  # (B, L)
     c1 = jnp.transpose(cls[:, 0::2])                      # (L/2, B)
     c2 = jnp.transpose(cls[:, 1::2])
     pair_idx = c1 * jnp.int32(k1) + c2

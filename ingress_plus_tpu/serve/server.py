@@ -622,7 +622,7 @@ def main(argv=None) -> None:
                          "latency-sensitive serving may prefer cpu")
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--scan-impl", default="auto",
-                    choices=["auto", "pair", "take", "pallas"],
+                    choices=["auto", "pair", "take", "pallas", "pallas2"],
                     help="TPU scan implementation; auto = startup "
                          "microbench on the live backend picks the "
                          "fastest (pallas excluded on cpu)")

@@ -79,3 +79,105 @@ def test_streaming_carry_chunks(tables):
     m2, _ = pallas_scan_bytes(tables, tb, lb, state=s1, match=m1,
                               interpret=True)
     np.testing.assert_array_equal(np.asarray(m2), np.asarray(want_m))
+
+
+# ---------------------------------------------- class-pair kernel (round 4)
+
+def test_pallas_pair_matches_reference(tables):
+    """Bit-for-bit: the class-pair Pallas kernel's match mask equals the
+    XLA byte scan on mixed-length rows (interpret mode on CPU — the
+    fake-backend tier)."""
+    from ingress_plus_tpu.ops.pallas_scan import PallasPairScanner
+
+    rows = _mixed_rows(13)
+    tokens, lengths = pad_rows(rows)
+    want_m, _ = scan_bytes(tables, tokens, lengths)
+    ps = PallasPairScanner(tables, TB=8, CL=16, MR=8)
+    got_m, _ = ps(tokens, lengths, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_m), np.asarray(want_m))
+
+
+def test_pallas_pair_sticky_match_chaining(tables):
+    """Chained calls must accumulate the sticky match exactly like the
+    serving K-rep contract."""
+    from ingress_plus_tpu.ops.pallas_scan import PallasPairScanner
+
+    rows = _mixed_rows(9, seed=3)
+    tokens, lengths = pad_rows(rows, round_to=64)
+    want_m, _ = scan_bytes(tables, tokens, lengths)
+    ps = PallasPairScanner(tables, TB=8, CL=16, MR=8)
+    m1, _ = ps(tokens, lengths, interpret=True)
+    m2, _ = ps(tokens, lengths, match=m1, interpret=True)
+    np.testing.assert_array_equal(np.asarray(m2), np.asarray(want_m))
+
+
+def test_pallas_pair_odd_lengths_and_empty(tables):
+    """Odd-length rows end on the pair's FIRST byte (the FA1 collection
+    path); empty rows must scan clean."""
+    from ingress_plus_tpu.ops.pallas_scan import PallasPairScanner
+
+    rows = [b"", b"x", b"1 union select 2", b"a" * 701,
+            b"; cat /etc/hosts!"]
+    tokens, lengths = pad_rows(rows, round_to=64)
+    odd = np.asarray([0, 1, 15, 701, 17], np.int32)
+    want_m, _ = scan_bytes(tables, tokens, odd)
+    ps = PallasPairScanner(tables, TB=8, CL=16, MR=8)
+    got_m, _ = ps(tokens, odd, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_m), np.asarray(want_m))
+
+
+def test_pallas_pair_multi_chunk_double_buffer(tables):
+    """Rows spanning many CL-chunks exercise the double-buffered
+    prefetch: chunk k+1's reach must land in the OTHER buffer than the
+    one chunk k's chain is reading."""
+    from ingress_plus_tpu.ops.pallas_scan import PallasPairScanner
+
+    rng = np.random.default_rng(11)
+    long = bytes(rng.integers(32, 127, size=900))
+    rows = [long[:813] + b"1 union select password from users" + long[:77],
+            long, b"short ; cat /etc/hosts", long[:500]]
+    tokens, lengths = pad_rows(rows, round_to=64)
+    want_m, _ = scan_bytes(tables, tokens, lengths)
+    ps = PallasPairScanner(tables, TB=8, CL=16, MR=8)
+    got_m, _ = ps(tokens, lengths, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_m), np.asarray(want_m))
+
+
+def test_pallas_pair_odd_remainder_stale_scratch(tables):
+    """Round-4 review repro: when the tile's remaining length is odd, the
+    chain's last pair reads the PADDING position's reach row — stage1
+    must compute it (all-zero dead class), not leave two-chunks-stale
+    scratch behind it.  49-byte row, 'd' planted at the same in-chunk
+    offset two chunks before a '/etc/passw' tail."""
+    from ingress_plus_tpu.ops.pallas_scan import PallasPairScanner
+
+    row = bytearray(b"a" * 49)
+    row[17] = ord("d")
+    row[39:49] = b"/etc/passw"
+    tokens, lengths = pad_rows([bytes(row)], round_to=64)
+    want_m, _ = scan_bytes(tables, tokens, lengths)
+    ps = PallasPairScanner(tables, TB=8, CL=16, MR=8)
+    got_m, _ = ps(tokens, lengths, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_m), np.asarray(want_m))
+
+
+def test_sharded_pair_odd_length_padded():
+    """ShardedEngine(pair) must accept odd-L host batches (one dead-class
+    padding column, the pre-pair contract)."""
+    from ingress_plus_tpu.parallel import ShardedEngine, make_mesh
+
+    cr = compile_ruleset(parse_seclang(RULES))
+    mesh = make_mesh(n_data=2, n_model=4)
+    eng = ShardedEngine(cr, mesh, scan_impl="pair")
+    row = b"q=1 union  select password from users"
+    tokens, lengths = pad_rows([row], round_to=64)
+    tokens = np.asarray(tokens)[:, :63]          # force odd L
+    lengths = np.minimum(np.asarray(lengths), 63)
+    from ingress_plus_tpu.compiler.ruleset import N_SV
+    tokens = np.repeat(tokens, 2, axis=0)        # one row per data shard
+    lengths = np.repeat(lengths, 2)
+    sv = np.ones((2, N_SV), np.int8)
+    rh, ch, sc = eng.detect(tokens, lengths,
+                            np.zeros((2,), np.int32), sv,
+                            np.zeros((2,), np.int32), 2)
+    assert rh[0].any()

@@ -136,3 +136,24 @@ def test_sp_boundary_attack_detected(ruleset):
     want = reference_scan(ruleset.tables, tokens[0].astype(np.uint8).tobytes())
     assert want.any()
     assert (merged[0][: want.shape[0]] == want).all()
+
+
+def test_tp_scan_impl_parity_and_autoselect(ruleset):
+    """Round-4 (VERDICT item #7): the sharded step must produce identical
+    verdicts under the pair-stride and gather scans, and autoselect must
+    measure both and install a valid winner."""
+    mesh = make_mesh(n_data=2, n_model=4)
+    eng = ShardedEngine(ruleset, mesh, scan_impl="take")
+    tokens, lengths, row_req, row_sv = _mk_batch(ruleset)
+    tenants = np.zeros((8,), np.int32)
+    out_take = eng.detect(tokens, lengths, row_req, row_sv, tenants, 8)
+    eng.set_scan_impl("pair")
+    out_pair = eng.detect(tokens, lengths, row_req, row_sv, tenants, 8)
+    for a, b in zip(out_take, out_pair):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    best = eng.autoselect_scan_impl(B=32, L=128, iters=3)
+    assert best in ("pair", "take")
+    assert eng.scan_impl == best
+    out_best = eng.detect(tokens, lengths, row_req, row_sv, tenants, 8)
+    for a, b in zip(out_take, out_best):
+        assert (np.asarray(a) == np.asarray(b)).all()
