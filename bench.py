@@ -186,8 +186,16 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
             PallasScanner,
         )
 
-        scanner = PallasScanner(tables.scan)
-        scanner2 = PallasPairScanner(tables.scan)
+        # constructor failures must not kill the whole capture — a TPU
+        # window may be the only one the round gets (tpu_hunt)
+        try:
+            scanner = PallasScanner(tables.scan)
+        except Exception as e:
+            log("PallasScanner unavailable (non-fatal): %r" % e)
+        try:
+            scanner2 = PallasPairScanner(tables.scan)
+        except Exception as e:
+            log("PallasPairScanner unavailable (non-fatal): %r" % e)
 
     def make_detect_k(impl: str):
         """K state-chained repetitions of the full multi-bucket batch for
@@ -253,8 +261,13 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
 
     log("backend: %s, devices: %s" % (jax.default_backend(), jax.devices()))
     global _HEADLINE
-    impls = ["take", "pair"] + (
-        ["pallas", "pallas2"] if scanner is not None else [])
+    # measured-winner-first ordering (pair won r01-r03 on BOTH platforms):
+    # if the watchdog fires mid-loop the stashed best-so-far is already
+    # the likely champion, not the warm-up act
+    impls = (["pair"]
+             + (["pallas2"] if scanner2 is not None else [])
+             + (["pallas"] if scanner is not None else [])
+             + ["take"])
     only = [a.split("=", 1)[1] for a in sys.argv if a.startswith("--impl=")]
     if only:
         bad = [i for i in only
@@ -274,14 +287,42 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
                     lambda kk, rep: detect_k(kk, tables, device_buckets),
                     k, n=3)
 
-            it = iters
-            d_lo, d_hi = timed(1), timed(it)
-            while d_hi - d_lo < 0.2 and it < 2048:  # dwarf RTT jitter
+            d_lo = timed(1)
+            # size K against the time actually left: timed(k) costs about
+            # 4*(overhead + k*marginal) (warm + best-of-3), and later
+            # impls plus the latency/quality legs still need room — spend
+            # at most ~30% of the remaining budget here.  d_lo is an
+            # OVERESTIMATE of the marginal cost (it includes dispatch/RTT
+            # overhead), safe for the initial sizing only; the widening
+            # guard below must use the measured marginal or a
+            # tunnel-dominated d_lo (~70ms RTT, ~0.5ms compute) blocks
+            # widening 100x too early
+            pb_est = max(d_lo, 1e-4)
+            share = max(15.0, _budget_left() * 0.30)
+            it = max(2, min(iters, int(share / (4 * pb_est))))
+            d_hi = timed(it)
+            marginal = max((d_hi - d_lo) / (it - 1), 1e-6)
+            while (d_hi - d_lo < 0.2 and it < 2048     # dwarf RTT jitter
+                   and 4 * d_lo + 16 * it * marginal
+                   < _budget_left() * 0.5):
                 it *= 4
                 log("[%s] widening K to %d (diff %.1f ms too small)"
                     % (impl, it, (d_hi - d_lo) * 1e3))
                 d_hi = timed(it)
-            per_batch = (d_hi - d_lo) / (it - 1)
+                marginal = max((d_hi - d_lo) / (it - 1), 1e-6)
+            delta = d_hi - d_lo
+            if delta <= 0.05:
+                # RTT jitter swamps the compute delta (microbench
+                # k_diff_time contract: <=0 delta is NO SIGNAL, never a
+                # throughput) — record nothing rather than noise
+                impl_stats[impl] = 0.0
+                log("[%s] no signal (delta %.1f ms at K=%d, budget-"
+                    "bounded); skipping" % (impl, delta * 1e3, it))
+                continue
+            if delta < 0.2:
+                log("[%s] WARNING: thin signal (delta %.1f ms at K=%d); "
+                    "number is noisier than usual" % (impl, delta * 1e3, it))
+            per_batch = delta / (it - 1)
             rps = n_req / per_batch
             mbs = total_bytes / per_batch / 1e6
             impl_stats[impl] = round(rps, 1)
@@ -305,6 +346,12 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
                 "platform": platform,
                 "scan_impl": impl,
                 "impls": impl_stats,
+                # cross-round auditability: r04 grew the pack 1405 -> 2002
+                # rules (343 -> 533 scan words), so CPU-fallback numbers
+                # are not comparable to r03's without these
+                "ruleset": {"rules": int(cr.n_rules),
+                            "factors": int(cr.tables.n_factors),
+                            "words": int(cr.tables.n_words)},
             }
             if backend_err:
                 result["error"] = backend_err
@@ -629,6 +676,7 @@ _EMITTED = False
 _PLATFORM_USED = None
 _HEADLINE = None  # measured result stashed before the diagnostics tail
 _WATCHDOG_TIMER = None
+_WATCHDOG_ARMED_AT = None
 _WATCHDOG_BUDGET = float(os.environ.get("BENCH_WATCHDOG_S", "540"))
 
 
@@ -661,12 +709,23 @@ def _arm_watchdog() -> None:
     """(Re)start the deadline clock.  Re-armed after the probe so its
     worst case (~3min of subprocess timeouts) doesn't eat the budget of
     a healthy fallback measurement."""
-    global _WATCHDOG_TIMER
+    global _WATCHDOG_TIMER, _WATCHDOG_ARMED_AT
     if _WATCHDOG_TIMER is not None:
         _WATCHDOG_TIMER.cancel()
+    _WATCHDOG_ARMED_AT = time.time()
     _WATCHDOG_TIMER = threading.Timer(_WATCHDOG_BUDGET, _watchdog_fire)
     _WATCHDOG_TIMER.daemon = True
     _WATCHDOG_TIMER.start()
+
+
+def _budget_left() -> float:
+    """Seconds until the watchdog fires — the measurement loop sizes its
+    iteration counts against this so a slow platform (2k-rule pack on
+    the 1-core CPU fallback: >1.3s/batch) still measures EVERY impl
+    instead of blowing the whole budget on the first one."""
+    if _WATCHDOG_ARMED_AT is None:
+        return _WATCHDOG_BUDGET
+    return max(0.0, _WATCHDOG_BUDGET - (time.time() - _WATCHDOG_ARMED_AT))
 
 
 def _fallback_result(err: str) -> dict:
