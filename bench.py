@@ -53,7 +53,7 @@ def probe_backend(timeouts=(60, 90, 120, 120), waits=(30, 45, 60)):
     Returns (platform, error_string_or_None) and, on TPU failure, forces
     the parent's platform to CPU so the bench still produces a number.
     """
-    import subprocess
+    from ingress_plus_tpu.utils.platform import probe_backend_once
 
     # the ladder's worst case (525s) nearly fills the 540s budget, and
     # jax + module imports already ran inside the armed window — re-arm
@@ -71,24 +71,13 @@ def probe_backend(timeouts=(60, 90, 120, 120), waits=(30, 45, 60)):
             log("TPU probe retry %d/%d in %ds (last: %s)"
                 % (attempt, len(timeouts) - 1, wait, last_err[:200]))
             time.sleep(wait)
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; d = jax.devices(); "
-                 "print('PLATFORM=' + d[0].platform)"],
-                capture_output=True, text=True, timeout=tmo)
-        except subprocess.TimeoutExpired:
-            last_err = "backend init hung >%ds" % tmo
-            continue
-        out = proc.stdout.strip().splitlines()
-        plat = next((l.split("=", 1)[1] for l in out
-                     if l.startswith("PLATFORM=")), None)
-        if proc.returncode == 0 and plat:
+        plat, err = probe_backend_once(tmo)
+        if plat is not None:
             if plat == "cpu":
                 return "cpu", None  # no TPU plugin on this machine at all
             log("TPU probe ok (%s, %.0fs timeout headroom)" % (plat, tmo))
             return plat, None
-        last_err = (proc.stderr.strip().splitlines() or ["rc=%d" % proc.returncode])[-1]
+        last_err = err
     log("TPU backend unavailable; falling back to CPU (last: %s)" % last_err[:300])
     from ingress_plus_tpu.utils.platform import force_cpu_devices
 
@@ -496,12 +485,16 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
         try:
             import subprocess
 
-            env = dict(os.environ)
-            env["JAX_PLATFORMS"] = "cpu"
+            # env inherited as-is: latency_only_main forces CPU
+            # in-process (force_cpu_devices), which wins over the env.
+            # Do NOT set JAX_PLATFORMS=cpu here — with the axon PJRT
+            # plugin registered by sitecustomize, the ENV-var path still
+            # initializes the plugin during backend discovery and hangs
+            # when the tunnel is down (observed r04).
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
                  "--latency-only"],
-                capture_output=True, text=True, timeout=300, env=env)
+                capture_output=True, text=True, timeout=300)
             sys.stderr.write(out.stderr[-2000:])
             if out.returncode == 0 and out.stdout.strip():
                 local = json.loads(out.stdout.strip().splitlines()[-1])

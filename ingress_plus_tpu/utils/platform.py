@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import re
+from typing import Optional
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
@@ -38,6 +39,73 @@ def force_cpu_devices(n_devices: int = 8) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def probe_backend_once(timeout: int = 60):
+    """``jax.devices()`` in a THROWAWAY SUBPROCESS under a hard timeout.
+
+    Returns ``(platform, None)`` on success or ``(None, error_string)``.
+    The ONE subprocess-probe primitive (bench.py's retry ladder and
+    __graft_entry__'s single-shot guard both build on this — the recipe
+    was hand-rolled per call site in earlier rounds and the un-shared
+    copies diverged; see the module docstring's round-1 postmortem).
+
+    Why a subprocess: the remote-TPU 'axon' backend has two observed
+    failure modes — fail fast at first dispatch, and hang indefinitely
+    during client init — and an in-process try cannot recover from the
+    hang.  Setting ``JAX_PLATFORMS=cpu`` in the ENV does not avoid it
+    either: backend discovery still initializes the registered plugin
+    (observed r04); only the in-process config override does.
+    """
+    import subprocess
+    import sys
+
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, "backend init hung >%ds" % timeout
+    out = [l for l in p.stdout.strip().splitlines()
+           if l.startswith("PLATFORM=")]
+    if p.returncode == 0 and out:
+        return out[-1].split("=", 1)[1], None
+    err = (p.stderr.strip().splitlines() or ["rc=%d" % p.returncode])[-1]
+    return None, err[:300]
+
+
+def ensure_live_backend(timeout: int = 60,
+                        fallback_devices: Optional[int] = None) -> None:
+    """Guard the first in-process backend touch: probe the default
+    backend via :func:`probe_backend_once` and force CPU if it is
+    down/hung.  No-op (no subprocess spawned) when this process is
+    already pinned to CPU.
+
+    ``fallback_devices``: virtual CPU device count to pin on fallback.
+    Defaults to an ``--xla_force_host_platform_device_count`` already in
+    ``XLA_FLAGS`` (a driver-set count must survive — forcing 1 here
+    would poison a later same-process ``dryrun_multichip(n)``), else 8,
+    which keeps every later ``force_cpu_devices(n <= 8)``-sized mesh
+    buildable in this process.
+    """
+    import sys
+
+    import jax
+
+    if jax.config.jax_platforms == "cpu":
+        return  # already pinned in-process — nothing to probe
+    plat, err = probe_backend_once(timeout)
+    if plat is not None:
+        return  # live backend — leave it alone
+    if fallback_devices is None:
+        m = re.search(re.escape(_COUNT_FLAG) + r"=(\d+)",
+                      os.environ.get("XLA_FLAGS", ""))
+        fallback_devices = int(m.group(1)) if m else 8
+    print("[platform] default backend unavailable (%s); forcing %d "
+          "virtual CPU device(s)" % (err, fallback_devices),
+          file=sys.stderr)
+    force_cpu_devices(fallback_devices)
 
 
 def assert_cpu_devices(n_devices: int) -> None:
