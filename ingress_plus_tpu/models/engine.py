@@ -277,7 +277,10 @@ class DetectionEngine:
         from ingress_plus_tpu.utils.microbench import k_diff_time
 
         if include_pallas is None:
-            include_pallas = jax.default_backend() != "cpu"
+            # Mosaic kernels: TPU platforms only ("axon" = this rig's
+            # remote-TPU PJRT plugin); a GPU backend would crash the
+            # bake-off at compile, not lose it
+            include_pallas = jax.default_backend() in ("tpu", "axon")
         candidates = ["pair", "take"] + (
             ["pallas", "pallas2"] if include_pallas else [])
         rng = np.random.default_rng(7)

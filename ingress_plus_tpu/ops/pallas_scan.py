@@ -416,6 +416,43 @@ def _pallas_pair_scan(cls_tokens, lengths, planes, init, final, state,
     return out_m, out_s
 
 
+def check_pair_tiling(TB: int, CL: int, MR: int) -> int:
+    """Validate the (TB, CL, MR) tile config; returns the clamped MR."""
+    MR = min(MR, CL * TB)
+    if TB % 8 or CL % 2 or (CL * TB) % MR or MR % TB:
+        raise ValueError(
+            "invalid tiling: need TB %% 8 == 0, CL even, MR %% TB == 0 "
+            "and (CL*TB) %% MR == 0; got TB=%d CL=%d MR=%d"
+            % (TB, CL, MR))
+    return MR
+
+
+def pack_pair_tables(class_table: np.ndarray, init_mask: np.ndarray,
+                     final_mask: np.ndarray):
+    """Pad + plane-split class tables into the _pallas_pair_scan input
+    layout — the ONE packing shared by PallasPairScanner (single chip)
+    and ShardedEngine's per-shard pallas2 path.
+
+    class_table (K1, W) uint32 with the DEAD class (all-zero reach)
+    LAST; init/final (W,) uint32.  Returns (planes (K1p, 4*Wp) float32
+    — byte planes of the uint32 words, exact in bf16 since every value
+    <= 255; init (1, Wp) int32; final (1, Wp) int32; K1p; Wp), padded to
+    the kernel's 128-lane tiles with all-zero (dead) rows."""
+    K1, W = class_table.shape
+    Wp = _round_up(max(W, 128), 128)
+    K1p = _round_up(max(K1, 128), 128)
+    ct = np.zeros((K1p, Wp), np.uint32)
+    ct[:K1, :W] = np.asarray(class_table)
+    planes = np.concatenate(
+        [((ct >> (8 * j)) & 0xFF).astype(np.float32) for j in range(4)],
+        axis=1)
+    init = np.zeros((1, Wp), np.int32)
+    init[0, :W] = np.asarray(init_mask).view(np.int32)
+    final = np.zeros((1, Wp), np.int32)
+    final[0, :W] = np.asarray(final_mask).view(np.int32)
+    return planes, init, final, K1p, Wp
+
+
 class PallasPairScanner:
     """Class-pair Pallas kernel with cached packed tables.
 
@@ -427,26 +464,12 @@ class PallasPairScanner:
         if tables.byte_class is None:
             raise ValueError("tables built without byte classes")
         W = tables.n_words
-        Wp = _round_up(max(W, 128), 128)
-        K1 = int(tables.class_table.shape[0])      # real classes + dead
-        K1p = _round_up(max(K1, 128), 128)
+        planes, init, final, K1p, Wp = pack_pair_tables(
+            np.asarray(tables.class_table), np.asarray(tables.init_mask),
+            np.asarray(tables.final_mask))
         self.W, self.Wp, self.TB, self.CL, self.K1p = W, Wp, TB, CL, K1p
-        self.MR = min(MR, CL * TB)
-        if TB % 8 or CL % 2 or (CL * TB) % self.MR or self.MR % TB:
-            raise ValueError(
-                "invalid tiling: need TB %% 8 == 0, CL even, MR %% TB == 0 "
-                "and (CL*TB) %% MR == 0; got TB=%d CL=%d MR=%d"
-                % (TB, CL, self.MR))
-        ct = np.zeros((K1p, Wp), np.uint32)
-        ct[:K1, :W] = np.asarray(tables.class_table)
-        # dead padding classes (K1..K1p) keep all-zero reach rows
-        self.planes = jnp.asarray(np.concatenate(
-            [((ct >> (8 * j)) & 0xFF).astype(np.float32) for j in range(4)],
-            axis=1), jnp.bfloat16)
-        init = np.zeros((1, Wp), np.int32)
-        init[0, :W] = np.asarray(tables.init_mask).view(np.int32)
-        final = np.zeros((1, Wp), np.int32)
-        final[0, :W] = np.asarray(tables.final_mask).view(np.int32)
+        self.MR = check_pair_tiling(TB, CL, MR)
+        self.planes = jnp.asarray(planes, jnp.bfloat16)
         self.init, self.final = jnp.asarray(init), jnp.asarray(final)
         self.byte_class = tables.byte_class        # (257,) int32
         self.dead = int(tables.class_table.shape[0]) - 1

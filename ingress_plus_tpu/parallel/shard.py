@@ -27,8 +27,15 @@ from jax import shard_map
 
 from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, N_SV
 from ingress_plus_tpu.compiler.seclang import CLASSES
+from ingress_plus_tpu.ops.pallas_scan import (
+    _pallas_pair_scan,
+    _round_up,
+    check_pair_tiling,
+    pack_pair_tables,
+)
 from ingress_plus_tpu.ops.scan import (
     build_class_pair_tables,
+    classes_for,
     scan_bytes,
     scan_pairs,
 )
@@ -145,6 +152,12 @@ class ShardedEngine:
     shared superset NFA (benchmark config #4: 256 Ingress tenants).
     """
 
+    #: "pair"    = class-pair stride via XLA (single-chip bake-off winner)
+    #: "take"    = one-gather-per-byte fallback
+    #: "pallas2" = the class-pair Pallas kernel, run per ruleset shard
+    #:             inside shard_map on that shard's packed tables
+    SCAN_IMPLS = ("pair", "take", "pallas2")
+
     def __init__(self, cr: CompiledRuleset, mesh: Mesh,
                  tenant_rule_mask: np.ndarray | None = None,
                  scan_impl: str = "pair"):
@@ -155,9 +168,14 @@ class ShardedEngine:
         if tenant_rule_mask is None:
             tenant_rule_mask = np.ones((1, max(cr.n_rules, 1)), bool)
         self.tenant_mask = tenant_rule_mask.astype(np.float32)
-        if scan_impl not in ("pair", "take"):
-            raise ValueError("sharded scan_impl must be 'pair' or 'take'")
+        if scan_impl not in self.SCAN_IMPLS:
+            raise ValueError("sharded scan_impl must be one of %s"
+                             % (self.SCAN_IMPLS,))
         self.scan_impl = scan_impl
+        # pallas2 tile config + interpret knob (tests force True on CPU)
+        self.p2_TB, self.p2_CL = 64, 16
+        self.p2_MR = check_pair_tiling(self.p2_TB, self.p2_CL, 256)
+        self.pallas_interpret = False
 
         def put(arr, spec):
             return jax.device_put(arr, NamedSharding(mesh, spec))
@@ -179,34 +197,60 @@ class ShardedEngine:
         self.d_ctab = put(st.class_table, P("model", None, None))
         self.d_preach = put(st.pair_reach, P("model", None, None))
         self.d_pfinal = put(st.pair_final, P("model", None, None))
+        # pallas2: per-shard tables packed into the kernel layout (ONE
+        # packing — ops/pallas_scan.pack_pair_tables — shared with the
+        # single-chip scanner).  Shapes are uniform across shards because
+        # every shard pads classes to k_max and words to w_shard.
+        self.p2_Wp = _round_up(max(st.w_shard, 128), 128)
+        planes_l, pinit_l, pfinal_l = [], [], []
+        for s in range(n_model):
+            sl = slice(s * st.w_shard, (s + 1) * st.w_shard)
+            pls, ini, fin, _K1p, _Wp = pack_pair_tables(
+                st.class_table[s], st.init_mask[sl], st.final_mask[sl])
+            planes_l.append(pls)
+            pinit_l.append(ini)
+            pfinal_l.append(fin)
+        self.d_p2planes = put(jnp.asarray(np.stack(planes_l), jnp.bfloat16),
+                              P("model", None, None))
+        self.d_p2init = put(np.stack(pinit_l), P("model", None, None))
+        self.d_p2final = put(np.stack(pfinal_l), P("model", None, None))
         self._steps = {}
         self._step = self._build_step(self.scan_impl)
 
     def set_scan_impl(self, scan_impl: str) -> None:
         """Switch the sharded scan implementation (compiled steps are
         cached per impl)."""
-        if scan_impl not in ("pair", "take"):
-            raise ValueError("sharded scan_impl must be 'pair' or 'take'")
+        if scan_impl not in self.SCAN_IMPLS:
+            raise ValueError("sharded scan_impl must be one of %s"
+                             % (self.SCAN_IMPLS,))
         self.scan_impl = scan_impl
         self._step = self._build_step(scan_impl)
 
     def _build_step(self, scan_impl: str):
-        if scan_impl in self._steps:
-            return self._steps[scan_impl]
+        key = (scan_impl, self.pallas_interpret)
+        if key in self._steps:
+            return self._steps[key]
         mesh = self.mesh
+        TB, CL, MR = self.p2_TB, self.p2_CL, self.p2_MR
+        Wp = self.p2_Wp
+        k_max = self.st.k_max
+        interpret = self.pallas_interpret
 
         def block(byte_table, init, final, bcls, ctab, preach, pfinal,
+                  p2planes, p2init, p2final,
                   fw, fb, fr, rule_sv, score,
                   cls_map, nopf, tenant_mask, tokens, lengths, row_req,
                   row_sv, tenants, num_requests):
             # shapes inside the block are per-device slices:
             # byte_table (256, w_shard); fw/fb (1, f_max); fr (1, f_max, R)
             fw, fb, fr = fw[0], fb[0], fr[0]
+            w_shard = byte_table.shape[1]
 
             # word-local scan — ZERO communication.  "pair" runs the
             # single-chip bake-off winner (class-pair stride: one reach
             # gather per TWO bytes) on this shard's own class tables;
-            # "take" is the one-gather-per-byte fallback.
+            # "pallas2" runs the hand kernel on the same per-shard
+            # tables; "take" is the one-gather-per-byte fallback.
             class _T:  # minimal ScanTables duck-type for the scan kernels
                 n_words = byte_table.shape[1]
             t = _T()
@@ -218,6 +262,24 @@ class ShardedEngine:
                 t.pair_reach = preach[0]
                 t.pair_final = pfinal[0]
                 match, _ = scan_pairs(t, tokens, lengths)
+            elif scan_impl == "pallas2":
+                cls = classes_for(bcls[0], tokens, lengths)   # (B_s, L)
+                B_s, L = cls.shape
+                Bp = -(-max(B_s, TB) // TB) * TB
+                Lp = -(-max(L, CL) // CL) * CL
+                # dead class (zero reach) = index k_max; padding rows
+                # and columns die immediately, like scan_pairs
+                cls_p = jnp.full((Bp, Lp), k_max, jnp.int32)
+                cls_p = cls_p.at[:B_s, :L].set(cls)
+                len_p = jnp.zeros((Bp, 1), jnp.int32)
+                len_p = len_p.at[:B_s, 0].set(lengths.astype(jnp.int32))
+                zeros = jnp.zeros((Bp, Wp), jnp.int32)
+                out_m, _ = _pallas_pair_scan(
+                    cls_p, len_p, p2planes[0], p2init[0], p2final[0],
+                    zeros, zeros, TB=TB, CL=CL, MR=MR,
+                    interpret=interpret)
+                match = jax.lax.bitcast_convert_type(
+                    out_m[:B_s, :w_shard], jnp.uint32)
             else:
                 match, _ = scan_bytes(t, tokens, lengths, gather="take")
 
@@ -265,6 +327,8 @@ class ShardedEngine:
                     P(None, "model"), P("model"), P("model"),      # tables
                     P("model", None), P("model", None, None),      # pair
                     P("model", None, None), P("model", None, None),
+                    P("model", None, None), P("model", None, None),  # p2
+                    P("model", None, None),
                     P("model", None), P("model", None),
                     P("model", None, None),
                     P(None, None), P(None), P(None, None), P(None),
@@ -277,23 +341,29 @@ class ShardedEngine:
             )
             return fn(self.d_byte, self.d_init, self.d_final,
                       self.d_bcls, self.d_ctab, self.d_preach,
-                      self.d_pfinal, self.d_fw,
+                      self.d_pfinal,
+                      self.d_p2planes, self.d_p2init, self.d_p2final,
+                      self.d_fw,
                       self.d_fb, self.d_fr, self.d_rule_sv, self.d_score,
                       self.d_class, self.d_nopf, self.d_tenant,
                       tokens, lengths, row_req, row_sv, tenants)
 
-        self._steps[scan_impl] = step
+        self._steps[key] = step
         return step
 
     def autoselect_scan_impl(self, B: int = 256, L: int = 256,
-                             iters: int = 17) -> str:
-        """Measure pair vs take on THIS mesh and keep the winner — the
-        sharded extension of DetectionEngine.autoselect_scan_impl
-        (round-4, VERDICT item #7: the multi-chip step used the gather
-        scan unconditionally while the single-chip bake-off winner was
-        pair).  K-chained timing like utils/microbench: per-impl, run the
-        jitted step iters times back-to-back and difference, so dispatch
-        overhead (and the tunnel on this rig) mostly cancels."""
+                             iters: int = 17,
+                             include_pallas: bool | None = None) -> str:
+        """Measure the sharded scan impls on THIS mesh and keep the
+        winner — the sharded extension of
+        DetectionEngine.autoselect_scan_impl (round-4, VERDICT item #7:
+        the multi-chip step used the gather scan unconditionally while
+        the single-chip bake-off winner was pair).  K-chained timing
+        like utils/microbench: per-impl, run the jitted step iters times
+        back-to-back and difference, so dispatch overhead (and the
+        tunnel on this rig) mostly cancels.  pallas2 joins the bake-off
+        on real TPU backends only (interpret mode would never win on
+        CPU)."""
         import time as _time
 
         if jax.process_count() > 1:
@@ -301,6 +371,11 @@ class ShardedEngine:
             # detect()); a measurement pass is not worth coordinating
             # across hosts — keep the configured impl
             return self.scan_impl
+        if include_pallas is None:
+            # Mosaic kernel: TPU platforms only ("axon" = this rig's
+            # remote-TPU PJRT plugin); a GPU backend would crash the
+            # bake-off at compile, not lose it
+            include_pallas = jax.default_backend() in ("tpu", "axon")
         n_data = self.mesh.shape["data"]
         B = -(-B // n_data) * n_data
         rng = np.random.default_rng(7)
@@ -311,7 +386,9 @@ class ShardedEngine:
         tenants = np.zeros((B,), np.int32)
 
         timings = {}
-        for impl in ("take", "pair"):
+        candidates = ("take", "pair") + (
+            ("pallas2",) if include_pallas else ())
+        for impl in candidates:
             step = self._build_step(impl)
             args = (jnp.asarray(tokens), jnp.asarray(lengths),
                     jnp.asarray(row_req), jnp.asarray(row_sv),
