@@ -194,6 +194,15 @@ class DetectionEngine:
         self._pallas = None
         self._pallas2 = None
 
+    def rebuilt(self, cr: CompiledRuleset) -> "DetectionEngine":
+        """Fresh engine of the SAME kind on a new ruleset — the batcher
+        hot-swap uses this so a mesh-backed engine (parallel/serve_mesh
+        MeshEngine) survives the swap instead of silently reverting to
+        the single-chip engine."""
+        eng = type(self)(cr, scan_impl=self.scan_impl)
+        eng.pallas_interpret = self.pallas_interpret
+        return eng
+
     def swap_ruleset(self, cr: CompiledRuleset) -> None:
         # tables are a jit *argument* (pytree), so a geometry change just
         # keys a fresh executable on next call — never clear the cache

@@ -1,0 +1,188 @@
+"""Serve the multi-chip detection step behind the single-chip engine API.
+
+``MeshEngine`` adapts ShardedEngine (DP×TP over a Mesh, shard.py) to the
+``DetectionEngine`` interface the serving stack consumes (pipeline
+``detect_device`` bucket dispatch, batcher hot-swap, server ``--scan-impl
+auto``), so ``serve --mesh data=2,model=4`` runs the SAME deadline
+batcher / bucketing / confirm pipeline with the scan spread over a
+device mesh.  Reference parity: wallarm scales the data plane by adding
+nginx workers/replicas (SURVEY §2.4 DP row); here one serve process
+scales across the chips it owns.
+
+Row layout contract: the adapter uses the sharded step's GLOBAL-ROWS
+variant (shard.py ``_build_step(global_rows=True)``) — rows ride in
+caller order with GLOBAL request ids, the data shards each
+segment-reduce their own row slice against all Q segments, and the
+per-request partials merge with one psum over the "data" axis.  Row
+placement is therefore free, and every jit shape is a pure function of
+(B, L, Q) — which is exactly the batcher's seen_shapes/warm_shape
+replay contract (a placement-dependent shape would make the hot-swap
+pre-compile the wrong executables and stall post-swap traffic on XLA
+compiles under the swap lock).
+
+Tenant (EP) masking stays in the PIPELINE (mask_hits), exactly as for
+the single-chip engine — the adapter always builds the sharded step with
+the trivial all-tenants mask so the two paths cannot diverge on EP
+semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ingress_plus_tpu.compiler.ruleset import CompiledRuleset
+from ingress_plus_tpu.parallel.shard import ShardedEngine
+
+try:  # Mesh type only used for annotations / isinstance docs
+    from jax.sharding import Mesh
+except Exception:  # pragma: no cover
+    Mesh = None
+
+
+def parse_mesh_spec(spec: str, n_devices: Optional[int] = None):
+    """'data=2,model=4' (or '2x4') → an actual jax Mesh over the local
+    devices.  A total of 0 on either axis is rejected; the product must
+    not exceed the device count."""
+    spec = spec.strip()
+    if "x" in spec and "=" not in spec:
+        d, m = spec.split("x", 1)
+        n_data, n_model = int(d), int(m)
+    else:
+        kv = dict(p.split("=", 1) for p in spec.split(","))
+        n_data, n_model = int(kv["data"]), int(kv["model"])
+    if n_data < 1 or n_model < 1:
+        raise ValueError("mesh axes must be >= 1: %r" % spec)
+    devs = jax.devices()
+    need = n_data * n_model
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    if need > len(devs):
+        raise ValueError("mesh %dx%d needs %d devices, have %d"
+                         % (n_data, n_model, need, len(devs)))
+    arr = np.asarray(devs[:need]).reshape(n_data, n_model)
+    from jax.sharding import Mesh as _Mesh
+    return _Mesh(arr, ("data", "model"))
+
+
+class MeshEngine:
+    """DetectionEngine-compatible facade over the sharded DP×TP step."""
+
+    #: sharded impls only — the pipeline/server select from these
+    SCAN_IMPLS = ShardedEngine.SCAN_IMPLS
+
+    def __init__(self, cr: CompiledRuleset, mesh, scan_impl: str = "pair"):
+        self.ruleset = cr
+        self.mesh = mesh
+        self._sharded = ShardedEngine(cr, mesh, scan_impl=scan_impl)
+        self._tables = None        # lazy single-chip tables (stream path)
+        self.pallas_interpret = False
+
+    # ------------------------------------------------ engine API surface
+
+    @property
+    def scan_impl(self) -> str:
+        return self._sharded.scan_impl
+
+    @scan_impl.setter
+    def scan_impl(self, v: str) -> None:
+        self._sharded.set_scan_impl(v)
+
+    @property
+    def tables(self):
+        """Single-chip EngineTables for consumers that scan OUTSIDE the
+        mesh step (the streaming-body carry path runs chunk scans
+        locally; only whole-batch prefilter rides the mesh)."""
+        if self._tables is None:
+            from ingress_plus_tpu.models.engine import EngineTables
+            self._tables = EngineTables.from_ruleset(self.ruleset)
+        return self._tables
+
+    def swap_ruleset(self, cr: CompiledRuleset) -> None:
+        self.ruleset = cr
+        self._tables = None
+        self._sharded = ShardedEngine(cr, self.mesh,
+                                      scan_impl=self.scan_impl)
+
+    def rebuilt(self, cr: CompiledRuleset) -> "MeshEngine":
+        """Fresh engine of the SAME kind on a new ruleset (batcher
+        hot-swap contract — see DetectionEngine.rebuilt)."""
+        eng = MeshEngine(cr, self.mesh, scan_impl=self.scan_impl)
+        eng.pallas_interpret = self.pallas_interpret
+        return eng
+
+    def autoselect_scan_impl(self, **kw) -> dict:
+        """Measure the sharded impls on the live mesh, install the
+        winner, and return {impl: seconds} (the server prints it).
+        Measures the global-rows step — the variant _dispatch serves
+        with — so the bake-off ranks and pre-warms the real program."""
+        self._sync_interpret()
+        kw.setdefault("global_rows", True)
+        self._sharded.autoselect_scan_impl(**kw)
+        return dict(getattr(self._sharded, "last_timings", {}))
+
+    # -------------------------------------------------------- dispatch
+
+    def _sync_interpret(self) -> None:
+        self._sharded.pallas_interpret = self.pallas_interpret
+
+    def _dispatch(self, tokens, lengths, row_req, row_sv,
+                  num_requests: int):
+        """One global-rows sharded step; returns the device
+        (num_requests, R) rule-hit array plus class/score legs.
+
+        The global-rows step (shard.py _build_step(global_rows=True))
+        reduces GLOBAL request ids and psums verdict partials across the
+        data axis, so row placement is free: rows ride in caller order,
+        the row axis pads to n_data * B_s with B_s a pure function of
+        the row count — which makes every jit shape a function of
+        (B, L, Q) alone, exactly what the batcher's warm_shape replay
+        (seen_shapes contract) pre-compiles."""
+        self._sync_interpret()
+        eng = self._sharded
+        n_data = eng.mesh.shape["data"]
+        tokens = np.asarray(tokens)
+        lengths = np.asarray(lengths, np.int32)
+        row_req = np.asarray(row_req, np.int32)
+        row_sv = np.asarray(row_sv, np.int8)
+
+        B = tokens.shape[0]
+        B_s = max(8, 1 << int(np.ceil(np.log2(max(1, -(-B // n_data))))))
+        L = tokens.shape[1]
+        if L % 2:
+            L += 1          # pair recurrence consumes byte PAIRS
+        tok2 = np.zeros((n_data * B_s, L), tokens.dtype)
+        len2 = np.zeros((n_data * B_s,), np.int32)
+        # padding rows carry request id 0 — harmless ONLY because their
+        # row_sv stays all-zero: `applies` is then false for every rule,
+        # so they can never contribute a vote (do not give padding rows
+        # a nonzero sv)
+        req2 = np.zeros((n_data * B_s,), np.int32)
+        sv2 = np.zeros((n_data * B_s, row_sv.shape[1]), np.int8)
+        tok2[:B, :tokens.shape[1]] = tokens
+        len2[:B] = lengths
+        req2[:B] = row_req
+        sv2[:B] = row_sv
+        # per-REQUEST tenant ids (replicated in the global-rows step);
+        # EP masking happens in the pipeline, so the trivial tenant 0
+        # rides here
+        ten2 = np.zeros((num_requests,), np.int32)
+        step = eng._build_step(eng.scan_impl, global_rows=True)
+        rh, ch, sc = step(
+            jax.numpy.asarray(tok2), jax.numpy.asarray(len2),
+            jax.numpy.asarray(req2), jax.numpy.asarray(sv2),
+            jax.numpy.asarray(ten2), num_requests=num_requests)
+        return rh, ch, sc
+
+    def detect_device(self, tokens, lengths, row_req, row_sv,
+                      num_requests: int):
+        rh, _, _ = self._dispatch(tokens, lengths, row_req, row_sv,
+                                  num_requests)
+        return rh
+
+    def detect(self, tokens, lengths, row_req, row_sv, num_requests: int):
+        rh, ch, sc = self._dispatch(tokens, lengths, row_req, row_sv,
+                                    num_requests)
+        return np.asarray(rh), np.asarray(ch), np.asarray(sc)

@@ -226,8 +226,17 @@ class ShardedEngine:
         self.scan_impl = scan_impl
         self._step = self._build_step(scan_impl)
 
-    def _build_step(self, scan_impl: str):
-        key = (scan_impl, self.pallas_interpret)
+    def _build_step(self, scan_impl: str, global_rows: bool = False):
+        """``global_rows=False`` (the detect() contract): row_req holds
+        SHARD-LOCAL request ids, each data shard reduces its own rows,
+        and the (Q, R) output is the concatenation of per-shard
+        verdicts.  ``global_rows=True`` (the serving adapter,
+        parallel/serve_mesh): row_req holds GLOBAL request ids, rows may
+        sit on ANY data shard, and per-request verdicts are merged with
+        one extra psum over the data axis — placement-free, so batch
+        shapes depend only on (B, L, Q) and the batcher's warm_shape
+        replay compiles exactly the executables live traffic hits."""
+        key = (scan_impl, self.pallas_interpret, global_rows)
         if key in self._steps:
             return self._steps[key]
         mesh = self.mesh
@@ -296,12 +305,24 @@ class ShardedEngine:
                               preferred_element_type=jnp.float32) > 0
             row_rule = jnp.logical_and(row_rule, applies)
 
-            rule_hits = jax.ops.segment_max(
+            rh_i = jax.ops.segment_max(
                 row_rule.astype(jnp.int32), row_req,
-                num_segments=num_requests) > 0
-            req_has_rows = jax.ops.segment_max(
+                num_segments=num_requests)
+            ap_i = jax.ops.segment_max(
                 applies.astype(jnp.int32), row_req,
-                num_segments=num_requests) > 0
+                num_segments=num_requests)
+            if global_rows:
+                # rows for one request may live on several data shards:
+                # OR the per-shard partials via psum.  segment_max fills
+                # segments with NO rows on a shard with INT32_MIN, which
+                # would poison the sum (INT_MIN + 1 stays negative and
+                # erases a real hit) — clamp the partials to 0/1 first
+                rh_i = jax.lax.psum(jnp.maximum(rh_i, 0),
+                                    axis_name="data")
+                ap_i = jax.lax.psum(jnp.maximum(ap_i, 0),
+                                    axis_name="data")
+            rule_hits = rh_i > 0
+            req_has_rows = ap_i > 0
             rule_hits = jnp.logical_or(
                 rule_hits, jnp.logical_and(req_has_rows, nopf[None, :]))
 
@@ -319,9 +340,15 @@ class ShardedEngine:
 
         @functools.partial(jax.jit, static_argnames=("num_requests",))
         def step(tokens, lengths, row_req, row_sv, tenants, num_requests):
+            seg = (num_requests if global_rows
+                   else num_requests // mesh.shape["data"])
+            # global mode: tenants are per-request and replicated (the
+            # verdict tensors are too, post-psum); local mode splits
+            # both along the data axis
+            out_axis = None if global_rows else "data"
+            ten_spec = P(out_axis)
             fn = shard_map(
-                functools.partial(block, num_requests=num_requests
-                                  // mesh.shape["data"]),
+                functools.partial(block, num_requests=seg),
                 mesh=mesh,
                 in_specs=(
                     P(None, "model"), P("model"), P("model"),      # tables
@@ -334,9 +361,10 @@ class ShardedEngine:
                     P(None, None), P(None), P(None, None), P(None),
                     P(None, None),                                  # tenant
                     P("data", None), P("data"), P("data"),
-                    P("data", None), P("data"),
+                    P("data", None), ten_spec,
                 ),
-                out_specs=(P("data", None), P("data", None), P("data")),
+                out_specs=(P(out_axis, None), P(out_axis, None),
+                           P(out_axis)),
                 check_vma=False,
             )
             return fn(self.d_byte, self.d_init, self.d_final,
@@ -353,7 +381,8 @@ class ShardedEngine:
 
     def autoselect_scan_impl(self, B: int = 256, L: int = 256,
                              iters: int = 17,
-                             include_pallas: bool | None = None) -> str:
+                             include_pallas: bool | None = None,
+                             global_rows: bool = False) -> str:
         """Measure the sharded scan impls on THIS mesh and keep the
         winner — the sharded extension of
         DetectionEngine.autoselect_scan_impl (round-4, VERDICT item #7:
@@ -381,7 +410,11 @@ class ShardedEngine:
         rng = np.random.default_rng(7)
         tokens = rng.integers(0, 256, (B, L), dtype=np.int32)
         lengths = np.full((B,), L, np.int32)
-        row_req = np.tile(np.arange(B // n_data, dtype=np.int32), n_data)
+        # one request per row; local mode wants SHARD-LOCAL ids, global
+        # mode GLOBAL ids (matching each step variant's contract)
+        row_req = (np.arange(B, dtype=np.int32) if global_rows
+                   else np.tile(np.arange(B // n_data, dtype=np.int32),
+                                n_data))
         row_sv = np.ones((B, self.st.rule_sv.shape[1]), np.int8)
         tenants = np.zeros((B,), np.int32)
 
@@ -389,7 +422,11 @@ class ShardedEngine:
         candidates = ("take", "pair") + (
             ("pallas2",) if include_pallas else ())
         for impl in candidates:
-            step = self._build_step(impl)
+            # measure the step VARIANT the caller serves with (the mesh
+            # adapter runs global_rows=True; timing the local-rows
+            # program would rank a program live traffic never executes
+            # and pay its compiles for nothing)
+            step = self._build_step(impl, global_rows=global_rows)
             args = (jnp.asarray(tokens), jnp.asarray(lengths),
                     jnp.asarray(row_req), jnp.asarray(row_sv),
                     jnp.asarray(tenants))
@@ -401,6 +438,7 @@ class ShardedEngine:
             jax.block_until_ready(out)
             timings[impl] = _time.perf_counter() - t0
         best = min(timings, key=timings.get)
+        self.last_timings = timings   # consumed by MeshEngine/diagnostics
         self.set_scan_impl(best)
         return best
 

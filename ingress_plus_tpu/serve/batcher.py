@@ -248,12 +248,13 @@ class Batcher:
            batch finishes, re-deriving tenant masks against the new rule
            axis so EP routing survives the swap."""
         old = self.pipeline
+        # rebuilt(): same engine KIND on the new ruleset, so a
+        # mesh-backed engine (parallel/serve_mesh) survives the swap
         new = DetectionPipeline(
             ruleset, mode=old.mode,
             anomaly_threshold=old.anomaly_threshold,
             fail_open=old.fail_open, paranoia_level=paranoia_level,
-            scan_impl=old.engine.scan_impl)
-        new.engine.pallas_interpret = old.engine.pallas_interpret
+            engine=old.engine.rebuilt(ruleset))
         for shape in sorted(getattr(old, "seen_shapes", ())):
             new.warm_shape(*shape)
         new.stats = old.stats  # counters span swaps (Prometheus contract)

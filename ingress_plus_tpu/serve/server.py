@@ -558,7 +558,8 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
                           max_batch: int = 256,
                           max_delay_s: float = 0.0005,
                           warmup: bool = True,
-                          scan_impl: str = "auto") -> Batcher:
+                          scan_impl: str = "auto",
+                          mesh_spec: Optional[str] = None) -> Batcher:
     from ingress_plus_tpu.compiler.ruleset import compile_ruleset
     from ingress_plus_tpu.compiler.seclang import load_seclang_dir
     from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
@@ -566,7 +567,25 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
 
     rules = (load_seclang_dir(rules_dir) if rules_dir
              else load_bundled_rules())
-    pipeline = DetectionPipeline(compile_ruleset(rules), mode=mode)
+    cr = compile_ruleset(rules)
+    engine = None
+    if mesh_spec:
+        # multi-chip serving: same batcher/pipeline/confirm, the scan
+        # rides the DP x TP sharded step (parallel/serve_mesh)
+        from ingress_plus_tpu.parallel.serve_mesh import (
+            MeshEngine, parse_mesh_spec)
+
+        engine = MeshEngine(cr, parse_mesh_spec(mesh_spec))
+        print("mesh serving: %s over %d devices"
+              % (mesh_spec, engine.mesh.size), file=sys.stderr)
+    pipeline = DetectionPipeline(cr, mode=mode, engine=engine)
+    if mesh_spec:
+        if scan_impl == "pallas":
+            # the byte kernel has no sharded variant; the class-pair
+            # kernel is its mesh counterpart
+            print("mesh serving: --scan-impl pallas -> pallas2 "
+                  "(sharded variant)", file=sys.stderr)
+            scan_impl = "pallas2"
     if scan_impl == "auto":
         # startup microbench on the LIVE backend picks the serving scan
         # implementation (pair/take/pallas) by measurement
@@ -621,6 +640,11 @@ def main(argv=None) -> None:
                          "box's TPU sits behind a ~70ms tunnel, so "
                          "latency-sensitive serving may prefer cpu")
     ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--mesh", default=None,
+                    help="serve the scan over a device mesh, e.g. "
+                         "'data=2,model=4' or '2x4' (DP x TP sharding "
+                         "across the local chips; see parallel/"
+                         "serve_mesh.py)")
     ap.add_argument("--scan-impl", default="auto",
                     choices=["auto", "pair", "take", "pallas", "pallas2"],
                     help="TPU scan implementation; auto = startup "
@@ -648,7 +672,7 @@ def main(argv=None) -> None:
     batcher = build_default_batcher(
         mode=args.mode, rules_dir=args.rules_dir, max_batch=args.max_batch,
         max_delay_s=args.max_delay_us / 1e6, warmup=not args.no_warmup,
-        scan_impl=args.scan_impl)
+        scan_impl=args.scan_impl, mesh_spec=args.mesh)
 
     post = None
     if args.spool_dir or args.export_url:
