@@ -484,7 +484,15 @@ def parse_seclang(
             m = re.search(r"paranoia-level/(\d)", t)
             if m:
                 paranoia = int(m.group(1))
-        phase = int(actions.get("phase", ["2"])[0] or 2)
+        phase_txt = (actions.get("phase", ["2"])[0] or "2").strip("'\"")
+        # ModSecurity 2.7+ symbolic phase names map to their numbers
+        phase_txt = {"request": "2", "response": "4",
+                     "logging": "5"}.get(phase_txt, phase_txt)
+        try:
+            phase = int(phase_txt)
+        except ValueError:
+            raise SecLangError("%s: bad phase %r in rule %s"
+                               % (source, actions.get("phase"), rid))
 
         rule = Rule(
             rule_id=rid,

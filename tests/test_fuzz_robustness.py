@@ -1,0 +1,183 @@
+"""Mutation/romdom-input robustness for the attacker-facing decoders.
+
+The reference's native parsers (body unpacking, protobuf walking, wire
+framing, SecLang loading) face hostile bytes by definition; this tier
+fuzzes ours the way the libdetection differential fuzz covers the
+confirm twins (SURVEY.md §4 test plan): seeded RNG (deterministic CI),
+thousands of random + mutated inputs, and a single invariant — decoders
+either return bounded output or raise their DECLARED error type.  No
+other exception class, no hang, no unbounded amplification.
+"""
+
+import random
+import zlib
+
+import pytest
+
+from ingress_plus_tpu.compiler.seclang import SecLangError, parse_seclang
+from ingress_plus_tpu.serve.protocol import (
+    REQ_MAGIC, FrameReader, ProtocolError, decode_request, encode_request)
+from ingress_plus_tpu.serve.unpack import (
+    DEFAULT_MAX_OUT, extract_json, extract_protobuf, extract_xml,
+    inflate, split_grpc_frames, unpack_body)
+
+
+def _mutate(rng: random.Random, data: bytes, n: int = 4) -> bytes:
+    buf = bytearray(data)
+    for _ in range(rng.randint(1, n)):
+        if not buf:
+            break
+        op = rng.randrange(3)
+        i = rng.randrange(len(buf))
+        if op == 0:
+            buf[i] ^= 1 << rng.randrange(8)      # bit flip
+        elif op == 1:
+            del buf[i:i + rng.randint(1, 16)]    # deletion
+        else:
+            buf[i:i] = bytes(rng.randrange(256)  # insertion
+                             for _ in range(rng.randint(1, 8)))
+    return bytes(buf)
+
+
+def _seed_bodies():
+    """Valid bodies of every kind the unpacker handles — the mutation
+    corpus seeds."""
+    import base64
+    import gzip
+    import json
+
+    j = json.dumps({"q": "1' UNION SELECT", "nest": {"a": ["<script>", 1],
+                                                     "b": "x" * 200}})
+    x = "<r a='1\" OR 1=1'><b>body &amp; text</b><c/></r>"
+    pb = (b"\x0a\x10" + b"q=union select x" +          # field 1: bytes
+          b"\x12\x08" + b"\x0a\x06attack" +            # field 2: nested
+          b"\x18\x2a")                                 # field 3: varint
+    grpc = b"\x00" + len(pb).to_bytes(4, "big") + pb
+    return [
+        j.encode(), x.encode(), pb, grpc,
+        gzip.compress(j.encode()), zlib.compress(x.encode()),
+        base64.b64encode(j.encode()),
+        b"a=1&b=" + b"%" * 30, b"\x00" * 64, b"",
+    ]
+
+
+HEADERS = [
+    {},
+    {"content-encoding": "gzip"},
+    {"content-encoding": "deflate"},
+    {"content-type": "application/json"},
+    {"content-type": "text/xml"},
+    {"content-type": "application/grpc+proto"},
+    {"content-type": "application/grpc+json",
+     "content-encoding": "gzip"},
+]
+
+
+def test_unpack_body_never_raises_and_is_bounded():
+    rng = random.Random(1234)
+    seeds = _seed_bodies()
+    for i in range(3000):
+        body = _mutate(rng, rng.choice(seeds))
+        headers = rng.choice(HEADERS)
+        out = unpack_body(body, headers)
+        assert isinstance(out, bytes)
+        # DoS bound: decoding can expand, but never past the cap plus
+        # the original (worst case: cap-limited expansion concatenated
+        # with pass-through segments)
+        assert len(out) <= DEFAULT_MAX_OUT + len(body)
+
+
+def test_individual_decoders_error_contract():
+    rng = random.Random(99)
+    seeds = _seed_bodies()
+    for i in range(2000):
+        blob = _mutate(rng, rng.choice(seeds))
+        for fn in (inflate, extract_json, extract_xml, extract_protobuf):
+            out = fn(blob)
+            assert out is None or isinstance(out, bytes)
+        frames = split_grpc_frames(blob)
+        assert frames is None or isinstance(frames, list)
+        for msg in frames or ():
+            assert isinstance(msg, bytes)
+            assert len(msg) <= DEFAULT_MAX_OUT
+
+
+def test_protobuf_walker_depth_and_budget_bounded():
+    # adversarial: deeply self-nested length-delimited fields
+    inner = b"q=1 union select"
+    blob = b"\x0a" + bytes([len(inner)]) + inner
+    for _ in range(64):                      # 64 nesting levels
+        if len(blob) > 120:
+            break
+        blob = b"\x0a" + bytes([len(blob)]) + blob
+    out = extract_protobuf(blob)
+    assert out is None or len(out) <= 1 << 20
+    # varint flood
+    out = extract_protobuf(b"\x08" * 4096)
+    assert out is None or isinstance(out, bytes)
+
+
+def test_frame_reader_survives_garbage_and_resyncs():
+    from ingress_plus_tpu.serve.normalize import Request
+
+    rng = random.Random(7)
+    good = encode_request(Request(uri="/ok"), req_id=1)
+    for i in range(500):
+        reader = FrameReader(REQ_MAGIC)
+        blob = _mutate(rng, good) + good
+        # arbitrary chunking
+        pos, frames, died = 0, [], False
+        while pos < len(blob):
+            n = rng.randint(1, 64)
+            try:
+                frames.extend(reader.feed(blob[pos:pos + n]))
+            except ProtocolError:
+                died = True     # declared error type: acceptable
+                break
+            pos += n
+        if not died:
+            for f in frames:
+                try:
+                    decode_request(f)
+                except ProtocolError:
+                    pass        # declared error type: acceptable
+
+
+def test_seclang_parser_error_contract():
+    rng = random.Random(31337)
+    base = (
+        'SecRule ARGS|REQUEST_BODY "@rx (?i)union\\s+select" '
+        '"id:942100,phase:2,block,t:urlDecodeUni,t:lowercase,'
+        "severity:CRITICAL,tag:'attack-sqli'\"\n"
+        'SecAction "id:900990,phase:1,pass,setvar:tx.crs_setup_version=330"\n'
+        'SecRule REQUEST_URI "@pm etc passwd" "id:930120,phase:2,block"\n'
+    )
+    ok = bad = 0
+    for i in range(800):
+        text = _mutate(rng, base.encode(), n=6).decode("latin-1")
+        try:
+            rules = parse_seclang(text)
+            ok += 1
+            assert isinstance(rules, list)
+        except SecLangError:
+            bad += 1
+        # any OTHER exception type propagates and fails the test
+    assert ok and bad   # the corpus must exercise both outcomes
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 17])
+def test_grpc_stream_feeder_on_mutated_frames(chunk):
+    from ingress_plus_tpu.serve.unpack import IncrementalGrpc
+
+    rng = random.Random(chunk)
+    pb = b"\x0a\x06attack"
+    frame = b"\x00" + len(pb).to_bytes(4, "big") + pb
+    for i in range(300):
+        blob = _mutate(rng, frame * 3)
+        st = IncrementalGrpc()
+        out = b""
+        for p in range(0, len(blob), chunk):
+            out += st.feed(blob[p:p + chunk])
+        out += st.flush()
+        assert isinstance(out, bytes)
+        assert len(out) <= len(blob) + (16 << 20)
