@@ -11,6 +11,7 @@ sigpack.py with the same Rule output type.
 
 from __future__ import annotations
 
+import glob as _glob
 import re
 import shlex
 from dataclasses import dataclass, field
@@ -307,6 +308,7 @@ def parse_seclang(
     source: str = "<string>",
     base_dir: Optional[Path] = None,
     rules: Optional[List[Rule]] = None,
+    _seen_includes: Optional[set] = None,
 ) -> List[Rule]:
     """Parse SecLang text → list of top-level Rules (chains attached).
 
@@ -324,6 +326,8 @@ def parse_seclang(
     .conf reach rules from earlier files."""
     if rules is None:
         rules = []
+    if _seen_includes is None:
+        _seen_includes = set()
     pending_chain: Optional[Rule] = None
 
     for line in _logical_lines(text):
@@ -334,6 +338,39 @@ def parse_seclang(
         if not tokens:
             continue
         directive = tokens[0]
+        if directive == "Include":
+            # ModSecurity's config-tree loader: every real deployment
+            # pulls CRS in via `Include .../rules/*.conf`, so a user
+            # migrating an existing tree points us at it unchanged.
+            # Paths resolve against the including file's directory;
+            # globs expand sorted (CRS file-order convention); a file
+            # is loaded at most once per parse (cycle-proof).
+            if len(tokens) < 2 or not tokens[1]:
+                raise SecLangError("%s: Include needs a path" % source)
+            if base_dir is None:
+                raise SecLangError(
+                    "%s: Include %r needs base_dir" % (source, tokens[1]))
+            pat = tokens[1]   # quotes already stripped by the tokenizer
+            root = Path(pat) if Path(pat).is_absolute() else base_dir / pat
+            # glob the FULL pattern — Apache/ModSecurity expand
+            # wildcards in directory segments too (conf.d/*/rules.conf)
+            matches = ([Path(m) for m in sorted(_glob.glob(str(root)))]
+                       if any(c in pat for c in "*?[") else [root])
+            if not matches or not any(m.is_file() for m in matches):
+                raise SecLangError(
+                    "%s: Include %r matched no files (resolved %s)"
+                    % (source, pat, root))
+            for conf in matches:
+                if not conf.is_file():
+                    continue
+                key = str(conf.resolve())
+                if key in _seen_includes:
+                    continue
+                _seen_includes.add(key)
+                parse_seclang(conf.read_text(), source=str(conf),
+                              base_dir=conf.parent, rules=rules,
+                              _seen_includes=_seen_includes)
+            continue
         if directive == "SecAction":
             # config-plane rule (CRS crs-setup.conf shape): no scan
             # content, but its setvar actions initialize the TX
@@ -534,12 +571,26 @@ def parse_seclang(
 
 
 def load_seclang_dir(path: str | Path) -> List[Rule]:
-    """Parse every ``*.conf`` under ``path`` (sorted, CRS-style file
-    order).  One shared rules accumulator rides through all files so
+    """Parse a rules tree: a DIRECTORY loads every ``*.conf`` (sorted,
+    CRS-style file order); a FILE is treated as the deployment's entry
+    config (modsecurity.conf shape) whose ``Include`` directives pull in
+    the rest.  One shared rules accumulator rides through all files so
     exclusion directives in later files (the REQUEST-900/999-style
     before/after convention) apply to rules from earlier ones."""
+    p = Path(path)
     rules: List[Rule] = []
-    for conf in sorted(Path(path).glob("*.conf")):
+    seen: set = set()
+    if p.is_file():
+        seen.add(str(p.resolve()))
+        return parse_seclang(p.read_text(), source=str(p),
+                             base_dir=p.parent, rules=rules,
+                             _seen_includes=seen)
+    for conf in sorted(p.glob("*.conf")):
+        key = str(conf.resolve())
+        if key in seen:
+            continue   # already pulled in by an earlier file's Include
+        seen.add(key)
         parse_seclang(conf.read_text(), source=str(conf),
-                      base_dir=conf.parent, rules=rules)
+                      base_dir=conf.parent, rules=rules,
+                      _seen_includes=seen)
     return rules

@@ -386,3 +386,42 @@ def test_count_form_targets_evaluated_exactly():
         'SecRule &ARGS|REQUEST_URI "@rx (?i)union\\s+select" '
         '"id:942999,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"')
     assert sorted(rules[0].targets) == ["args", "body", "uri"]
+
+
+def test_include_directive_loads_config_tree(tmp_path):
+    """ModSecurity `Include` (relative, glob, nested, cycle-proof) — the
+    entry-config shape every real CRS deployment uses."""
+    from ingress_plus_tpu.compiler.seclang import (
+        SecLangError, load_seclang_dir, parse_seclang)
+
+    rdir = tmp_path / "rules"
+    rdir.mkdir()
+    (rdir / "a-sqli.conf").write_text(
+        'SecRule ARGS "@rx (?i)union\\s+select" '
+        '"id:942100,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"\n')
+    (rdir / "b-xss.conf").write_text(
+        'SecRule ARGS "@rx (?i)<script" '
+        '"id:941100,phase:2,block,severity:CRITICAL,tag:\'attack-xss\'"\n'
+        # nested include + self-include (cycle) must both be harmless
+        'Include b-xss.conf\n'
+        'Include ../extra.conf\n')
+    (tmp_path / "extra.conf").write_text(
+        'SecRule ARGS "@rx /etc/passwd" '
+        '"id:930120,phase:2,block,severity:CRITICAL,tag:\'attack-lfi\'"\n')
+    entry = tmp_path / "modsecurity.conf"
+    entry.write_text("Include rules/*.conf\n")
+
+    rules = parse_seclang(entry.read_text(), source=str(entry),
+                          base_dir=entry.parent)
+    ids = sorted(r.rule_id for r in rules)
+    assert ids == [930120, 941100, 942100]
+
+    # load_seclang_dir accepts the entry FILE directly
+    rules2 = load_seclang_dir(entry)
+    assert sorted(r.rule_id for r in rules2) == ids
+
+    # missing include is a hard, typed error
+    entry.write_text("Include nope/*.conf\n")
+    import pytest
+    with pytest.raises(SecLangError):
+        load_seclang_dir(entry)
