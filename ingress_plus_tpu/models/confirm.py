@@ -636,9 +636,28 @@ class ConfirmRule:
         return None
 
 
+    def _entry_name(self, entry) -> str:
+        """Human/export name of a plan entry: 'ARGS:q', 'REQUEST_BODY'…
+        (the wallarm attack-export 'point' analog)."""
+        count, base, sel = entry
+        name = base.decode() if isinstance(base, bytes) else str(base)
+        if name == "#BLOB":
+            # legacy whole-stream entries: export the stream's SecLang
+            # name, not the internal sentinel
+            s = sel.decode("latin-1") if isinstance(sel, bytes) else str(sel)
+            return {"args": "ARGS", "headers": "REQUEST_HEADERS",
+                    "body": "REQUEST_BODY", "uri": "REQUEST_URI",
+                    "resp_headers": "RESPONSE_HEADERS",
+                    "resp_body": "RESPONSE_BODY"}.get(s, s.upper())
+        if sel is not None:
+            s = sel.decode("latin-1") if isinstance(sel, bytes) else str(sel)
+            name = "%s:%s" % (name, s)
+        return ("&" + name) if count else name
+
     def matches_streams(self, streams: Dict[str, bytes],
                         cache: Optional[Dict] = None,
-                        extra_excl: Optional[Dict] = None) -> bool:
+                        extra_excl: Optional[Dict] = None,
+                        detail_out: Optional[list] = None) -> bool:
         """Evaluate against raw streams (applies own transforms).
 
         Negated operators ("!@op") invert per VARIABLE VALUE, mirroring
@@ -676,6 +695,15 @@ class ConfirmRule:
                     continue   # abstain survives negation: never a hit
                 if m != self.negate:
                     hit = True
+                    if detail_out is not None:
+                        # matched point for the attack export: variable
+                        # name + bounded post-transform snippet (raw
+                        # bodies stay out of the queue — see post.Hit)
+                        snip = val if isinstance(val, bytes) else \
+                            str(val).encode()
+                        detail_out.append(
+                            (self._entry_name(entry),
+                             snip[:100].decode("latin-1")))
                     break
             if hit:
                 break

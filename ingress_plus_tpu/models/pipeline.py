@@ -53,6 +53,10 @@ class Verdict:
     score: int
     fail_open: bool = False
     elapsed_us: int = 0
+    #: matched points for the attack export (wallarm "points" analog):
+    #: up to 8 dicts {rule_id, var, value} — var is the SecLang variable
+    #: ('ARGS:q'), value a bounded post-transform snippet
+    matches: List[dict] = field(default_factory=list)
 
 
 @dataclass
@@ -324,15 +328,22 @@ class DetectionPipeline:
                     merged = extra_excl.setdefault(idx, {})
                     for kind, sels in excl_map.items():
                         merged.setdefault(kind, set()).update(sels)
+            points: List[dict] = []
             for r in hit_rules:
                 r = int(r)
                 if r in self._ctl_pass_idx:
                     continue   # config machinery, never a detection hit
                 if excluded is not None and excluded[r]:
                     continue
+                det: list = []
                 if self.confirms[r].matches_streams(
-                        streams, cache, extra_excl.get(r)):
+                        streams, cache, extra_excl.get(r),
+                        detail_out=det if len(points) < 8 else None):
                     confirmed.append(r)
+                    if det:
+                        points.append({"rule_id": int(rs.rule_ids[r]),
+                                       "var": det[0][0],
+                                       "value": det[0][1]})
             score = int(rs.rule_score[confirmed].sum()) if confirmed else 0
             classes = sorted(
                 {CLASSES[rs.rule_class[r]] for r in confirmed})
@@ -372,6 +383,7 @@ class DetectionPipeline:
                 classes=classes,
                 rule_ids=[int(rs.rule_ids[r]) for r in confirmed],
                 score=score,
+                matches=points,
             ))
         stats.confirm_us += int((time.perf_counter() - tc0) * 1e6)
         stats.confirmed_rule_hits += sum(len(v.rule_ids) for v in verdicts)

@@ -29,6 +29,7 @@ class Attack:
     sample_uris: List[str] = field(default_factory=list)
     sample_rule_ids: List[int] = field(default_factory=list)
     sample_request_ids: List[str] = field(default_factory=list)
+    sample_points: List[dict] = field(default_factory=list)
 
     MAX_SAMPLES = 8
 
@@ -46,6 +47,11 @@ class Attack:
                 break
             if r not in self.sample_rule_ids:
                 self.sample_rule_ids.append(r)
+        for p in hit.matches:
+            if len(self.sample_points) >= self.MAX_SAMPLES:
+                break
+            if p not in self.sample_points:   # distinct points only
+                self.sample_points.append(p)
 
     def to_dict(self) -> dict:
         return {
@@ -56,6 +62,7 @@ class Attack:
             "sample_uris": self.sample_uris,
             "sample_rule_ids": self.sample_rule_ids,
             "sample_request_ids": self.sample_request_ids,
+            "sample_points": self.sample_points,
         }
 
 

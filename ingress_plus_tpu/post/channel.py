@@ -61,7 +61,10 @@ class PostChannel:
             rule_ids=tuple(verdict.rule_ids),
             score=verdict.score, blocked=verdict.blocked,
             attack=verdict.attack, fail_open=verdict.fail_open,
-            mode=request.mode))
+            mode=request.mode,
+            # verdict is duck-typed (ws/stream paths and tests pass
+            # lightweight stubs) — matches is optional on that surface
+            matches=tuple(getattr(verdict, "matches", ()))))
 
     def start(self) -> None:
         self.exporter.start()

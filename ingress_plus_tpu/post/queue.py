@@ -37,11 +37,16 @@ class Hit:
     attack: bool
     fail_open: bool = False
     mode: int = 2
+    #: matched points ({rule_id, var, value-snippet}) — the reference
+    #: ships the serialized request and the cloud re-derives points; we
+    #: ship the points themselves (bounded, raw bodies stay out)
+    matches: Tuple[dict, ...] = ()
 
     def to_dict(self) -> dict:
         d = asdict(self)
         d["classes"] = list(self.classes)
         d["rule_ids"] = list(self.rule_ids)
+        d["matches"] = list(d["matches"])  # keep asdict's deep copies
         return d
 
 

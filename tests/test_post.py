@@ -219,3 +219,33 @@ def test_ruleset_watcher_empty_dir(tmp_path):
                        poster=lambda p, d: {})
     assert w.check_once() is False
     assert w.errors == 0
+
+
+def test_matched_points_flow_to_attack_export(tmp_path):
+    """Verdict.matches (confirm's matched variable + snippet) must ride
+    the Hit into the aggregated attack record (wallarm export 'points'
+    analog)."""
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.normalize import Request
+
+    cr = compile_ruleset(parse_seclang(
+        'SecRule ARGS "@rx (?i)union\\s+select" '
+        '"id:942100,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"'))
+    p = DetectionPipeline(cr, mode="block")
+    req = Request(uri="/p?q=1+union+select+password", request_id="r1")
+    v = p.detect([req])[0]
+    assert v.attack and v.matches, v
+    assert v.matches[0]["rule_id"] == 942100
+    assert "union" in v.matches[0]["value"].lower()
+    assert v.matches[0]["var"].lower().startswith("args")
+
+    ch = PostChannel(brute=False)
+    ch.record(req, v)
+    hits = ch.queue.drain()
+    assert hits[0].matches and hits[0].matches[0]["rule_id"] == 942100
+    attacks = aggregate_attacks(hits)
+    assert attacks
+    rec = attacks[0].to_dict()
+    assert rec["sample_points"][0]["rule_id"] == 942100
