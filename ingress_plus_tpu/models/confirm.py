@@ -487,7 +487,11 @@ class ConfirmRule:
     def _iter_entry(self, entry, streams: Dict[str, bytes],
                     cache: Optional[Dict],
                     extra_excl: Optional[Dict] = None):
-        """Yield (text, exact, is_count) for one plan entry.
+        """Yield (text, exact, is_count, label) for one plan entry.
+
+        label: the collection item's name (bytes) when iterating an
+        UNSELECTED collection (so a hit can be attributed 'ARGS:q', not
+        just 'ARGS'); None otherwise.
 
         exact=True: the text is one variable's value, exactly as
         ModSecurity would expose it (negation/numerics may consume it).
@@ -501,7 +505,7 @@ class ConfirmRule:
         if base == "#BLOB":   # legacy collection: whole stream, non-exact
             blob = streams.get(sel.decode())
             if blob:
-                yield blob, False, False
+                yield blob, False, False, None
             return
         cb = _COLLECTION_BASES.get(base)
         if cb is not None:
@@ -519,7 +523,7 @@ class ConfirmRule:
                               "resp_headers": "resp_headers"}[kind]
                     blob = streams.get(coarse)
                     if blob:
-                        yield blob, False, False
+                        yield blob, False, False, None
                 return
             exd = self._exclusions.get(kind, set())
             if extra_excl:
@@ -527,23 +531,27 @@ class ConfirmRule:
             if sel is not None:
                 if sel in exd:
                     return   # the named subfield itself is excluded
-                vals = [(n if part == "names" else v)
+                vals = [(None, n if part == "names" else v)
                         for lo, n, v in coll if lo == sel]
             else:
-                vals = [(n if part == "names" else v)
+                # keep the item's ORIGINAL-CASE name so a hit can be
+                # attributed to the specific variable ('ARGS:q',
+                # 'REQUEST_HEADERS:X-Api-Key') in the attack export,
+                # mirroring MATCHED_VAR_NAME's casing
+                vals = [(n, n if part == "names" else v)
                         for lo, n, v in coll if lo not in exd]
             if count:
-                yield str(len(vals)).encode(), True, True
+                yield str(len(vals)).encode(), True, True, None
             else:
-                for v in vals:
-                    yield v, True, False
+                for name, v in vals:
+                    yield v, True, False, name
             return
         blob_stream = _BLOB_BASES.get(base)
         if blob_stream is not None:
             if not count:
                 blob = streams.get(blob_stream)
                 if blob:
-                    yield blob, False, False
+                    yield blob, False, False, None
             return  # counts on blob-approximated bases abstain
         stream = _SCALAR_BASES.get(base)
         if stream is None:
@@ -564,12 +572,12 @@ class ConfirmRule:
                 # the historical whole-uri superset, negation abstains
                 blob = streams.get("uri")
                 if blob:
-                    yield blob, False, False
+                    yield blob, False, False, None
             return
         if count:
-            yield (b"1" if val else b"0"), True, True
+            yield (b"1" if val else b"0"), True, True, None
         elif val:
-            yield val, True, False
+            yield val, True, False, None
 
     def _op_match(self, text: bytes) -> Optional[bool]:
         """Tri-state: True/False = evaluated; None = ABSTAIN (cannot
@@ -636,10 +644,14 @@ class ConfirmRule:
         return None
 
 
-    def _entry_name(self, entry) -> str:
+    def _entry_name(self, entry, label=None) -> str:
         """Human/export name of a plan entry: 'ARGS:q', 'REQUEST_BODY'…
-        (the wallarm attack-export 'point' analog)."""
+        (the wallarm attack-export 'point' analog).  ``label`` (bytes):
+        the matched item's own name when the entry iterated a whole
+        collection — refines 'ARGS' to 'ARGS:q'."""
         count, base, sel = entry
+        if sel is None and label:
+            sel = label
         name = base.decode() if isinstance(base, bytes) else str(base)
         if name == "#BLOB":
             # legacy whole-stream entries: export the stream's SecLang
@@ -676,7 +688,7 @@ class ConfirmRule:
         restrict = self.negate or self.op in NUMERIC_OPS
         tkey = tuple(self.transforms)
         for entry in self._plan:
-            for text, exact, is_count in self._iter_entry(
+            for text, exact, is_count, label in self._iter_entry(
                     entry, streams, cache, extra_excl):
                 if restrict and not exact:
                     continue  # abstain: blob values can't drive negation
@@ -702,7 +714,7 @@ class ConfirmRule:
                         snip = val if isinstance(val, bytes) else \
                             str(val).encode()
                         detail_out.append(
-                            (self._entry_name(entry),
+                            (self._entry_name(entry, label),
                              snip[:100].decode("latin-1")))
                     break
             if hit:

@@ -234,12 +234,14 @@ def test_matched_points_flow_to_attack_export(tmp_path):
         'SecRule ARGS "@rx (?i)union\\s+select" '
         '"id:942100,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"'))
     p = DetectionPipeline(cr, mode="block")
-    req = Request(uri="/p?q=1+union+select+password", request_id="r1")
+    req = Request(uri="/p?a=clean&q=1+union+select+password",
+                  request_id="r1")
     v = p.detect([req])[0]
     assert v.attack and v.matches, v
     assert v.matches[0]["rule_id"] == 942100
     assert "union" in v.matches[0]["value"].lower()
-    assert v.matches[0]["var"].lower().startswith("args")
+    # the SPECIFIC variable, not just the collection
+    assert v.matches[0]["var"] == "ARGS:q"
 
     ch = PostChannel(brute=False)
     ch.record(req, v)
