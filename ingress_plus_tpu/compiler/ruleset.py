@@ -489,7 +489,12 @@ def _factor_group_for(rule: Rule) -> Tuple[F.Group, Dict]:
     if rule.raw_targets:
         bases = {t.strip().lstrip("&!").split(":", 1)[0].upper()
                  for t in rule.raw_targets if t.strip()}
-        if bases and bases <= F_NON_SCANNED:
+        # ANY non-scanned target makes the rule always-confirm, not just
+        # all-non-scanned (round-4 review): a mixed REQUEST_URI|
+        # REMOTE_ADDR rule with a scanned-side prefilter would silently
+        # drop the REMOTE_ADDR leg whenever the uri bytes miss — a
+        # prefilter may only gate targets whose text it can actually see
+        if bases and bases & F_NON_SCANNED:
             return [], confirm
 
     # Soundness fix-ups for destructive transforms (see module docstring).

@@ -78,6 +78,11 @@ KNOWN_TARGETS = {
     "REQUEST_COOKIES_NAMES": "headers",
     "REQUEST_LINE": "uri",
     "REQUEST_METHOD": "uri",
+    # scalar resolved in confirm (@ipMatch); binds to uri so the rule
+    # APPLIES to every request (every request has a uri row) — the
+    # factor group is empty (NON_SCANNED_SCALAR_BASES), so this never
+    # compiles a dead prefilter against uri bytes
+    "REMOTE_ADDR": "uri",
     "REQUEST_PROTOCOL": "uri",
     # ---- response side (phase 3/4 rules; wallarm_parse_response /
     # wallarm-unpack-response analog — scanned from PTPI frames)
@@ -97,7 +102,7 @@ STREAMS = ("uri", "args", "headers", "body", "resp_headers", "resp_body")
 #: to args text.
 UNSCANNABLE_BASES = {
     "TX", "IP", "GLOBAL", "SESSION", "USER", "ENV", "GEO", "TIME",
-    "DURATION", "REMOTE_ADDR", "REMOTE_HOST", "REMOTE_PORT", "AUTH_TYPE",
+    "DURATION", "REMOTE_HOST", "REMOTE_PORT", "AUTH_TYPE",
     "MATCHED_VAR", "MATCHED_VARS", "MATCHED_VAR_NAME", "MATCHED_VARS_NAMES",
     "UNIQUE_ID", "WEBSERVER_ERROR_LOG",
 }
@@ -108,7 +113,7 @@ UNSCANNABLE_BASES = {
 #: review: RESPONSE_STATUS "^5\\d\\d$" factors can't match header bytes)
 NON_SCANNED_SCALAR_BASES = {
     "RESPONSE_STATUS", "RESPONSE_PROTOCOL", "REQUEST_METHOD",
-    "REQUEST_PROTOCOL",
+    "REQUEST_PROTOCOL", "REMOTE_ADDR",
 }
 STREAM_INDEX = {s: i for i, s in enumerate(STREAMS)}
 

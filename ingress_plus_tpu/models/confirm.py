@@ -217,6 +217,7 @@ _SCALAR_BASES = {
     "QUERY_STRING": "query",
     "RESPONSE_BODY": "resp_body",
     "RESPONSE_STATUS": "status",
+    "REMOTE_ADDR": "remote_addr",
 }
 
 #: bases that only approximate to a coarse blob (REQUEST_LINE has no
@@ -638,10 +639,47 @@ class ConfirmRule:
             return True
         if self.op == "noMatch":
             return False
-        # unsupported operator (@rbl, @ipMatch, @geoLookup, ... — need
-        # external state we don't model): abstain — never match, never
-        # block, regardless of negation
+        if self.op == "ipMatch":
+            # IP/CIDR list in the rule argument (CRS REMOTE_ADDR rules);
+            # the list parses once, the per-request test is O(nets).
+            # Unparseable text (a blob, not an address) abstains.
+            nets = self._ip_nets()
+            if nets is None:
+                return None
+            import ipaddress
+            try:
+                ip = ipaddress.ip_address(text.decode("ascii").strip())
+            except ValueError:
+                return None
+            return any(ip in n for n in nets)
+        # unsupported operator (@rbl, @geoLookup, @ipMatchFromFile, ...
+        # — need external state we don't model): abstain — never match,
+        # never block, regardless of negation
         return None
+
+    def _ip_nets(self):
+        """Parse @ipMatch's comma-separated IP/CIDR argument once; a
+        fully-invalid list yields None (operator abstains)."""
+        nets = getattr(self, "_ip_nets_cache", False)
+        if nets is not False:
+            return nets
+        import ipaddress
+        parsed = []
+        for part in self.arg.decode("ascii", "replace").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                parsed.append(ipaddress.ip_network(part, strict=False))
+            except ValueError:
+                # ANY malformed entry poisons the whole list → abstain:
+                # silently narrowing the list would under-match positive
+                # rules and OVER-FIRE negated ones (ModSecurity rejects
+                # the config outright; abstain is our fail-safe analog)
+                parsed = None
+                break
+        self._ip_nets_cache = parsed or None
+        return self._ip_nets_cache
 
 
     def _entry_name(self, entry, label=None) -> str:

@@ -278,6 +278,8 @@ class Request:
         s["method"] = self.method.encode("utf-8", "surrogateescape")
         if self.protocol:   # unknown protocol stays absent → abstain
             s["protocol"] = self.protocol.encode("utf-8", "surrogateescape")
+        if self.client_ip:  # REMOTE_ADDR (@ipMatch rules); absent→abstain
+            s["remote_addr"] = self.client_ip.encode("ascii", "replace")
         return s
 
 

@@ -306,3 +306,40 @@ def test_tx_only_rule_abstains_not_args():
                   '"id:949110,phase:2,block,severity:CRITICAL,'
                   'tag:\'attack-generic\'"')
     assert not p.detect([Request(uri="/q?n=7")])[0].attack
+
+
+def test_ipmatch_remote_addr():
+    """@ipMatch on REMOTE_ADDR (CRS 910-family shape): CIDR + single-IP
+    lists, negated form, and abstain when no client IP is known."""
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.normalize import Request
+
+    cr = compile_ruleset(parse_seclang(
+        'SecRule REMOTE_ADDR "@ipMatch 10.0.0.0/8,192.168.1.5" '
+        '"id:910100,phase:1,deny,severity:CRITICAL,'
+        "tag:'attack-generic'\""))
+    p = DetectionPipeline(cr, mode="block")
+    hit = p.detect([Request(uri="/x", client_ip="10.2.3.4",
+                            request_id="a")])[0]
+    assert hit.attack and hit.blocked
+    assert hit.matches[0]["var"] == "REMOTE_ADDR"
+    exact = p.detect([Request(uri="/x", client_ip="192.168.1.5",
+                              request_id="a2")])[0]
+    assert exact.attack
+    miss = p.detect([Request(uri="/x", client_ip="8.8.8.8",
+                             request_id="b")])[0]
+    assert not miss.attack
+    noip = p.detect([Request(uri="/x", request_id="c")])[0]
+    assert not noip.attack   # unknown source: abstain, never block
+
+    cr2 = compile_ruleset(parse_seclang(
+        'SecRule REMOTE_ADDR "!@ipMatch 10.0.0.0/8" '
+        '"id:910101,phase:1,deny,severity:CRITICAL,'
+        "tag:'attack-generic'\""))
+    p2 = DetectionPipeline(cr2, mode="block")
+    assert not p2.detect([Request(uri="/x", client_ip="10.9.9.9",
+                                  request_id="d")])[0].attack
+    assert p2.detect([Request(uri="/x", client_ip="1.2.3.4",
+                              request_id="e")])[0].attack
