@@ -504,6 +504,26 @@ def parse_seclang(
                 raise SecLangError("%s: @pmFromFile %r is empty" % (source, argument))
             operator, argument = "pm", "\n".join(phrases)
 
+        if operator == "ipMatchFromFile":
+            # resolved HERE like @pmFromFile: the operator rewrites to
+            # @ipMatch over the file's entries (one IP/CIDR per line,
+            # '#' comments) — CRS DoS/allowlist data-file shape
+            if base_dir is None:
+                raise SecLangError(
+                    "%s: @ipMatchFromFile %r needs base_dir"
+                    % (source, argument))
+            fp = (base_dir / argument).resolve()
+            if not fp.exists():
+                raise SecLangError(
+                    "%s: @ipMatchFromFile %r not found (resolved %s)"
+                    % (source, argument, fp))
+            entries = [w.strip() for w in fp.read_text().splitlines()
+                       if w.strip() and not w.startswith("#")]
+            if not entries:
+                raise SecLangError(
+                    "%s: @ipMatchFromFile %r is empty" % (source, argument))
+            operator, argument = "ipMatch", ",".join(entries)
+
         actions = _parse_actions(actions_txt)
         try:
             rid = int(actions.get("id", ["0"])[0] or 0)

@@ -343,3 +343,28 @@ def test_ipmatch_remote_addr():
                                   request_id="d")])[0].attack
     assert p2.detect([Request(uri="/x", client_ip="1.2.3.4",
                               request_id="e")])[0].attack
+
+
+def test_ipmatchfromfile_resolved_at_parse(tmp_path):
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import (
+        SecLangError, parse_seclang)
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.normalize import Request
+    import pytest
+
+    (tmp_path / "bad-ips.data").write_text(
+        "# scanner ranges\n10.0.0.0/8\n\n192.168.1.5\n")
+    rules = parse_seclang(
+        'SecRule REMOTE_ADDR "@ipMatchFromFile bad-ips.data" '
+        '"id:910110,phase:1,deny,severity:CRITICAL,'
+        "tag:'attack-generic'\"", base_dir=tmp_path)
+    assert rules[0].operator == "ipMatch"
+    p = DetectionPipeline(compile_ruleset(rules), mode="block")
+    assert p.detect([Request(uri="/x", client_ip="10.1.1.1",
+                             request_id="a")])[0].blocked
+    assert not p.detect([Request(uri="/x", client_ip="9.9.9.9",
+                                 request_id="b")])[0].attack
+    with pytest.raises(SecLangError):
+        parse_seclang('SecRule REMOTE_ADDR "@ipMatchFromFile nope.data" '
+                      '"id:1,phase:1,deny"', base_dir=tmp_path)
