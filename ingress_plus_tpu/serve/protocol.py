@@ -152,7 +152,17 @@ def encode_request(req: Request, req_id: int, mode: int = 2) -> bytes:
         mode |= MODE_GREYLIST
     method = req.method.encode()
     uri = req.uri.encode("utf-8", "surrogateescape")
-    hdr = headers_blob(req.headers)
+    headers = req.headers
+    if req.client_ip:
+        # symmetric with decode_request: the client IP rides the trusted
+        # plane as the shim-injected header.  The TRUSTED value always
+        # wins: any inbound copy of the header is dropped first, exactly
+        # like the C shim (an attacker-supplied copy would otherwise
+        # spoof ACL allow/deny/greylist decisions).
+        headers = {k: v for k, v in headers.items()
+                   if k.lower() != CLIENT_IP_HEADER}
+        headers[CLIENT_IP_HEADER] = req.client_ip
+    hdr = headers_blob(headers)
     payload = _REQ_HEAD.pack(req_id, req.tenant, mode, len(method),
                              len(uri), len(hdr), len(req.body))
     payload += method + uri + hdr + req.body
