@@ -440,6 +440,7 @@ class ConfirmRule:
             self.allowed_bytes = frozenset(allowed) if allowed else None
         self.chain = [ConfirmRule(c) for c in confirm.get("chain", [])]
         self._plan, self._exclusions = self._compile_targets()
+        self._matched_spec = self._parse_matched_spec()
 
     def _compile_targets(self):
         """raw_targets → ([(count, BASE, selector_or_None)], exclusions).
@@ -722,9 +723,52 @@ class ConfirmRule:
         chain, and the prefilter-loss gate evaluates EVERY rule per
         request, where the cache turns O(rules × transforms) into
         O(distinct chains × distinct values)."""
+        collect = any(link._matched_spec for link in self.chain)
+        hit, cur = self._self_match(streams, cache, extra_excl,
+                                    detail_out, collect)
+        if not hit:
+            return False
+        # chain: sequential, ModSecurity-style — every link must match,
+        # and each NORMAL link updates the matched-variable state that
+        # later links' MATCHED_* targets consume (each rule in a ModSec
+        # chain overwrites MATCHED_VARS with its own matches)
+        for i, link in enumerate(self.chain):
+            if link._matched_spec:
+                cur = link._eval_matched(cur)
+                if cur is None:
+                    return False
+                # the link's own matching SUBSET becomes the state its
+                # successors see (ModSecurity overwrites MATCHED_VARS
+                # with each rule's matches)
+            else:
+                need_next = any(l2._matched_spec
+                                for l2 in self.chain[i + 1:])
+                lh, lmv = link._self_match(streams, cache, extra_excl,
+                                           None, need_next)
+                if not lh:
+                    return False
+                if need_next:
+                    cur = lmv
+        return True
+
+    def _self_match(self, streams: Dict[str, bytes],
+                    cache: Optional[Dict],
+                    extra_excl: Optional[Dict],
+                    detail_out: Optional[list],
+                    collect: bool):
+        """THIS rule's own targets/operator only — no chain.
+
+        Returns ``(hit, matched)``; ``matched`` is the [(name, value)]
+        list of every EXACT matching variable when ``collect`` (the
+        MATCHED_* chain state).  Blob fallbacks and counts never enter
+        the list: a coarse stream blob is not a variable, and feeding it
+        to a negated/numeric MATCHED_VAR link would bypass the
+        exact-values-only restriction this method enforces for those
+        operators on its own targets."""
         hit = False
         restrict = self.negate or self.op in NUMERIC_OPS
         tkey = tuple(self.transforms)
+        matched: list = []
         for entry in self._plan:
             for text, exact, is_count, label in self._iter_entry(
                     entry, streams, cache, extra_excl):
@@ -754,11 +798,84 @@ class ConfirmRule:
                         detail_out.append(
                             (self._entry_name(entry, label),
                              snip[:100].decode("latin-1")))
+                    if collect:
+                        if exact and not is_count:
+                            matched.append(
+                                (self._entry_name(entry, label),
+                                 val if isinstance(val, bytes)
+                                 else str(val).encode()))
+                        continue   # keep scanning for further matches
                     break
-            if hit:
+            if hit and not collect:
                 break
-        if not hit:
-            return False
-        # chain: every link must also match (on its own targets/transforms)
-        return all(link.matches_streams(streams, cache, extra_excl)
-                   for link in self.chain)
+        return hit, matched
+
+    #: chain-link pseudo-targets resolved against the tracked matches
+    _MATCHED_BASES = {"MATCHED_VAR": ("one", "values"),
+                      "MATCHED_VARS": ("all", "values"),
+                      "MATCHED_VAR_NAME": ("one", "names"),
+                      "MATCHED_VARS_NAMES": ("all", "names")}
+
+    def _parse_matched_spec(self):
+        """Precomputed at construction: list of (scope, part, is_count)
+        — one per raw target token — when EVERY token is a MATCHED_*
+        pseudo-variable (the CRS chain-link shape); None otherwise.
+        '!'-excluded tokens are unsupported → None (normal evaluation,
+        which abstains on empty targets)."""
+        if not self.raw_targets:
+            return None
+        specs = []
+        for t in self.raw_targets:
+            t = t.strip()
+            if not t:
+                continue
+            if t.startswith("!"):
+                return None
+            is_count = t.startswith("&")
+            if is_count:
+                t = t[1:].strip()
+            sp = self._MATCHED_BASES.get(t.split(":", 1)[0].upper())
+            if sp is None:
+                return None
+            specs.append((sp[0], sp[1], is_count))
+        return specs or None
+
+    def _eval_matched(self, matched_vals):
+        """Evaluate this chain link against the tracked matched
+        (name, value) pairs — OR over its target tokens (ModSecurity
+        target-list semantics): MATCHED_VAR = the LAST match only,
+        MATCHED_VARS = all; *_NAME(S) compare variable names; the
+        &-count form compares the match COUNT (transforms don't apply
+        to counts).  Own transforms apply to value/name candidates;
+        negation is per candidate (every candidate exact by
+        construction — _self_match only collects exact variables).
+
+        Returns the SUBSET of ``matched_vals`` this link matched (the
+        state its chain successors see — ModSecurity overwrites
+        MATCHED_VARS with each rule's own matches), or None on no
+        match.  A count-token hit keeps its candidate set unchanged
+        (the match is the count, not any particular variable)."""
+        out: list = []
+        hit = False
+        for scope, part, is_count in self._matched_spec:
+            cands = matched_vals[-1:] if scope == "one" else matched_vals
+            if is_count:
+                m = self._op_match(str(len(cands)).encode())
+                if m is not None and m != self.negate:
+                    hit = True
+                    for c in cands:
+                        if c not in out:
+                            out.append(c)
+                continue
+            for name, val in cands:
+                cand = (name.encode("latin-1", "replace")
+                        if part == "names" else val)
+                v = apply_transforms(cand, self.transforms)
+                m = self._op_match(v)
+                if m is None:
+                    continue
+                if m != self.negate:
+                    hit = True
+                    if (name, val) not in out:
+                        out.append((name, val))
+        return out if hit else None

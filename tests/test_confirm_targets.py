@@ -368,3 +368,120 @@ def test_ipmatchfromfile_resolved_at_parse(tmp_path):
     with pytest.raises(SecLangError):
         parse_seclang('SecRule REMOTE_ADDR "@ipMatchFromFile nope.data" '
                       '"id:1,phase:1,deny"', base_dir=tmp_path)
+
+
+def test_matched_var_chain_links():
+    """CRS-style chains on MATCHED_VAR(S): the link re-tests the parent
+    rule's matched values, not the raw streams (these chains previously
+    never fired — the link abstained and killed the chain)."""
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.normalize import Request
+
+    def verdict(rules_txt, uri):
+        cr = compile_ruleset(parse_seclang(rules_txt))
+        p = DetectionPipeline(cr, mode="block")
+        return p.detect([Request(uri=uri, request_id="x")])[0]
+
+    chain = (
+        'SecRule ARGS "@rx (?i)select" "id:942050,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-sqli',chain\"\n"
+        'SecRule MATCHED_VAR "@rx (?i)from" "t:lowercase"\n')
+    # both legs present in the SAME matched value -> fires
+    v = verdict(chain, "/x?q=SELECT+password+FROM+users")
+    assert v.attack and 942050 in v.rule_ids
+    # link leg absent from the matched value -> chain must NOT fire
+    v = verdict(chain, "/x?q=SELECT+1")
+    assert not v.attack
+    # link leg in a DIFFERENT variable than the match -> MATCHED_VAR
+    # must not see it
+    v = verdict(chain, "/x?q=SELECT+1&r=from+me")
+    assert not v.attack
+
+    # negated link: fire only when the matched value LACKS the pattern
+    neg = (
+        'SecRule ARGS "@rx (?i)select" "id:942051,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-sqli',chain\"\n"
+        'SecRule MATCHED_VAR "!@rx (?i)benign_marker" ""\n')
+    assert verdict(neg, "/x?q=select+x").attack
+    assert not verdict(neg, "/x?q=select+benign_marker").attack
+
+    # MATCHED_VAR_NAME: constrain WHERE the parent matched
+    name_chain = (
+        'SecRule ARGS "@rx (?i)select" "id:942052,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-sqli',chain\"\n"
+        'SecRule MATCHED_VAR_NAME "@rx (?i)^args:pw$" ""\n')
+    assert verdict(name_chain, "/x?pw=select+1").attack
+    assert not verdict(name_chain, "/x?other=select+1").attack
+
+
+def test_matched_var_chain_semantics_deep():
+    """Round-4 review repros: count form counts matches (not atoi of a
+    value), a later link sees the PREVIOUS link's matches, and a mixed
+    names|values target list ORs across tokens."""
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.normalize import Request
+
+    def verdict(rules_txt, uri, headers=None):
+        cr = compile_ruleset(parse_seclang(rules_txt))
+        p = DetectionPipeline(cr, mode="block")
+        return p.detect([Request(uri=uri, headers=headers or {},
+                                 request_id="x")])[0]
+
+    # &MATCHED_VARS counts matches: one matching arg -> @gt 1 must NOT
+    # fire, even when the value starts with digits (the atoi trap)
+    count = (
+        'SecRule ARGS "@rx (?i)select" "id:942060,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-sqli',chain\"\n"
+        'SecRule &MATCHED_VARS "@gt 1" ""\n')
+    assert not verdict(count, "/x?q=5select").attack
+    assert verdict(count, "/x?q=5select&r=select+2").attack
+
+    # 3-link chain: the MATCHED_VAR link tests the SECOND rule's match
+    # (the header), not the first rule's args match
+    three = (
+        'SecRule ARGS "@rx (?i)select" "id:942061,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-sqli',chain\"\n"
+        'SecRule REQUEST_HEADERS "@rx (?i)evil" "chain"\n'
+        'SecRule MATCHED_VAR "@rx (?i)evilbot" ""\n')
+    v = verdict(three, "/x?q=select+1",
+                headers={"user-agent": "evilbot/1.0"})
+    assert v.attack and 942061 in v.rule_ids
+    assert not verdict(three, "/x?q=select+1",
+                       headers={"user-agent": "evil-but-not-bot"}).attack
+
+    # mixed names|values target list: the NAME leg alone must fire
+    mixed = (
+        'SecRule ARGS "@rx (?i)select" "id:942062,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-sqli',chain\"\n"
+        'SecRule MATCHED_VARS_NAMES|MATCHED_VARS "@rx (?i)pw" ""\n')
+    assert verdict(mixed, "/x?pw=select+1").attack
+    assert not verdict(mixed, "/x?other=select+1").attack
+
+
+def test_matched_var_state_narrows_through_chain():
+    """Round-4 review repro: a MATCHED_* link's own matching subset
+    becomes the state its successors see — link 2 rejecting variable r
+    means link 3's MATCHED_VAR can only be q."""
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.normalize import Request
+
+    rules = (
+        'SecRule ARGS "@rx (?i)select" "id:942063,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-sqli',chain\"\n"
+        'SecRule MATCHED_VARS "@rx (?i)foo" "chain"\n'
+        'SecRule MATCHED_VAR "@rx (?i)bar" ""\n')
+    p = DetectionPipeline(compile_ruleset(parse_seclang(rules)),
+                          mode="block")
+    # link2 matches only q(selectfoo); link3 then sees q, not r -> no bar
+    v = p.detect([Request(uri="/x?q=selectfoo&r=selectbar",
+                          request_id="a")])[0]
+    assert not v.attack, v
+    # and the positive case still fires when one variable has both legs
+    v = p.detect([Request(uri="/x?q=selectfoobar", request_id="b")])[0]
+    assert v.attack
