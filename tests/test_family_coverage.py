@@ -100,3 +100,31 @@ _MP_BODY = (b'------WebKitFormBoundary7MA4YWxk\r\n'
 def test_family_benign_not_blocked(pipeline, req):
     v = pipeline.detect([req])[0]
     assert not v.attack and not v.blocked, (v.classes, v.rule_ids)
+
+
+@pytest.mark.parametrize("want_rule,req,should_hit", [
+    # 910: static block list via @ipMatchFromFile
+    (910120, Request(uri="/x", client_ip="203.0.113.50"), True),
+    (910120, Request(uri="/x", client_ip="198.51.100.23"), True),
+    (910120, Request(uri="/x", client_ip="8.8.8.8"), False),
+    (910120, Request(uri="/x"), False),   # unknown source: abstain
+    # 910: anonymity net + tooling agent (chain)
+    (910140, Request(uri="/x", client_ip="198.51.100.200",
+                     headers={"user-agent": "curl/8.0"}), True),
+    (910140, Request(uri="/x", client_ip="198.51.100.200",
+                     headers={"user-agent": "Mozilla/5.0"}), False),
+    # 942470: SELECT + system catalog must share ONE input
+    (942470, Request(uri="/q?s=select+name+from+information_schema.tables"),
+     True),
+    (942470, Request(uri="/q?a=select+1&b=information_schema"), False),
+    # 942471: UNION then SELECT ... NULL in the same input
+    (942471, Request(uri="/q?u=1+union+select+null,null"), True),
+    (942471, Request(uri="/q?u=1+union+x&v=select+null"), False),
+])
+def test_ip_reputation_and_chain_rules(pipeline, want_rule, req,
+                                       should_hit):
+    v = pipeline.detect([req])[0]
+    if should_hit:
+        assert want_rule in v.rule_ids, (v.classes, v.rule_ids)
+    else:
+        assert want_rule not in v.rule_ids, (v.classes, v.rule_ids)
