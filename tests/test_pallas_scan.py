@@ -181,3 +181,32 @@ def test_sharded_pair_odd_length_padded():
                             np.zeros((2,), np.int32), sv,
                             np.zeros((2,), np.int32), 2)
     assert rh[0].any()
+
+
+def test_pallas_pair_full_pack_geometry():
+    """Interpret parity at the REAL bundled-pack geometry (500+ words,
+    100+ byte classes, padded K1p/Wp tiles) — the small fixture cannot
+    exercise the multi-tile padding paths the serving ruleset hits."""
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+    from ingress_plus_tpu.ops.pallas_scan import PallasPairScanner
+    from ingress_plus_tpu.ops.scan import scan_pairs
+
+    cr = compile_ruleset(load_bundled_rules())
+    t = ScanTables.from_bitap(cr.tables)
+    assert t.n_words > 400   # the point of this test
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    B, L = 4, 192
+    tokens = rng.integers(32, 127, (B, L)).astype(np.uint8)
+    atk = b"1' union select password from users -- "
+    tokens[0, :len(atk)] = np.frombuffer(atk, np.uint8)
+    tokens[2, 100:100 + len(atk)] = np.frombuffer(atk, np.uint8)
+    lengths = np.asarray([L, 37, L, 0], np.int32)
+
+    want_m, _ = scan_pairs(t, jnp.asarray(tokens), jnp.asarray(lengths))
+    ps = PallasPairScanner(t)
+    got_m, _ = ps(jnp.asarray(tokens), jnp.asarray(lengths),
+                  interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_m), np.asarray(want_m))
+    assert np.asarray(want_m)[0].any()   # non-vacuous
