@@ -242,6 +242,14 @@ def _parse_actions(text: str) -> Dict[str, List[str]]:
     return out
 
 
+def _phase_key(actions: Dict[str, List[str]]) -> str:
+    """The ONE normalized phase string (symbolic names mapped to their
+    numbers) — used for both SecDefaultAction storage and rule lookup,
+    so mixed numeric/symbolic notation can't break inheritance."""
+    txt = (actions.get("phase", ["2"])[0] or "2").strip("'\"")
+    return {"request": "2", "response": "4", "logging": "5"}.get(txt, txt)
+
+
 def _parse_targets(text: str) -> List[str]:
     """Target expression → stream names (prefilter sv-mask granularity).
 
@@ -314,6 +322,7 @@ def parse_seclang(
     base_dir: Optional[Path] = None,
     rules: Optional[List[Rule]] = None,
     _seen_includes: Optional[set] = None,
+    _phase_defaults: Optional[dict] = None,
 ) -> List[Rule]:
     """Parse SecLang text → list of top-level Rules (chains attached).
 
@@ -333,6 +342,8 @@ def parse_seclang(
         rules = []
     if _seen_includes is None:
         _seen_includes = set()
+    if _phase_defaults is None:
+        _phase_defaults = {}   # phase → (default action, default t: list)
     pending_chain: Optional[Rule] = None
 
     for line in _logical_lines(text):
@@ -374,7 +385,8 @@ def parse_seclang(
                 _seen_includes.add(key)
                 parse_seclang(conf.read_text(), source=str(conf),
                               base_dir=conf.parent, rules=rules,
-                              _seen_includes=_seen_includes)
+                              _seen_includes=_seen_includes,
+                              _phase_defaults=_phase_defaults)
             continue
         if directive == "SecAction":
             # config-plane rule (CRS crs-setup.conf shape): no scan
@@ -394,9 +406,21 @@ def parse_seclang(
                     argument="", targets=[], raw_targets=[],
                     action="pass", setvars=sv))
             continue
+        if directive == "SecDefaultAction":
+            # per-phase defaults subsequent SecRules inherit: the
+            # disruptive action (when a rule names none) and the
+            # transform chain (prepended unless the rule leads with
+            # t:none) — ModSecurity's inheritance model
+            acts = _parse_actions(tokens[1] if len(tokens) > 1 else "")
+            ph = _phase_key(acts)
+            d_action = next((a for a in ("deny", "block", "pass")
+                             if a in acts), None)
+            d_t = [v for v in acts.get("t", []) if v]
+            _phase_defaults[ph] = (d_action, d_t)
+            continue
         if directive in ("SecMarker", "SecComponentSignature",
                          "SecRuleEngine", "SecRequestBodyAccess",
-                         "SecDefaultAction", "SecCollectionTimeout"):
+                         "SecCollectionTimeout"):
             continue  # engine-control directives: no scan content
         if directive == "SecRuleRemoveById":
             # config-time removal (the FP-tuning workhorse of every real
@@ -529,13 +553,27 @@ def parse_seclang(
             rid = int(actions.get("id", ["0"])[0] or 0)
         except ValueError:
             raise SecLangError("%s: non-numeric rule id in %r" % (source, line))
-        transforms = [v for v in actions.get("t", []) if v and v != "none"]
+        raw_t = [v for v in actions.get("t", []) if v]
+        phase_txt = _phase_key(actions)
+        dflt = _phase_defaults.get(phase_txt)
+        # ModSecurity transform inheritance: t:none RESETS the chain —
+        # everything before the last t:none (inherited defaults
+        # included) is discarded; without any t:none the rule's list
+        # appends to the phase's SecDefaultAction transforms (the
+        # reason every CRS rule leads with t:none)
+        if "none" in raw_t:
+            raw_t = raw_t[len(raw_t) - raw_t[::-1].index("none"):]
+        elif dflt and dflt[1]:
+            raw_t = dflt[1] + raw_t
+        transforms = [v for v in raw_t if v != "none"]
         if "deny" in actions:
             action = "deny"
         elif "block" in actions:
             action = "block"
         elif "pass" in actions:
             action = "pass"
+        elif dflt and dflt[0]:
+            action = dflt[0]   # phase default (SecDefaultAction)
         else:
             action = "block"
         severity = (actions.get("severity", ["WARNING"])[0] or "WARNING").strip("'\"")
@@ -546,10 +584,6 @@ def parse_seclang(
             m = re.search(r"paranoia-level/(\d)", t)
             if m:
                 paranoia = int(m.group(1))
-        phase_txt = (actions.get("phase", ["2"])[0] or "2").strip("'\"")
-        # ModSecurity 2.7+ symbolic phase names map to their numbers
-        phase_txt = {"request": "2", "response": "4",
-                     "logging": "5"}.get(phase_txt, phase_txt)
         try:
             phase = int(phase_txt)
         except ValueError:
@@ -605,11 +639,13 @@ def load_seclang_dir(path: str | Path) -> List[Rule]:
     p = Path(path)
     rules: List[Rule] = []
     seen: set = set()
+    defaults: dict = {}   # SecDefaultAction state crosses files
     if p.is_file():
         seen.add(str(p.resolve()))
         return parse_seclang(p.read_text(), source=str(p),
                              base_dir=p.parent, rules=rules,
-                             _seen_includes=seen)
+                             _seen_includes=seen,
+                             _phase_defaults=defaults)
     for conf in sorted(p.glob("*.conf")):
         key = str(conf.resolve())
         if key in seen:
@@ -617,5 +653,5 @@ def load_seclang_dir(path: str | Path) -> List[Rule]:
         seen.add(key)
         parse_seclang(conf.read_text(), source=str(conf),
                       base_dir=conf.parent, rules=rules,
-                      _seen_includes=seen)
+                      _seen_includes=seen, _phase_defaults=defaults)
     return rules

@@ -425,3 +425,47 @@ def test_include_directive_loads_config_tree(tmp_path):
     import pytest
     with pytest.raises(SecLangError):
         load_seclang_dir(entry)
+
+
+def test_secdefaultaction_inheritance():
+    """SecDefaultAction per-phase defaults: disruptive action when a
+    rule names none, transforms prepended unless the rule leads with
+    t:none (the reason CRS rules all start with t:none)."""
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+
+    text = (
+        'SecDefaultAction "phase:2,pass,t:lowercase,t:urlDecodeUni"\n'
+        # inherits pass + both transforms
+        'SecRule ARGS "@rx select" "id:1,phase:2"\n'
+        # t:none resets the default transform chain
+        'SecRule ARGS "@rx select" "id:2,phase:2,t:none,t:trim,block"\n'
+        # appends to defaults (no leading t:none)
+        'SecRule ARGS "@rx select" "id:3,phase:2,t:trim"\n'
+        # phase 1 has no default: falls back to block, own transforms
+        'SecRule ARGS "@rx select" "id:4,phase:1"\n')
+    rules = {r.rule_id: r for r in parse_seclang(text)}
+    assert rules[1].action == "pass"
+    assert rules[1].transforms == ["lowercase", "urlDecodeUni"]
+    assert rules[2].action == "block"
+    assert rules[2].transforms == ["trim"]
+    assert rules[3].action == "pass"
+    assert rules[3].transforms == ["lowercase", "urlDecodeUni", "trim"]
+    assert rules[4].action == "block"
+    assert rules[4].transforms == []
+
+
+def test_secdefaultaction_symbolic_phase_and_midlist_none():
+    """Round-4 review repros: symbolic/numeric phase notation mixes
+    must still inherit, and a mid-list t:none resets everything before
+    it (defaults included)."""
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+
+    text = (
+        'SecDefaultAction "phase:request,pass,t:urlDecodeUni"\n'
+        'SecRule ARGS "@rx select" "id:1,phase:2"\n'
+        'SecRule ARGS "@rx select" '
+        '"id:2,phase:request,t:lowercase,t:none,t:trim"\n')
+    rules = {r.rule_id: r for r in parse_seclang(text)}
+    assert rules[1].action == "pass"            # symbolic->numeric mix
+    assert rules[1].transforms == ["urlDecodeUni"]
+    assert rules[2].transforms == ["trim"]      # mid-list reset
