@@ -97,7 +97,7 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
     from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
     from ingress_plus_tpu.models.engine import EngineTables
     from ingress_plus_tpu.models.pipeline import DetectionPipeline
-    from ingress_plus_tpu.ops.scan import pad_rows, scan_bytes
+    from ingress_plus_tpu.ops.scan import pad_rows
     from ingress_plus_tpu.serve.normalize import merge_rows, rows_for_requests
     from ingress_plus_tpu.utils.corpus import generate_corpus
     from ingress_plus_tpu.utils.microbench import best_time, k_diff_time

@@ -8,7 +8,6 @@ round-4 runtime: Acl longest-prefix decisions, the hot-swap endpoint,
 pipeline verdicts per mode, and the trusted client-ip plumbing.
 """
 
-import json
 
 import pytest
 

@@ -8,7 +8,6 @@ annotation parser tests with synthetic Ingress objects
 
 import json
 
-import numpy as np
 import pytest
 
 from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, compile_ruleset

@@ -3,7 +3,6 @@ indistinguishable at the rule-hit level, and the auto-select must
 install a working impl (VERDICT round-1: the Pallas kernel must sit in
 the serving path, not beside it)."""
 
-import numpy as np
 import pytest
 
 from ingress_plus_tpu.compiler.ruleset import compile_ruleset

@@ -5,7 +5,6 @@ benign corpus must (mostly) pass, streaming/monitoring/fail-open contracts
 hold.
 """
 
-import numpy as np
 import pytest
 
 from ingress_plus_tpu.compiler.ruleset import compile_ruleset

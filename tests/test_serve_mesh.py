@@ -3,7 +3,6 @@ sharded step behind the single-chip engine API, so the SAME pipeline /
 batcher / confirm chain serves multi-chip.  Runs on the virtual 8-device
 CPU mesh (conftest), the kind-cluster analog from SURVEY.md §4."""
 
-import numpy as np
 import pytest
 
 from ingress_plus_tpu.compiler.ruleset import compile_ruleset

@@ -8,7 +8,6 @@ e2e driving WTPI frames over a real UDS.
 import json
 import os
 import socket
-import struct
 import subprocess
 import sys
 import time
