@@ -34,7 +34,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ingress_plus_tpu.control.dbg")
     ap.add_argument("cmd",
                     choices=["conf", "health", "metrics", "tenants",
-                             "ruleset"])
+                             "ruleset", "acl"])
     ap.add_argument("--server", default="127.0.0.1:9901")
     ap.add_argument("--set", dest="set_json", default=None,
                     help="tenants: JSON tenant→tags table to push")
@@ -53,6 +53,14 @@ def main(argv=None) -> int:
         elif args.cmd == "tenants":
             if args.set_json:
                 out = _call(args.server, "/configuration/tenants",
+                            json.loads(args.set_json))
+            else:
+                out = _call(args.server, "/configuration")
+        elif args.cmd == "acl":
+            if args.set_json:
+                # push: {"acls": {name: {allow/deny/greylist: [cidr]}},
+                #        "tenant_acl": {"0": name}, "default": name}
+                out = _call(args.server, "/configuration/acl",
                             json.loads(args.set_json))
             else:
                 out = _call(args.server, "/configuration")

@@ -433,6 +433,17 @@ def test_acl_hot_swap_over_wire(server):
     r = verdict("/q?a=1;drop+table+users", "192.0.2.9", rid=8105)
     assert r["attack"] and not r["blocked"], r
 
+    # the dbg CLI drives the same lane (push + inspect)
+    from ingress_plus_tpu.control import dbg
+    rc = dbg.main(["acl", "--server", "127.0.0.1:19901", "--set",
+                   json.dumps({"acls": {"ops": {"deny": ["203.0.113.0/24"]}},
+                               "default": "ops"})])
+    assert rc == 0
+    conf = json.loads(urllib.request.urlopen(
+        "http://127.0.0.1:19901/configuration", timeout=10).read())
+    assert conf["acls"] == ["ops"]
+    assert dbg.main(["acl", "--server", "127.0.0.1:19901"]) == 0
+
     # clear ACLs so later tests see the original behavior
     req = urllib.request.Request(
         "http://127.0.0.1:19901/configuration/acl",
