@@ -300,7 +300,14 @@ class ServeLoop:
                     w.abort()
             for t in pending:
                 t.cancel()
-            writer.close()
+            try:
+                writer.close()
+            except RuntimeError:
+                # interpreter-shutdown race: asyncio.run() can close the
+                # loop while a connection's finally block still runs —
+                # the transport dies with the loop either way, and the
+                # traceback would pollute the driver's bench stderr
+                pass
             self.connections -= 1
 
     # ------------------------------------------------------ HTTP plane
