@@ -1,0 +1,154 @@
+"""Measure BASELINE config #1 — the CPU baseline the reference table
+demands we measure ourselves ("wallarm-mode=monitoring, libdetection
+SQLi only, wrk2 replay of 10k-request CRS test corpus"; the reference
+publishes no numbers, BASELINE.json "published": {}).
+
+Shape: monitoring mode (flag, never block), the full bundled pack with
+the strict-grammar confirm (libdetection analog) in the loop, a
+10k-request labeled corpus replayed by the C++ loadgen through the C++
+sidecar into the serve loop — the wrk2-replay analog on the UDS plane.
+CPU platform by construction: this IS the baseline the TPU path is
+measured against.
+
+Writes reports/CONFIG1_CPU_BASELINE.json.  Run:
+    python tools/config1_baseline.py [--requests 10000]
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import socket as socketmod
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ingress_plus_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=10_000)
+    ap.add_argument("--connections", type=int, default=4)
+    ap.add_argument("--inflight", type=int, default=8)
+    args = ap.parse_args()
+
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.batcher import Batcher
+    from ingress_plus_tpu.serve.server import ServeLoop
+    from ingress_plus_tpu.utils.export_corpus import export
+
+    sidecar_dir = os.path.join(REPO, "native", "sidecar")
+    subprocess.run(["make", "-s", "-C", sidecar_dir], check=True,
+                   capture_output=True, timeout=300)
+
+    t0 = time.time()
+    cr = compile_ruleset(load_bundled_rules())
+    print("ruleset: %d rules (%.1fs)" % (cr.n_rules, time.time() - t0),
+          file=sys.stderr)
+    pipeline = DetectionPipeline(cr, mode="monitoring")
+    batcher = Batcher(pipeline)
+
+    tmp = tempfile.mkdtemp(prefix="ipt_cfg1_")
+    srv_sock = os.path.join(tmp, "srv.sock")
+    side_sock = os.path.join(tmp, "side.sock")
+    serve = ServeLoop(batcher, srv_sock)
+    loop = asyncio.new_event_loop()
+
+    def runner():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(serve.start())
+        loop.run_forever()
+
+    threading.Thread(target=runner, daemon=True).start()
+
+    def wait_sock(path, timeout_s=60):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if os.path.exists(path):
+                try:
+                    s = socketmod.socket(socketmod.AF_UNIX)
+                    s.connect(path)
+                    s.close()
+                    return True
+                except OSError:
+                    pass
+            time.sleep(0.05)
+        return False
+
+    assert wait_sock(srv_sock), "serve loop socket never appeared"
+    sidecar = subprocess.Popen(
+        [os.path.join(sidecar_dir, "sidecar"), "--listen", side_sock,
+         "--upstream", srv_sock, "--deadline-ms", "30000"],
+        stderr=subprocess.DEVNULL)
+    try:
+        assert wait_sock(side_sock), "sidecar socket never appeared"
+        corpus_path = os.path.join(tmp, "c.bin")
+        export(corpus_path, n=args.requests, seed=17, attack_fraction=0.2)
+        from ingress_plus_tpu.utils.corpus import generate_corpus
+        n_attacks = sum(1 for lr in generate_corpus(
+            n=args.requests, attack_fraction=0.2, seed=17)
+            if lr.is_attack)
+        loadgen = os.path.join(sidecar_dir, "loadgen")
+        # warmup compiles the serving shapes out of the measurement
+        subprocess.run(
+            [loadgen, "--socket", side_sock, "--corpus", corpus_path,
+             "--connections", str(args.connections),
+             "--inflight", str(args.inflight), "--requests", "512"],
+            capture_output=True, timeout=600)
+        out = subprocess.run(
+            [loadgen, "--socket", side_sock, "--corpus", corpus_path,
+             "--connections", str(args.connections),
+             "--inflight", str(args.inflight),
+             "--requests", str(args.requests)],
+            capture_output=True, text=True, timeout=1800)
+        if out.returncode != 0:
+            print("loadgen rc=%d: %s" % (out.returncode,
+                                         out.stderr[-400:]),
+                  file=sys.stderr)
+            return 1
+        r = json.loads(out.stdout)
+        result = {
+            "config": ("BASELINE config #1: wallarm-mode=monitoring, "
+                       "strict-grammar (libdetection analog) confirm in "
+                       "the loop, loadgen replay of the labeled corpus "
+                       "(wrk2-replay analog), CPU platform"),
+            "requests": r["requests"],
+            "corpus_attacks": n_attacks,
+            "rps": r["rps"],
+            "p50_us": r["p50_us"], "p90_us": r["p90_us"],
+            "p99_us": r["p99_us"], "p999_us": r["p999_us"],
+            "fail_open": r["fail_open"],
+            "flagged": r["attacks"],
+            "blocked": r["blocked"],
+            "mode": "monitoring",
+            "ruleset": {"rules": int(cr.n_rules),
+                        "version": cr.version},
+            "concurrency": {"connections": args.connections,
+                            "inflight": args.inflight},
+            "host": "1-vCPU dev rig (the TPU path's comparison anchor)",
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        path = os.path.join(REPO, "reports", "CONFIG1_CPU_BASELINE.json")
+        with open(path, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(result), file=sys.stderr)
+        print("wrote %s" % path, file=sys.stderr)
+        return 0
+    finally:
+        sidecar.terminate()
+        loop.call_soon_threadsafe(loop.stop)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
