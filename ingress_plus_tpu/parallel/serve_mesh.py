@@ -74,6 +74,12 @@ class MeshEngine:
     SCAN_IMPLS = ShardedEngine.SCAN_IMPLS
 
     def __init__(self, cr: CompiledRuleset, mesh, scan_impl: str = "pair"):
+        if jax.process_count() > 1:
+            raise ValueError(
+                "MeshEngine serves a SINGLE-host mesh (its dispatch "
+                "builds host-local arrays); multi-host batches ride "
+                "parallel/dcn.py make_global into ShardedEngine.detect "
+                "instead — see tests/test_dcn.py")
         self.ruleset = cr
         self.mesh = mesh
         self._sharded = ShardedEngine(cr, mesh, scan_impl=scan_impl)
