@@ -206,4 +206,10 @@ def main(argv=None) -> None:
 
 
 if __name__ == "__main__":
+    # CPU oracle tool: never touch the (possibly dead) TPU tunnel —
+    # in-process forcing, since env vars alone are too late on this rig
+    # (see utils/platform.py)
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
     main()
