@@ -485,3 +485,37 @@ def test_matched_var_state_narrows_through_chain():
     # and the positive case still fires when one variable has both legs
     v = p.detect([Request(uri="/x?q=selectfoobar", request_id="b")])[0]
     assert v.attack
+
+
+def test_round4_semantics_survive_checkpoint(tmp_path):
+    """MATCHED_VAR chains and @ipMatch must behave identically after a
+    save/load hot-swap (the sync-node artifact path serializes confirm
+    specs; a silent downgrade here would only surface in production)."""
+    from ingress_plus_tpu.compiler.ruleset import (
+        CompiledRuleset, compile_ruleset)
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.normalize import Request
+
+    rules = (
+        'SecRule ARGS "@rx (?i)select" "id:942470,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-sqli',chain\"\n"
+        'SecRule MATCHED_VAR "@rx (?i)information_schema" '
+        '"t:lowercase"\n'
+        'SecRule REMOTE_ADDR "@ipMatch 10.0.0.0/8" '
+        '"id:910100,phase:1,deny,severity:CRITICAL,'
+        "tag:'attack-generic'\"\n")
+    cr = compile_ruleset(parse_seclang(rules))
+    cr.save(str(tmp_path / "ck"))
+    cr2 = CompiledRuleset.load(str(tmp_path / "ck"))
+    p = DetectionPipeline(cr2, mode="block")
+    hit = p.detect([Request(
+        uri="/q?s=select+x+from+information_schema.t",
+        request_id="a")])[0]
+    assert hit.attack and 942470 in hit.rule_ids
+    assert not p.detect([Request(
+        uri="/q?a=select+1&b=information_schema",
+        request_id="b")])[0].attack
+    ip = p.detect([Request(uri="/x", client_ip="10.1.2.3",
+                           request_id="c")])[0]
+    assert ip.attack and 910100 in ip.rule_ids
