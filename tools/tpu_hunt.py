@@ -161,8 +161,13 @@ def main() -> None:
         with open(base + ".json", "w") as f:
             json.dump(artifact, f, indent=1)
         log("artifact written: %s" % base + ".json")
-        if result.get("platform") == "tpu":
-            log("TPU-platform result captured; hunt complete")
+        if result.get("platform") not in (None, "cpu"):
+            # any non-CPU platform IS the chip on this rig — the axon
+            # PJRT plugin may report "axon" or "tpu" depending on
+            # version; demanding the literal "tpu" would loop forever
+            # re-benching a live chip
+            log("%s-platform result captured; hunt complete"
+                % result["platform"])
             return
         log("bench fell back to %s; continuing hunt"
             % result.get("platform"))
