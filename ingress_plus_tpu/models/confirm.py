@@ -20,10 +20,12 @@ import binascii
 import re
 from typing import Callable, Dict, List, Optional
 
+from ingress_plus_tpu.serve.bodyparse import flatten_json, parse_multipart
 from ingress_plus_tpu.serve.normalize import (
     html_entity_decode,
     url_decode_uni,
 )
+from ingress_plus_tpu.serve.unpack import SEP as _UNPACK_SEP
 
 _WS = b" \t\n\r\f\v"
 
@@ -287,12 +289,28 @@ def _looks_like_form(body: bytes) -> bool:
 
 
 def _body_content_type(streams: Dict[str, bytes],
-                       cache: Optional[Dict]) -> bytes:
-    """Lowercased Content-Type header value (b"" when absent)."""
+                       cache: Optional[Dict],
+                       raw: bool = False) -> bytes:
+    """Content-Type header value (b"" when absent).  ``raw=True`` keeps
+    the original case — the multipart boundary token is case-sensitive,
+    so the delimiter must come from the unlowered value."""
     for lo, _n, v in (_parse_collection("headers", streams, cache) or ()):
         if lo == b"content-type":
-            return v.lower()
+            return v if raw else v.lower()
     return b""
+
+
+def _parse_body_form(streams: Dict[str, bytes], cache: Optional[Dict]):
+    """Memoized multipart parse of the body stream (fields AND files
+    come from the one walk); None = present-but-unparseable (abstain)."""
+    ck = ("#mpform",)
+    if cache is not None and ck in cache:
+        return cache[ck]
+    form = parse_multipart(streams.get("body", b""),
+                           _body_content_type(streams, cache, raw=True))
+    if cache is not None:
+        cache[ck] = form
+    return form
 
 
 def _split_form(raw: bytes, decode: bool) -> List[tuple]:
@@ -365,21 +383,46 @@ def _parse_collection(kind: str, streams: Dict[str, bytes],
         if not blob:
             out = []
         elif b"multipart/form-data" in ct:
-            # splitting multipart on '&'/'=' fabricates pairs (round-3
-            # review); faithful node values need a multipart parser we
-            # don't model — abstain
-            out = None
+            # RFC 7578 part parsing (serve/bodyparse.py): non-file
+            # parts are ModSecurity's ARGS_POST; a malformed body
+            # abstains rather than fabricate pairs (round-3 review)
+            form = _parse_body_form(streams, cache)
+            out = None if form is None else [
+                (n.lower(), n, v) for n, v in form.fields]
+        elif b"json" in ct:
+            # JSON processor (ModSecurity analog): dotted json.path
+            # names feed ARGS_POST → the ARGS union.  The body stream
+            # may carry unpack's extra \x1f-joined segments — the JSON
+            # document is the base segment (valid JSON cannot contain
+            # a raw 0x1f byte, so the split is exact).  Honors the
+            # wallarm-parser-disable json bit like the unpack stage.
+            if b"json" in streams.get("parsers_off", b""):
+                out = []
+            else:
+                ent = flatten_json(blob.split(_UNPACK_SEP, 1)[0])
+                out = None if ent is None else [
+                    (n.lower(), n, v) for n, v in ent]
         elif (b"application/x-www-form-urlencoded" in ct
               or (not ct and _looks_like_form(blob))):
             out = _split_form(blob, decode=True)
         else:
-            # non-form body: ModSecurity's ARGS_POST is empty here (the
-            # JSON/XML processors feed different collections)
+            # non-form body: ModSecurity's ARGS_POST is empty here
+            # (the XML processor feeds a different collection)
             out = []
     elif kind == "files":
-        # same parsed values as bodyargs, separate kind so ARGS-family
-        # exclusions can't reach it (see _COLLECTION_BASES note)
-        out = _parse_collection("bodyargs", streams, cache)
+        # multipart file parts only (ModSecurity: FILES values are the
+        # client filenames, FILES_NAMES the field names); separate kind
+        # from bodyargs so ARGS-family exclusions can't reach it (see
+        # _COLLECTION_BASES note).  Non-multipart bodies faithfully
+        # have an empty FILES collection.
+        blob = streams.get("body")
+        ct = _body_content_type(streams, cache)
+        if blob and b"multipart/form-data" in ct:
+            form = _parse_body_form(streams, cache)
+            out = None if form is None else [
+                (n.lower(), n, fn) for n, fn in form.files]
+        else:
+            out = []
     elif kind == "args":
         # ModSecurity's ARGS is ARGS_GET ∪ ARGS_POST (round-3 review:
         # query-only counts fabricated '&ARGS @eq 0' hits on POSTs);
@@ -558,6 +601,20 @@ class ConfirmRule:
         stream = _SCALAR_BASES.get(base)
         if stream is None:
             return  # unknown base: abstain
+        if base == "REQUEST_BODY":
+            # ModSecurity: the multipart processor REPLACES the raw body
+            # — REQUEST_BODY is not populated on a parsed multipart POST
+            # (parts feed ARGS_POST/FILES instead).  Without this, every
+            # multipart body confirms 942170-shaped rules (it ends in
+            # "--boundary--") and every upload with a part Content-Type
+            # confirms 921120 response-splitting (a header-shaped line
+            # after CRLF) — observed blocking a benign file upload.  A
+            # MALFORMED multipart keeps the blob (None → fall through):
+            # framing desync must not blind raw-body rules.
+            ct = _body_content_type(streams, cache)
+            if (b"multipart/form-data" in ct
+                    and _parse_body_form(streams, cache) is not None):
+                return
         val = streams.get(stream)
         if val is None and stream in ("query", "filename", "basename"):
             # derivable from the raw uri when the caller passed only the

@@ -280,6 +280,13 @@ class Request:
             s["protocol"] = self.protocol.encode("utf-8", "surrogateescape")
         if self.client_ip:  # REMOTE_ADDR (@ipMatch rules); absent→abstain
             s["remote_addr"] = self.client_ip.encode("ascii", "replace")
+        if self.parsers_off:
+            # marker the confirm stage's body-processor selection reads
+            # (models/confirm.py JSON branch) so a wallarm-parser-disable
+            # location also switches off ARGS-from-JSON, matching the
+            # unpack stage's gating; matches no SecLang base, so rules
+            # never see it
+            s["parsers_off"] = ",".join(sorted(self.parsers_off)).encode()
         return s
 
 

@@ -1,0 +1,324 @@
+"""Multipart/form-data + JSON body → per-variable collections
+(round-5, VERDICT r04 item #2).
+
+ModSecurity's multipart and JSON body processors populate ARGS_POST /
+FILES / FILES_NAMES so 942-family per-variable rules, `&ARGS` counts,
+and exclusion selectors resolve on non-urlencoded POSTs (SURVEY.md §2.2
+ngx_http_wallarm_module unpack duties; libmodsecurity row).  Before
+round 5 the confirm stage abstained on multipart ARGS and mapped JSON
+to the raw body blob — these tests pin the exact-collection semantics
+of serve/bodyparse.py end to end through the pipeline.
+"""
+
+from __future__ import annotations
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.bodyparse import (
+    MAX_JSON_ARGS,
+    flatten_json,
+    multipart_boundary,
+    parse_multipart,
+)
+from ingress_plus_tpu.serve.normalize import Request
+
+
+def _pipeline(conf: str) -> DetectionPipeline:
+    return DetectionPipeline(compile_ruleset(parse_seclang(conf)),
+                             mode="block", anomaly_threshold=3)
+
+
+def _mp(fields, boundary=b"Xy12", files=()):
+    parts = []
+    for name, value in fields:
+        parts.append(b"--" + boundary + b"\r\n"
+                     b'Content-Disposition: form-data; name="' + name
+                     + b'"\r\n\r\n' + value + b"\r\n")
+    for name, filename, content in files:
+        parts.append(b"--" + boundary + b"\r\n"
+                     b'Content-Disposition: form-data; name="' + name
+                     + b'"; filename="' + filename + b'"\r\n'
+                     b"Content-Type: application/octet-stream\r\n\r\n"
+                     + content + b"\r\n")
+    return b"".join(parts) + b"--" + boundary + b"--\r\n"
+
+
+def _mp_request(fields, boundary=b"Xy12", files=()):
+    body = _mp(fields, boundary, files)
+    return Request(
+        method="POST", uri="/upload",
+        headers={"Content-Type":
+                 "multipart/form-data; boundary=" + boundary.decode()},
+        body=body)
+
+
+# ---------------------------------------------------------------- parser
+
+
+def test_multipart_fields_and_files():
+    form = parse_multipart(
+        _mp([(b"comment", b"hello world"), (b"page", b"3")],
+            files=[(b"photo", b"cat.jpg", b"\xff\xd8binary")]),
+        b"multipart/form-data; boundary=Xy12")
+    assert form is not None
+    assert form.fields == [(b"comment", b"hello world"), (b"page", b"3")]
+    assert form.files == [(b"photo", b"cat.jpg")]
+
+
+def test_multipart_quoted_boundary_and_lf_only():
+    assert multipart_boundary(
+        b'multipart/form-data; boundary="a b?c"') == b"a b?c"
+    body = (b"--B\nContent-Disposition: form-data; name=f\n\nv\n--B--\n")
+    form = parse_multipart(body, b"multipart/form-data; boundary=B")
+    assert form is not None and form.fields == [(b"f", b"v")]
+
+
+def test_multipart_preserves_crlf_inside_value():
+    body = _mp([(b"t", b"line1\r\nline2")])
+    form = parse_multipart(body, b"multipart/form-data; boundary=Xy12")
+    assert form.fields == [(b"t", b"line1\r\nline2")]
+
+
+def test_multipart_malformed_abstains():
+    ct = b"multipart/form-data; boundary=Xy12"
+    # no closing delimiter (truncated body)
+    assert parse_multipart(
+        b'--Xy12\r\nContent-Disposition: form-data; name="a"\r\n\r\nv\r\n',
+        ct) is None
+    # part with no Content-Disposition name
+    assert parse_multipart(
+        b"--Xy12\r\nContent-Type: text/plain\r\n\r\nv\r\n--Xy12--\r\n",
+        ct) is None
+    # boundary absent from the Content-Type
+    assert parse_multipart(_mp([(b"a", b"v")]),
+                           b"multipart/form-data") is None
+    # content after the closing delimiter
+    assert parse_multipart(
+        _mp([(b"a", b"v")]) + b"--Xy12\r\ntrailing", ct) is None
+
+
+def test_multipart_empty_filename_is_a_file_part():
+    """An empty file input submits filename="": still a FILES entry
+    (with an empty value), never an ARGS_POST field."""
+    form = parse_multipart(
+        _mp([], files=[(b"up", b"", b"")]),
+        b"multipart/form-data; boundary=Xy12")
+    assert form.fields == [] and form.files == [(b"up", b"")]
+
+
+def test_flatten_json_paths():
+    ent = flatten_json(b'{"a": {"b": 1}, "tags": ["x", "y"], '
+                       b'"ok": true, "none": null}')
+    assert ent == [(b"json.a.b", b"1"), (b"json.tags", b"x"),
+                   (b"json.tags", b"y"), (b"json.ok", b"true"),
+                   (b"json.none", b"")]
+    assert flatten_json(b"not json {") is None
+    # scalar root document
+    assert flatten_json(b'"hello"') == [(b"json", b"hello")]
+
+
+def test_flatten_json_bounds_abstain():
+    deep = b'{"k":' * 40 + b"1" + b"}" * 40
+    assert flatten_json(deep) is None
+    wide = (b"{" + b",".join(b'"k%d": 1' % i
+                             for i in range(MAX_JSON_ARGS + 1)) + b"}")
+    assert flatten_json(wide) is None
+
+
+# ------------------------------------------------- pipeline integration
+
+
+SQLI_ARGS = ('SecRule ARGS "@rx (?i)union\\s+select" '
+             '"id:942999,phase:2,block,t:urlDecodeUni,'
+             'severity:CRITICAL,tag:\'attack-sqli\'"')
+
+
+def test_942_fires_on_multipart_field():
+    """VERDICT item-2 'done' criterion: per-variable confirm fires on a
+    payload inside a multipart field."""
+    p = _pipeline(SQLI_ARGS)
+    v = p.detect([_mp_request([(b"q", b"1 UNION SELECT password"),
+                               (b"page", b"2")])])[0]
+    assert v.attack and v.rule_ids == [942999]
+    assert not p.detect([_mp_request([(b"q", b"just a comment")])])[0].attack
+
+
+def test_942_fires_on_json_string_field():
+    """...and inside a JSON string field, via the json.path collection."""
+    p = _pipeline(SQLI_ARGS)
+    atk = Request(method="POST", uri="/api",
+                  headers={"Content-Type": "application/json"},
+                  body=b'{"filter": {"q": "1 UNION SELECT pass"}}')
+    v = p.detect([atk])[0]
+    assert v.attack and v.rule_ids == [942999]
+    ok = Request(method="POST", uri="/api",
+                 headers={"Content-Type": "application/json"},
+                 body=b'{"filter": {"q": "union of two sets"}}')
+    assert not p.detect([ok])[0].attack
+
+
+def test_args_count_resolves_on_multipart():
+    """`&ARGS` no longer abstains on multipart POSTs (VERDICT item 2)."""
+    p = _pipeline('SecRule &ARGS "@gt 2" '
+                  '"id:920998,phase:2,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    assert p.detect([_mp_request([(b"a", b"1"), (b"b", b"2"),
+                                  (b"c", b"3")])])[0].attack
+    assert not p.detect([_mp_request([(b"a", b"1")])])[0].attack
+
+
+def test_exclusion_reaches_multipart_and_json_fields():
+    """!ARGS:x / !ARGS:json.path exclusions narrow the parsed body
+    collections exactly like query args."""
+    conf = (SQLI_ARGS
+            + '\nSecRuleUpdateTargetById 942999 "!ARGS:trusted"'
+            + '\nSecRuleUpdateTargetById 942999 "!ARGS:json.trusted"')
+    p = _pipeline(conf)
+    # excluded multipart field → no fire; other field → fire
+    assert not p.detect(
+        [_mp_request([(b"trusted", b"1 UNION SELECT x")])])[0].attack
+    assert p.detect(
+        [_mp_request([(b"other", b"1 UNION SELECT x")])])[0].attack
+    # excluded JSON path → no fire; sibling path → fire
+    def js(body):
+        return Request(method="POST", uri="/api",
+                       headers={"Content-Type": "application/json"},
+                       body=body)
+    assert not p.detect(
+        [js(b'{"trusted": "1 UNION SELECT x"}')])[0].attack
+    assert p.detect([js(b'{"q": "1 UNION SELECT x"}')])[0].attack
+
+
+def test_files_collections_from_multipart():
+    """FILES matches the client filename, FILES_NAMES the field name;
+    field values never leak into FILES."""
+    p = _pipeline('SecRule FILES "@rx (?i)\\.phps?$" '
+                  '"id:920997,phase:2,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    atk = _mp_request([(b"note", b"see attached")],
+                      files=[(b"upload", b"shell.php", b"<?php ?>")])
+    assert p.detect([atk])[0].attack
+    ok = _mp_request([(b"note", b"innocent.php mention")],
+                     files=[(b"upload", b"cat.jpg", b"\xff\xd8")])
+    assert not p.detect([ok])[0].attack
+    p2 = _pipeline('SecRule FILES_NAMES "@streq backdoor" '
+                   '"id:920996,phase:2,block,severity:CRITICAL,'
+                   'tag:\'attack-protocol\'"')
+    assert p2.detect([_mp_request(
+        [], files=[(b"backdoor", b"x.txt", b"hi")])])[0].attack
+
+
+def test_disposition_param_spoofing_rejected():
+    """A 'name=' token hidden inside ANOTHER parameter's quoted value
+    must not override the real field name (review finding: a findall
+    parser let xp="name=trusted" spoof the part name past !ARGS:x
+    exclusions — ModSecurity parses parameters sequentially)."""
+    body = (b"--B\r\n"
+            b'Content-Disposition: form-data; name="x"; '
+            b'xp="name=trusted"\r\n\r\npayload\r\n--B--\r\n')
+    form = parse_multipart(body, b"multipart/form-data; boundary=B")
+    assert form.fields == [(b"x", b"payload")]
+    # duplicated name=: first occurrence wins, no override
+    body2 = (b"--B\r\n"
+             b'Content-Disposition: form-data; name="x"; '
+             b'name="trusted"\r\n\r\npayload\r\n--B--\r\n')
+    form2 = parse_multipart(body2, b"multipart/form-data; boundary=B")
+    assert form2.fields == [(b"x", b"payload")]
+
+
+def test_boundary_spoofing_rejected():
+    """A 'boundary=' inside another Content-Type parameter's quotes must
+    not become the delimiter (review finding: the fake framing parsed
+    cleanly, suppressing REQUEST_BODY while the backend parsed the real
+    boundary's parts)."""
+    assert multipart_boundary(
+        b'multipart/form-data; x="boundary=AAA"; boundary=real') == b"real"
+    body = (b"--AAA\r\n"
+            b'Content-Disposition: form-data; name="trusted"\r\n\r\n'
+            b"--real\r\n"
+            b'Content-Disposition: form-data; name="q"\r\n\r\n'
+            b"1 UNION SELECT x\r\n--real--\r\n"
+            b"\r\n--AAA--\r\n")
+    form = parse_multipart(
+        body, b'multipart/form-data; x="boundary=AAA"; boundary=real')
+    # parsed with the REAL boundary: the attack part is a variable
+    assert form is not None and (b"q", b"1 UNION SELECT x") in form.fields
+
+
+def test_mid_line_delimiter_does_not_fabricate_parts():
+    """RFC 2046: a delimiter counts only at line start — 'junk--B' must
+    not open a part an RFC parser (e.g. Go mime/multipart) would never
+    see (review finding: fabricated pairs broke the never-fabricate
+    contract and wrongly suppressed REQUEST_BODY)."""
+    body = (b'junk--B\r\nContent-Disposition: form-data; name="x"'
+            b"\r\n\r\nv\r\n--B--\r\n")
+    form = parse_multipart(body, b"multipart/form-data; boundary=B")
+    # everything before the first LINE-START delimiter is preamble, so
+    # an RFC parser sees zero parts here — and so do we (no fabricated
+    # (x, v) pair)
+    assert form is not None and form.fields == [] and form.files == []
+    # ...but a preamble on its OWN line before the first delimiter is
+    # legal and ignored
+    ok = (b"preamble line\r\n--B\r\n"
+          b'Content-Disposition: form-data; name="x"\r\n\r\nv\r\n--B--\r\n')
+    form = parse_multipart(ok, b"multipart/form-data; boundary=B")
+    assert form is not None and form.fields == [(b"x", b"v")]
+
+
+def test_parsed_multipart_suppresses_request_body():
+    """ModSecurity: the multipart processor REPLACES the raw body, so
+    REQUEST_BODY rules must not confirm on a parsed multipart POST —
+    without this every body fires 942170-shaped rules on its own
+    '--boundary--' epilogue and every upload with a part Content-Type
+    fires 921120 response-splitting (observed blocking a benign
+    upload).  A MALFORMED multipart keeps the raw-blob superset."""
+    p = _pipeline('SecRule REQUEST_BODY "@rx (?i)[\\r\\n]\\W*?'
+                  'content-type:" '
+                  '"id:921999,phase:2,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    ok = _mp_request([(b"note", b"holiday pics")],
+                     files=[(b"photo", b"cat.jpg", b"\xff\xd8")])
+    assert not p.detect([ok])[0].attack
+    # same bytes, framing broken (no closing delimiter): blob fallback
+    raw = ok.body.rsplit(b"--Xy12--", 1)[0]
+    bad = Request(method="POST", uri="/upload",
+                  headers=dict(ok.headers), body=raw)
+    assert p.detect([bad])[0].attack
+
+
+def test_executable_upload_rules_cover_both_framings():
+    """922130 (FILES exact) fires on a parsed upload; 922131 (raw-body
+    twin) fires when framing desync makes the parser abstain, including
+    the BARE filename= form (review finding: the quoted-only tail let
+    an unquoted token slip)."""
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset as _cc
+    p = DetectionPipeline(_cc(load_bundled_rules()), mode="block")
+    parsed = _mp_request([], files=[(b"up", b"shell.php", b"<?php ?>")])
+    v = p.detect([parsed])[0]
+    assert 922130 in v.rule_ids and v.blocked
+    malformed = Request(
+        method="POST", uri="/upload",
+        headers={"Content-Type": "multipart/form-data; boundary=Xy12"},
+        body=b"--Xy12\r\nContent-Disposition: form-data; name=f; "
+             b"filename=shell.php\r\n\r\nx\r\n")   # no closing delimiter
+    v2 = p.detect([malformed])[0]
+    assert 922131 in v2.rule_ids and v2.blocked
+
+
+def test_parser_disable_switches_off_json_args():
+    """The wallarm-parser-disable json bit gates ARGS-from-JSON like it
+    gates the unpack stage: with the parser off the collection is
+    faithfully empty, so a count rule sees 0."""
+    p = _pipeline('SecRule &ARGS_POST "@eq 0" '
+                  '"id:920995,phase:2,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    body = b'{"a": 1}'
+    on = Request(method="POST", uri="/api",
+                 headers={"Content-Type": "application/json"}, body=body)
+    assert not p.detect([on])[0].attack
+    off = Request(method="POST", uri="/api",
+                  headers={"Content-Type": "application/json"},
+                  body=body, parsers_off=frozenset({"json"}))
+    assert p.detect([off])[0].attack

@@ -176,10 +176,10 @@ def test_encoded_separator_does_not_fabricate_args():
 def test_body_args_counts_follow_content_type():
     """ARGS_POST counts mirror ModSecurity's body-processor selection:
     an urlencoded body (by Content-Type, any size) parses into real
-    values; a multipart body ABSTAINS (we don't model its parser —
-    splitting it on '&'/'=' fabricated pairs, review finding); a JSON
-    body faithfully has an EMPTY ARGS_POST (its processor feeds a
-    different collection)."""
+    values; a well-formed multipart body parses into per-part values
+    (round-5: serve/bodyparse.py — previously abstained); a JSON body
+    feeds dotted json.path ARGS through the JSON processor; a MALFORMED
+    multipart body still abstains (never fabricate pairs or a count)."""
     p = _pipeline('SecRule &ARGS_POST "@eq 0" '
                   '"id:920991,phase:2,block,severity:CRITICAL,'
                   'tag:\'attack-protocol\'"')
@@ -189,18 +189,30 @@ def test_body_args_counts_follow_content_type():
     assert not p.detect([Request(method="POST", uri="/f",
                                  headers=ct_form,
                                  body=big_form)])[0].attack
-    # multipart: abstain, never fabricate pairs or a zero count
+    # well-formed multipart: one real ARGS_POST variable -> no @eq 0
     mp = Request(method="POST", uri="/f",
                  headers={"Content-Type":
                           "multipart/form-data; boundary=xYz"},
                  body=b'--xYz\r\nContent-Disposition: form-data; '
                       b'name="f"\r\n\r\nv=1\r\n--xYz--\r\n')
     assert not p.detect([mp])[0].attack
-    # JSON body: ARGS_POST is faithfully empty -> @eq 0 fires
+    # malformed multipart (no closing delimiter): abstain, not zero
+    bad = Request(method="POST", uri="/f",
+                  headers={"Content-Type":
+                           "multipart/form-data; boundary=xYz"},
+                  body=b'--xYz\r\nContent-Disposition: form-data; '
+                       b'name="f"\r\n\r\nv=1\r\n')
+    assert not p.detect([bad])[0].attack
+    # JSON body: the processor populates json.a -> count is 1, not 0
     js = Request(method="POST", uri="/f",
                  headers={"Content-Type": "application/json"},
                  body=b'{"a": 1}')
-    assert p.detect([js])[0].attack
+    assert not p.detect([js])[0].attack
+    # invalid JSON with a json Content-Type: abstain, not zero
+    badjs = Request(method="POST", uri="/f",
+                    headers={"Content-Type": "application/json"},
+                    body=b'{"a": ')
+    assert not p.detect([badjs])[0].attack
 
 
 def test_args_union_includes_post_args():

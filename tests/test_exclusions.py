@@ -229,9 +229,10 @@ SecRuleUpdateTargetById 942900 "!ARGS:trusted"
 
 def test_args_exclusion_does_not_reach_files():
     """ModSecurity's ARGS exclusions never touch FILES: an '!ARGS:photo'
-    exclusion must not suppress an upload rule matching the form field
-    of the same name (review finding — FILES shared the bodyargs
-    exclusion namespace)."""
+    exclusion must not suppress an upload rule matching the multipart
+    file part of the same field name (review finding — FILES shared the
+    bodyargs exclusion namespace; round-5: FILES now comes from the real
+    multipart parser, serve/bodyparse.py)."""
     text = """
 SecRule FILES "@rx \\.php$" \\
     "id:920460,phase:2,block,t:lowercase,severity:CRITICAL,tag:'attack-protocol'"
@@ -240,9 +241,21 @@ SecRuleUpdateTargetById 920460 "!ARGS:photo"
     p = _pipeline(text)
     req = Request(
         method="POST", uri="/up",
+        headers={"Content-Type": "multipart/form-data; boundary=Bnd"},
+        body=b'--Bnd\r\n'
+             b'Content-Disposition: form-data; name="photo"; '
+             b'filename="shell.PHP"\r\n'
+             b'Content-Type: application/octet-stream\r\n\r\n'
+             b'<?php system($_GET[0]); ?>\r\n'
+             b'--Bnd--\r\n')
+    assert p.detect([req])[0].attack
+    # urlencoded bodies have a faithfully EMPTY FILES collection: the
+    # same rule must not fire on a mere form field mentioning .php
+    form = Request(
+        method="POST", uri="/up",
         headers={"Content-Type": "application/x-www-form-urlencoded"},
         body=b"photo=shell.php")
-    assert p.detect([req])[0].attack
+    assert not p.detect([form])[0].attack
 
 
 def test_fingerprint_covers_exclusions():
