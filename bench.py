@@ -85,6 +85,35 @@ def probe_backend(timeouts=(60, 90, 120, 120), waits=(30, 45, 60)):
     return "cpu", "tpu-unavailable: %s" % last_err[:300]
 
 
+def load_fixed_pack():
+    """The FROZEN round-3 rule pack (VERDICT r04 item #3): the r03 conf
+    tree plus the r03 sigpack generator, both committed verbatim under
+    ``bench_fixtures/pack_r03/`` at commit 3c10aaf's content.  Compiles
+    to exactly the pack BENCH_r03 measured — 1405 rules / 1233 factors /
+    343 scan words — so a throughput number on it is comparable across
+    rounds regardless of how the live pack grows (r04's 2.4x CPU drop
+    was unattributable because only the current pack was measured)."""
+    import importlib.util
+
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import load_seclang_dir
+
+    fix = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "bench_fixtures", "pack_r03")
+    spec = importlib.util.spec_from_file_location(
+        "bench_sigpack_r03", os.path.join(fix, "sigpack_r03.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rules = load_seclang_dir(os.path.join(fix, "crs"))
+    return compile_ruleset(rules + mod.generate_signature_rules())
+
+
+#: BENCH_r03.json's measured CPU anchor on this frozen pack (scan_impl
+#: pair, 2048-req corpus) — the cross-round comparison point
+R03_REFERENCE = {"req_per_s": 5013.3, "platform": "cpu",
+                 "scan_impl": "pair"}
+
+
 def run_bench(force_cpu_err: str | None = None) -> dict:
     """Measure and return the result dict.  ``force_cpu_err`` non-None
     means a prior attempt failed at dispatch time despite a good probe
@@ -142,29 +171,43 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
     # Length bucketing: corpus rows average ~0.3KB with a long tail; one
     # padded (B, 512) batch would be ~85% padding.  The serve batcher does
     # the same bucketing online.
-    n_sv = cr.rule_sv_mask.shape[1]
     edges = DetectionPipeline.L_BUCKETS  # identical tiers to production
-    buckets = {}
-    for i, d in enumerate(data_list):
-        for edge in edges:
-            if len(d) <= edge or edge == edges[-1]:
-                buckets.setdefault(edge, []).append(i)
-                break
+
+    def build_device_buckets(cr_x, dat, req_ids, svs, verbose=False):
+        """Bucket + pad + device_put merged rows for one ruleset — the
+        ONE buffer-building path shared by the live-pack and fixed-pack
+        legs (review finding: a copy diverging between legs would skew
+        exactly the cross-round comparability the fixed leg exists
+        for)."""
+        n_sv_x = cr_x.rule_sv_mask.shape[1]
+        bks: dict = {}
+        for i, d in enumerate(dat):
+            for edge in edges:
+                if len(d) <= edge or edge == edges[-1]:
+                    bks.setdefault(edge, []).append(i)
+                    break
+        bufs = []
+        for edge, idxs in sorted(bks.items()):
+            rws = [dat[i][:edge] for i in idxs]
+            tokens, lengths = pad_rows(rws, max_len=edge, round_to=edge)
+            row_sv = np.zeros((len(rws), n_sv_x), np.int8)
+            for j, i in enumerate(idxs):
+                row_sv[j, svs[i]] = 1
+            bufs.append((
+                jax.device_put(tokens.astype(np.int32)),
+                jax.device_put(lengths),
+                jax.device_put(np.asarray([req_ids[i] for i in idxs],
+                                          np.int32)),
+                jax.device_put(row_sv),
+            ))
+            if verbose:
+                log("bucket %4dB: %d rows" % (edge, len(rws)))
+        return tuple(bufs)
+
+    n_sv = cr.rule_sv_mask.shape[1]
     tables = EngineTables.from_ruleset(cr)
-    device_buckets = []
-    for edge, idxs in sorted(buckets.items()):
-        rows = [data_list[i][:edge] for i in idxs]
-        tokens, lengths = pad_rows(rows, max_len=edge, round_to=edge)
-        row_sv = np.zeros((len(rows), n_sv), np.int8)
-        for j, i in enumerate(idxs):
-            row_sv[j, sv_list[i]] = 1
-        device_buckets.append((
-            jax.device_put(tokens.astype(np.int32)),
-            jax.device_put(lengths),
-            jax.device_put(np.asarray([req_list[i] for i in idxs], np.int32)),
-            jax.device_put(row_sv),
-        ))
-        log("bucket %4dB: %d rows" % (edge, len(rows)))
+    device_buckets = build_device_buckets(cr, data_list, req_list,
+                                          sv_list, verbose=True)
 
     from ingress_plus_tpu.models.engine import detect_rows, map_match_words
 
@@ -350,6 +393,73 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
     result = _HEADLINE
     result["impls"] = impl_stats
     log("scan impl winner: %s (%s)" % (best_impl, impl_stats))
+
+    # fixed-pack leg (VERDICT r04 item #3): the SAME throughput
+    # measurement on the frozen r03 pack, always scan_impl=pair (the
+    # r01-r04 winner on both platforms) so the number is comparable
+    # round over round — this is what separates "the code got slower"
+    # from "the pack got bigger".  Never fatal; headline already stashed.
+    try:
+        if _budget_left() < 75:
+            log("fixed-pack leg skipped: %.0fs budget left" % _budget_left())
+        else:
+            t0f = time.time()
+            cr_fix = load_fixed_pack()
+            log("fixed pack: %d rules, %d factors, %d words (compiled "
+                "in %.1fs)" % (cr_fix.n_rules, cr_fix.tables.n_factors,
+                               cr_fix.tables.n_words, time.time() - t0f))
+            pipe_fix = DetectionPipeline(cr_fix)
+            rows_f = rows_for_requests(requests,
+                                       needed_sv=pipe_fix.needed_sv)
+            dlist, rlist, svlist = merge_rows(rows_f)
+            tables_f = EngineTables.from_ruleset(cr_fix)
+            bufs_f = build_device_buckets(cr_fix, dlist, rlist, svlist)
+            dk_fix = make_detect_k("pair")
+
+            def timed_f(k: int) -> float:
+                return best_time(
+                    lambda kk, rep: dk_fix(kk, tables_f, bufs_f), k, n=3)
+
+            f_lo = timed_f(1)
+            share = max(10.0, _budget_left() * 0.20)
+            itf = max(2, min(iters, int(share / (4 * max(f_lo, 1e-4)))))
+            f_hi = timed_f(itf)
+            f_delta = f_hi - f_lo
+            if f_delta > 0.05:
+                f_per_batch = f_delta / (itf - 1)
+                f_rps = n_req / f_per_batch
+                fixed = {
+                    "pack": "bench_fixtures/pack_r03 (frozen r03 "
+                            "ruleset: conf tree + r03 sigpack generator)",
+                    "rules": int(cr_fix.n_rules),
+                    "words": int(cr_fix.tables.n_words),
+                    "scan_impl": "pair",
+                    "req_per_s": round(f_rps, 1),
+                    "platform": platform,
+                    "r03_reference": R03_REFERENCE,
+                }
+                cur_pair = impl_stats.get("pair") or best_rps
+                if platform == "cpu" and cur_pair:
+                    fixed["attribution"] = (
+                        "frozen 1405-rule r03 pack on current code: %.0f "
+                        "req/s vs r03's measured %.0f -> code delta "
+                        "%.2fx; current %d-rule pack: %.0f req/s -> "
+                        "pack-size delta %.2fx; the r03->r04 CPU "
+                        "regression decomposes into exactly these two "
+                        "factors"
+                        % (f_rps, R03_REFERENCE["req_per_s"],
+                           f_rps / R03_REFERENCE["req_per_s"],
+                           cr.n_rules, cur_pair, f_rps / cur_pair))
+                result["fixed_pack"] = fixed
+                _HEADLINE = dict(result)
+                log("fixed-pack (1405 rules, pair): %.2f ms/batch -> "
+                    "%.0f req/s%s" % (f_per_batch * 1e3, f_rps,
+                                      "; " + fixed.get("attribution", "")))
+            else:
+                log("fixed-pack leg: no signal (delta %.1f ms at K=%d)"
+                    % (f_delta * 1e3, itf))
+    except Exception as e:
+        log("fixed-pack leg failed (non-fatal): %r" % (e,))
 
     # per-bucket MB/s diagnostics (stderr only; never fatal)
     try:

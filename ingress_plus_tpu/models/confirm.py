@@ -562,11 +562,17 @@ class ConfirmRule:
                 # exact 0 would false-fire "@eq 0" rules (review
                 # finding); positive pattern ops keep the blob superset
                 if not count and sel is None:
+                    # "files" is deliberately ABSENT: a FILES rule's
+                    # bare extension pattern against the raw body blob
+                    # fired on benign text ("run setup.sh after
+                    # install") in any truncated multipart (review
+                    # finding) — the context-anchored REQUEST_BODY twin
+                    # rules (922131) own the malformed-framing case
                     coarse = {"headers": "headers", "cookies": "headers",
                               "args": "args", "queryargs": "args",
-                              "bodyargs": "body", "files": "body",
-                              "resp_headers": "resp_headers"}[kind]
-                    blob = streams.get(coarse)
+                              "bodyargs": "body",
+                              "resp_headers": "resp_headers"}.get(kind)
+                    blob = streams.get(coarse) if coarse else None
                     if blob:
                         yield blob, False, False, None
                 return

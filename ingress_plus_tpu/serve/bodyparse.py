@@ -178,12 +178,19 @@ def parse_multipart(body: bytes,
             part = chunk[1:]
         else:
             return None
-        sep = part.find(b"\r\n\r\n")
-        skip = 4
-        if sep < 0:
-            sep = part.find(b"\n\n")
-            skip = 2
-        if sep < 0 or sep > MAX_PART_HEADER_BYTES:
+        # header/value boundary = the EARLIEST blank line, CRLF or LF
+        # framed (review finding: preferring \r\n\r\n let an LF-framed
+        # part hide its real value before a later CRLFCRLF, swallowing
+        # the payload into the discarded header block)
+        a = part.find(b"\r\n\r\n")
+        b = part.find(b"\n\n")
+        if a >= 0 and (b < 0 or a < b):
+            sep, skip = a, 4
+        elif b >= 0:
+            sep, skip = b, 2
+        else:
+            return None
+        if sep > MAX_PART_HEADER_BYTES:
             return None
         # the CRLF preceding the next delimiter was consumed by the
         # split, so the remainder IS the exact part value

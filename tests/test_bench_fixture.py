@@ -1,0 +1,31 @@
+"""Frozen r03 bench fixture (VERDICT r04 item #3).
+
+The fixed-pack throughput leg is only meaningful if the fixture keeps
+compiling to the EXACT pack BENCH_r03 measured — 1405 rules / 1233
+factors / 343 scan words.  A drift here (conf edit, sigpack change
+leaking in, compiler behavior change on old syntax) silently breaks
+cross-round comparability, which is the leg's whole purpose.
+"""
+
+from __future__ import annotations
+
+import bench
+
+
+def test_fixed_pack_dimensions_pinned():
+    cr = bench.load_fixed_pack()
+    assert cr.n_rules == 1405
+    assert cr.tables.n_factors == 1233
+    assert cr.tables.n_words == 343
+
+
+def test_fixed_pack_detects_classic_payloads():
+    """The frozen pack must stay a WORKING ruleset, not just a blob
+    with the right dimensions."""
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.normalize import Request
+
+    p = DetectionPipeline(bench.load_fixed_pack(), mode="block")
+    assert p.detect([Request(uri="/q?id=1' UNION SELECT password--")])[0].attack
+    assert p.detect([Request(uri="/q?x=<script>alert(1)</script>")])[0].attack
+    assert not p.detect([Request(uri="/blog?title=hello world")])[0].attack

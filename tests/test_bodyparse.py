@@ -80,6 +80,40 @@ def test_multipart_preserves_crlf_inside_value():
     assert form.fields == [(b"t", b"line1\r\nline2")]
 
 
+def test_lf_framed_part_value_not_swallowed():
+    """The header/value boundary is the EARLIEST blank line, CRLF or LF
+    framed (review finding: preferring \\r\\n\\r\\n let an LF-framed part
+    hide its payload before a later CRLFCRLF — the value vanished into
+    the discarded header block while the successful parse suppressed
+    REQUEST_BODY, bypassing every per-variable confirm)."""
+    body = (b'--B\nContent-Disposition: form-data; name="q"\n\n'
+            b"1 UNION SELECT pass\r\n\r\ntail\n--B--\n")
+    form = parse_multipart(body, b"multipart/form-data; boundary=B")
+    assert form.fields == [(b"q", b"1 UNION SELECT pass\r\n\r\ntail")]
+    p = _pipeline(SQLI_ARGS)
+    req = Request(method="POST", uri="/f",
+                  headers={"Content-Type":
+                           "multipart/form-data; boundary=B"},
+                  body=body)
+    assert p.detect([req])[0].attack
+
+
+def test_files_never_falls_back_to_raw_blob():
+    """On a malformed multipart the FILES collection abstains WITHOUT
+    the raw-blob superset (review finding: a bare extension regex on a
+    truncated body blocked benign text mentioning 'setup.sh'); the
+    context-anchored 922131 raw-body twin owns that case."""
+    p = _pipeline('SecRule FILES "@rx (?i)\\.(?:sh|exe)\\b" '
+                  '"id:920994,phase:2,block,severity:CRITICAL,'
+                  'tag:\'attack-protocol\'"')
+    truncated = Request(
+        method="POST", uri="/f",
+        headers={"Content-Type": "multipart/form-data; boundary=B"},
+        body=b"--B\r\nContent-Disposition: form-data; "
+             b'name="note"\r\n\r\nplease run setup.sh after install\r\n')
+    assert not p.detect([truncated])[0].attack
+
+
 def test_multipart_malformed_abstains():
     ct = b"multipart/form-data; boundary=Xy12"
     # no closing delimiter (truncated body)
