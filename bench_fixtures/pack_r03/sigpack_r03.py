@@ -20,7 +20,10 @@ from typing import Dict, List, Sequence
 
 from ingress_plus_tpu.compiler.seclang import Rule
 
-RULES_DIR = Path(__file__).resolve().parent.parent / "rules"
+# FIXTURE EDIT (round 5): the original line resolved to the live
+# package rules dir; the frozen fixture must be self-contained, so
+# RULES_DIR points at the adjacent frozen crs/ tree instead.
+RULES_DIR = Path(__file__).resolve().parent
 
 # (class, base_id, severity, targets, templates) — {w} is the keyword slot.
 # Templates are regexes in our supported subset; authored for this project.

@@ -83,6 +83,14 @@ _MP_BODY = (b'------WebKitFormBoundary7MA4YWxk\r\n'
                 "Content-Length": str(len(_MP_BODY))}),
             body=_MP_BODY),
     Request(uri="/blog?title=the spawn of a new era", headers=dict(_BH)),
+    # globstar path patterns are a literal substring of the comment-
+    # splice shape ("src/**/tests" IS "c/**/t") — the 942520 chain's
+    # second-signal link must keep them clean (round-5 review finding)
+    Request(uri="/search?path=src/**/tests", headers=dict(_BH)),
+    Request(method="POST", uri="/api/config",
+            headers=dict(_BH, **{"Content-Type": "application/json",
+                                 "Content-Length": "30"}),
+            body=b'{"include": "src/**/index.js"}'),
     Request(uri="/docs?path=constructors in java", headers=dict(_BH)),
     Request(method="OPTIONS", uri="/api", headers=dict(_BH)),
     Request(uri="/env?name=process improvement plan", headers=dict(_BH)),
