@@ -85,6 +85,26 @@ def probe_backend(timeouts=(60, 90, 120, 120), waits=(30, 45, 60)):
     return "cpu", "tpu-unavailable: %s" % last_err[:300]
 
 
+def _widen_k(timed, d_lo: float, d_hi: float, it: int, tag: str,
+             budget_frac: float = 0.5, cap: int = 2048):
+    """Grow K 4x at a time until the K-diff clears RTT jitter (0.2s) or
+    the budget share runs out — the ONE widening loop shared by the
+    live-pack and fixed-pack legs (review finding: two hand-synced
+    copies).  The guard uses the measured MARGINAL cost, not d_lo: a
+    tunnel-dominated d_lo (~70ms RTT, ~0.5ms compute) would block
+    widening 100x too early.  Returns (d_hi, it)."""
+    marginal = max((d_hi - d_lo) / (it - 1), 1e-6)
+    while (d_hi - d_lo < 0.2 and it < cap
+           and 4 * d_lo + 16 * it * marginal
+           < _budget_left() * budget_frac):
+        it *= 4
+        log("[%s] widening K to %d (diff %.1f ms too small)"
+            % (tag, it, (d_hi - d_lo) * 1e3))
+        d_hi = timed(it)
+        marginal = max((d_hi - d_lo) / (it - 1), 1e-6)
+    return d_hi, it
+
+
 def load_fixed_pack():
     """The FROZEN round-3 rule pack (VERDICT r04 item #3): the r03 conf
     tree plus the r03 sigpack generator, both committed verbatim under
@@ -333,15 +353,8 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
             share = max(15.0, _budget_left() * 0.30)
             it = max(2, min(iters, int(share / (4 * pb_est))))
             d_hi = timed(it)
-            marginal = max((d_hi - d_lo) / (it - 1), 1e-6)
-            while (d_hi - d_lo < 0.2 and it < 2048     # dwarf RTT jitter
-                   and 4 * d_lo + 16 * it * marginal
-                   < _budget_left() * 0.5):
-                it *= 4
-                log("[%s] widening K to %d (diff %.1f ms too small)"
-                    % (impl, it, (d_hi - d_lo) * 1e3))
-                d_hi = timed(it)
-                marginal = max((d_hi - d_lo) / (it - 1), 1e-6)
+            d_hi, it = _widen_k(timed, d_lo, d_hi, it, impl,
+                                budget_frac=0.5)
             delta = d_hi - d_lo
             if delta <= 0.05:
                 # RTT jitter swamps the compute delta (microbench
@@ -424,20 +437,13 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
             share = max(10.0, _budget_left() * 0.20)
             itf = max(2, min(iters, int(share / (4 * max(f_lo, 1e-4)))))
             f_hi = timed_f(itf)
-            f_marginal = max((f_hi - f_lo) / (itf - 1), 1e-6)
-            # same K-widening as the live leg: on the tunnel platform
-            # f_lo is RTT-dominated (~70ms) and the initial K sizing
-            # caps 100x too early, parking the delta under the no-signal
-            # threshold on exactly the platform rounds this leg exists
-            # to anchor (review finding)
-            while (f_hi - f_lo < 0.2 and itf < 2048
-                   and 4 * f_lo + 16 * itf * f_marginal
-                   < _budget_left() * 0.4):
-                itf *= 4
-                log("[fixed-pack] widening K to %d (diff %.1f ms)"
-                    % (itf, (f_hi - f_lo) * 1e3))
-                f_hi = timed_f(itf)
-                f_marginal = max((f_hi - f_lo) / (itf - 1), 1e-6)
+            # same widening as the live leg (shared helper): on the
+            # tunnel platform f_lo is RTT-dominated and the initial K
+            # sizing caps 100x too early, parking the delta under the
+            # no-signal threshold on exactly the platform rounds this
+            # leg exists to anchor (review finding)
+            f_hi, itf = _widen_k(timed_f, f_lo, f_hi, itf, "fixed-pack",
+                                 budget_frac=0.4)
             f_delta = f_hi - f_lo
             if f_delta > 0.05:
                 f_per_batch = f_delta / (itf - 1)

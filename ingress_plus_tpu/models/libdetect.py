@@ -160,9 +160,19 @@ def _sqli_token_patterns(tokens: List[Tuple[str, bytes]]) -> bool:
             if len(rest) >= 3 and _is_value(rest[0]) and \
                rest[1][1].lower() in _CMP_OPS and _is_value(rest[2]):
                 return True
-            # OR 'a' / OR 1 — bare truthy value then end/comment
+            # OR 'a' / OR 1 — bare truthy value then TRUNCATION: end of
+            # input, a line comment anywhere, or an inline comment that
+            # ENDS the input.  A mid-expression /**/ is not truncation —
+            # benign globstar queries ("src/**/lib or docs/**/api")
+            # tokenize as value+comment there (round-5 review finding),
+            # and real truncation semantics require the comment to eat
+            # the statement tail.
             if len(rest) >= 1 and _is_value(rest[0]) and (
-                    len(rest) == 1 or rest[1][0] == "comment"):
+                    len(rest) == 1
+                    or (rest[1][0] == "comment"
+                        and (len(rest) == 2
+                             or rest[1][1][:2] == b"--"
+                             or rest[1][1][:1] == b"#"))):
                 return True
     # time/exfil function call: fn '('
     for i, (k, _) in enumerate(tokens[:-1]):

@@ -117,14 +117,14 @@ std::vector<Token> TokenizeSql(const uint8_t* data, size_t n) {
     if (c == '-' && i + 1 < n && data[i + 1] == '-') {
       size_t j = i + 2;
       while (j < n && data[j] != '\n') ++j;
-      toks.push_back({Kind::kComment, "", ""});
+      toks.push_back({Kind::kComment, "--", ""});
       i = j;
       continue;
     }
     if (c == '#') {
       size_t j = i + 1;
       while (j < n && data[j] != '\n') ++j;
-      toks.push_back({Kind::kComment, "", ""});
+      toks.push_back({Kind::kComment, "#", ""});
       i = j;
       continue;
     }
@@ -132,7 +132,7 @@ std::vector<Token> TokenizeSql(const uint8_t* data, size_t n) {
       size_t j = i + 2;
       while (j + 1 < n && !(data[j] == '*' && data[j + 1] == '/')) ++j;
       i = (j + 1 < n) ? j + 2 : n;  // closed or runs to end
-      toks.push_back({Kind::kComment, "", ""});
+      toks.push_back({Kind::kComment, "/*", ""});
       continue;
     }
     if (c == '\'' || c == '"' || c == '`') {
@@ -295,7 +295,12 @@ bool SqliTokenPatterns(const std::vector<Token>& toks) {
     if (rest >= 3 && IsValue(toks[i + 1]) && IsCmpText(toks[i + 2].text) &&
         IsValue(toks[i + 3]))
       return true;
-    if (IsValue(toks[i + 1]) && toks[i + 2].kind == Kind::kComment)
+    // bare truthy value then TRUNCATION: a line comment anywhere, or
+    // an inline comment that ENDS the input.  A mid-expression /**/ is
+    // not truncation — benign globstar queries ("src/**/lib or
+    // docs/**/api") tokenize as value+comment there (review finding).
+    if (IsValue(toks[i + 1]) && toks[i + 2].kind == Kind::kComment &&
+        (rest == 2 || toks[i + 2].text != "/*"))
       return true;
   }
   // time/exfil function call: fn '('

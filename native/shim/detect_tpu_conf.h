@@ -34,4 +34,34 @@ typedef struct {
                                     * at server scope by the template) */
 } ngx_http_detect_tpu_loc_conf_t;
 
+/* Per-upgraded-connection WebSocket capture state (the module's
+ * upgrade-relay wrap — see the "WebSocket upgrade capture" section of
+ * ngx_http_detect_tpu_module.c).  Shared with the harness so the test
+ * double can drive the tunnel-byte path the way a relay would. */
+typedef struct {
+    uint64_t     stream_id;        /* serve-side stream key            */
+    ngx_str_t    socket_path;
+    double       timeout_ms;
+    uint32_t     tenant;
+    uint8_t      mode;
+    unsigned     fail_open:1;      /* conf->fail_open at begin time    */
+    unsigned     blocked:1;        /* sticky: relay must close tunnel  */
+    unsigned     ended:1;          /* end frame sent; no more capture  */
+} ngx_http_detect_tpu_ws_ctx_t;
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+struct ngx_http_request_s;
+ngx_http_detect_tpu_ws_ctx_t *ngx_http_detect_tpu_ws_begin(
+    struct ngx_http_request_s *r);
+ngx_int_t ngx_http_detect_tpu_ws_data(ngx_http_detect_tpu_ws_ctx_t *ws,
+    ngx_uint_t server_to_client, u_char *data, size_t len);
+void ngx_http_detect_tpu_ws_end(ngx_http_detect_tpu_ws_ctx_t *ws);
+
+#ifdef __cplusplus
+}
+#endif
+
 #endif /* DETECT_TPU_CONF_H */

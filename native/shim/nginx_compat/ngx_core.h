@@ -18,6 +18,7 @@ typedef struct {
 #define ngx_str_null(str)  (str)->len = 0; (str)->data = NULL
 
 ngx_int_t ngx_strncasecmp(u_char *s1, u_char *s2, size_t n);
+u_char *ngx_strcasestrn(u_char *s1, char *s2, size_t n);
 u_char *ngx_snprintf(u_char *buf, size_t max, const char *fmt, ...);
 
 /* ---------------------------------------------------- pools + memory */

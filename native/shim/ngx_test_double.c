@@ -104,6 +104,21 @@ ngx_strncasecmp(u_char *s1, u_char *s2, size_t n)
 }
 
 u_char *
+ngx_strcasestrn(u_char *s1, char *s2, size_t n)
+{
+    /* nginx contract: s2 has n+1 significant chars; s1 NUL-terminated */
+    size_t  len = strlen((const char *) s1);
+    size_t  i;
+
+    for (i = 0; i + n + 1 <= len; i++) {
+        if (strncasecmp((const char *) s1 + i, s2, n + 1) == 0) {
+            return s1 + i;
+        }
+    }
+    return NULL;
+}
+
+u_char *
 ngx_snprintf(u_char *buf, size_t max, const char *fmt, ...)
 {
     /* the module uses only "%O" (off_t) — translate to %lld */

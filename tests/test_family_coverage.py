@@ -87,6 +87,10 @@ _MP_BODY = (b'------WebKitFormBoundary7MA4YWxk\r\n'
     # splice shape ("src/**/tests" IS "c/**/t") — the 942520 chain's
     # second-signal link must keep them clean (round-5 review finding)
     Request(uri="/search?path=src/**/tests", headers=dict(_BH)),
+    # ...and with boolean-looking prose around the glob: the strict
+    # grammar's truncation branch must not treat a mid-expression /**/
+    # as a statement-tail comment (round-5 review finding)
+    Request(uri="/search?q=src/**/lib or docs/**/api", headers=dict(_BH)),
     Request(method="POST", uri="/api/config",
             headers=dict(_BH, **{"Content-Type": "application/json",
                                  "Content-Length": "30"}),

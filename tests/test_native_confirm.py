@@ -91,6 +91,14 @@ HANDCRAFTED = [
     b"0X41 and 1.5 or 1.",
     b"@@version",
     b"a||b&&c<>d!=e<=f>=g",
+    # truncation semantics (round-5): a line comment truncates anywhere;
+    # an inline /**/ truncates only at end of input — mid-expression
+    # globstar shapes are benign
+    b"src/**/lib or docs/**/api",
+    b"don't/**/skip",
+    b"' OR 1/*",
+    b"' OR 1/**/x",
+    b"x' OR 'a'--",
 ]
 
 

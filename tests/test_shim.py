@@ -155,6 +155,8 @@ SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)union\\s+select" \
     "id:942100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-sqli'"
 SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)<script" \
     "id:941100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-xss'"
+SecRule RESPONSE_BODY "@rx (?i)root:[^\\s]{0,24}:0:0:" \
+    "id:950100,phase:4,block,severity:CRITICAL,tag:'attack-disclosure'"
 """
 
 
@@ -211,12 +213,14 @@ def harness_stack(tmp_path_factory):
 
 
 def test_phase_state_machine_scenarios(harness_stack):
-    """VERDICT r03 item #5: execute the module's access-phase re-entry /
-    refcount / verdict machine under the nginx test double, against a
-    live serve loop.  13 checks across 11 scenarios: pass, 403,
-    block-page redirect, monitoring, fail-open (+marker header),
-    fail-closed 503, missing thread pool, safe_blocking greylist/neutral,
-    client-ip spoof stripping, ACL deny — with refcount invariants."""
+    """VERDICT r03 item #5 + r04 item #5: execute the module's
+    access-phase re-entry / refcount / verdict machine AND the WebSocket
+    upgrade-capture relay wrap under the nginx test double, against a
+    live serve loop: pass, 403, block-page redirect, monitoring,
+    fail-open (+marker header), fail-closed 503, missing thread pool,
+    safe_blocking greylist/neutral, client-ip spoof stripping, ACL deny
+    — with refcount invariants — plus ws_begin gating, per-read capture
+    with a cross-frame attack, sticky tunnel abort, and stream end."""
     out = subprocess.run([str(HARNESS), harness_stack],
                          capture_output=True, text=True, timeout=120)
     sys.stderr.write(out.stdout)
@@ -224,3 +228,12 @@ def test_phase_state_machine_scenarios(harness_stack):
     lines = [l for l in out.stdout.splitlines() if l]
     assert lines[-1] == "HARNESS-OK"
     assert sum(1 for l in lines if l.startswith("ok ")) >= 20
+    # the r04-item-5 websocket scenarios specifically (the module's
+    # least-executed code before round 5): every one must have run
+    for want in ("ok ws_upgrade_request_passes", "ok ws_begin_on_upgrade",
+                 "ok ws_benign_frame_passes", "ok ws_attack_aborts_tunnel",
+                 "ok ws_sticky_verdict", "ok ws_end_marks_ended",
+                 "ok ws_s2c_frame_scanned",
+                 "ok ws_begin_gated_by_directive",
+                 "ok ws_begin_requires_upgrade_header"):
+        assert want in lines, want
