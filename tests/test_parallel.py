@@ -138,6 +138,72 @@ def test_sp_boundary_attack_detected(ruleset):
     assert (merged[0][: want.shape[0]] == want).all()
 
 
+def test_sp_ring_scan_ragged_rows(ruleset):
+    """VERDICT r04 item #6: per-row lengths in the ring scan.  Rows
+    shorter than the padded width must scan exactly their own bytes —
+    a planted attack INSIDE the padding region must NOT match, and the
+    merged mask must equal the single-device engine's on the same
+    (tokens, lengths)."""
+    from ingress_plus_tpu.ops.scan import scan_bytes_jit
+
+    mesh = make_mesh(n_data=1, n_model=8)
+    tables = ScanTables.from_bitap(ruleset.tables)
+    rng = np.random.default_rng(23)
+    B, L = 4, 1024  # 8 shards x 128 bytes
+    tokens = rng.integers(97, 122, size=(B, L), dtype=np.int32)
+    lengths = np.asarray([1024, 300, 130, 64], np.int32)
+    atk = b"1' UNION SELECT password FROM users--"
+    # row 0: attack spanning the shard-3 boundary (byte 384)
+    tokens[0, 380:380 + len(atk)] = np.frombuffer(atk, np.uint8)
+    # row 1: attack inside its 300 valid bytes, spanning shard boundary
+    tokens[1, 120:120 + len(atk)] = np.frombuffer(atk, np.uint8)
+    # row 2: attack ENTIRELY in padding (beyond byte 130) — dead bytes
+    tokens[2, 200:200 + len(atk)] = np.frombuffer(atk, np.uint8)
+    # row 3: 64 valid bytes, all within shard 0
+
+    merged = np.asarray(ring_scan(tables, mesh, tokens, lengths=lengths))
+    want, _ = scan_bytes_jit(tables, tokens, lengths, gather="take")
+    want = np.asarray(want)
+    assert (merged == want).all()
+    # absolute grounding: the padding attack really is invisible, the
+    # in-bounds attacks really are found
+    ref1 = reference_scan(
+        ruleset.tables, tokens[1, :300].astype(np.uint8).tobytes())
+    assert ref1.any() and (merged[1][: ref1.shape[0]] == ref1).all()
+    ref2 = reference_scan(
+        ruleset.tables, tokens[2, :130].astype(np.uint8).tobytes())
+    assert (merged[2][: ref2.shape[0]] == ref2).all()
+
+
+def test_sp_ring_scan_config5_mixed_1mb_batch(ruleset):
+    """VERDICT r04 weak-item #5: the ring at the REAL config-#5 geometry
+    — an actual 1MB body and a mixed 100KB/1MB ragged batch across the
+    8-device mesh, with a boundary-spanning attack — not just the toy
+    L=64*n shapes."""
+    from ingress_plus_tpu.ops.scan import scan_bytes_jit
+
+    mesh = make_mesh(n_data=1, n_model=8)
+    tables = ScanTables.from_bitap(ruleset.tables)
+    rng = np.random.default_rng(29)
+    B, L = 2, 1 << 20                   # 1 MiB, 8 shards x 128 KiB
+    shard = L // 8
+    tokens = rng.integers(97, 122, size=(B, L), dtype=np.int32)
+    lengths = np.asarray([L, 100 * 1024], np.int32)
+    atk = b"1' UNION SELECT password FROM users--"
+    # row 0 (full 1MB): attack spans the shard-1 boundary
+    tokens[0, shard - 16:shard - 16 + len(atk)] = np.frombuffer(atk, np.uint8)
+    # row 1 (100KB): attack inside the valid prefix...
+    tokens[1, 50_000:50_000 + len(atk)] = np.frombuffer(atk, np.uint8)
+    # ...and one planted far beyond its length — must stay invisible
+    tokens[1, 500_000:500_000 + len(atk)] = np.frombuffer(atk, np.uint8)
+
+    merged = np.asarray(ring_scan(tables, mesh, tokens, lengths=lengths))
+    want, _ = scan_bytes_jit(tables, tokens, lengths, gather="take")
+    assert (merged == np.asarray(want)).all()
+    # the boundary-spanning and in-prefix attacks are present
+    assert merged[0].any() and merged[1].any()
+
+
 def test_tp_pallas2_shard_parity(ruleset):
     """Round-4: the per-shard Pallas class-pair kernel must produce the
     same verdicts as the XLA scans through the full sharded step
