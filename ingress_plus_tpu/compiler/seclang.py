@@ -316,6 +316,78 @@ def _id_matcher(specs: Sequence[str]):
     return match
 
 
+def _static_skip_condition(targets_txt: str, negate: bool, operator: str,
+                           argument: str, tx: Dict[str, str]):
+    """Statically evaluate a skipAfter rule's condition against the
+    parse-time TX environment (VERDICT r04 item #7).
+
+    The CRS paranoia-gating shape — ``SecRule TX:DETECTION_PARANOIA_LEVEL
+    "@lt 2" "...,skipAfter:END-...-PL2"`` — compares a SecAction-set TX
+    variable against a literal (or another TX variable), so the whole
+    control flow resolves at compile time.  Returns True/False when
+    decidable, None otherwise (unknown variable, non-TX target, macro
+    that doesn't resolve, unsupported operator) — the caller then keeps
+    the skipped-over rules ACTIVE, the sound fallback."""
+    toks = [t.strip().strip("'\"") for t in targets_txt.split("|")
+            if t.strip()]
+    if len(toks) != 1 or not toks[0].upper().startswith("TX:"):
+        return None
+    var = toks[0].split(":", 1)[1].strip().lower()
+    val = tx.get(var)
+    if val is None:
+        return None
+    arg = argument.strip().strip("'\"")
+    m = re.match(r"%\{tx\.([a-zA-Z0-9_]+)\}\Z", arg)
+    if m:
+        arg = tx.get(m.group(1).lower())
+        if arg is None:
+            return None
+    if operator in ("eq", "ge", "gt", "le", "lt"):
+        ma = re.match(r"\s*([+-]?\d+)", str(val))
+        mb = re.match(r"\s*([+-]?\d+)", str(arg))
+        if not ma or not mb:
+            return None
+        a, b = int(ma.group(1)), int(mb.group(1))
+        res = {"eq": a == b, "ge": a >= b, "gt": a > b,
+               "le": a <= b, "lt": a < b}[operator]
+    elif operator == "streq":
+        res = str(val) == str(arg)
+    else:
+        return None
+    return (not res) if negate else res
+
+
+def _fold_tx_assignments(tx: Dict[str, str], setvars: List[str]) -> None:
+    """Record literal ``tx.name=value`` assignments (and one-hop
+    ``%{tx.other}`` copies) in the parse-time TX env.  An increment
+    (``=+``/``=-``) or an unresolvable macro INVALIDATES the entry
+    rather than leaving the stale literal behind (review finding: a
+    stale value made a later skipAfter condition confidently wrong and
+    dropped rules ModSecurity would run) — an undecidable variable
+    makes conditions on it abstain, which keeps rules active."""
+    for sv in setvars:
+        name, sep, value = sv.partition("=")
+        name = name.strip().lower()
+        if not sep or not name.startswith("tx."):
+            continue
+        key = name[3:]
+        value = value.strip()
+        if value[:1] in ("+", "-"):
+            tx.pop(key, None)
+            continue
+        m = re.match(r"%\{tx\.([a-zA-Z0-9_]+)\}\Z", value)
+        if m:
+            resolved = tx.get(m.group(1).lower())
+            if resolved is None:
+                tx.pop(key, None)
+                continue
+            value = resolved
+        elif "%{" in value:
+            tx.pop(key, None)
+            continue
+        tx[key] = value
+
+
 def parse_seclang(
     text: str,
     source: str = "<string>",
@@ -323,6 +395,7 @@ def parse_seclang(
     rules: Optional[List[Rule]] = None,
     _seen_includes: Optional[set] = None,
     _phase_defaults: Optional[dict] = None,
+    _skip_state: Optional[dict] = None,
 ) -> List[Rule]:
     """Parse SecLang text → list of top-level Rules (chains attached).
 
@@ -344,6 +417,18 @@ def parse_seclang(
         _seen_includes = set()
     if _phase_defaults is None:
         _phase_defaults = {}   # phase → (default action, default t: list)
+    if _skip_state is None:
+        # "tx": parse-time TX env (SecAction literal assignments);
+        # "skips": active skipAfter regions as (marker, phase) pairs —
+        # SecRule/SecAction directives OF THE SAME PHASE are
+        # runtime-skipped until the marker, because a ModSecurity jump
+        # only applies within the control rule's own phase (review
+        # finding: rules of other phases in the interval still run;
+        # CRS emits paired per-phase control rules for exactly this
+        # reason).  Config directives (Include/SecRuleRemove...) always
+        # apply: skipAfter is runtime flow, config is config.
+        # "chain_drop": a skipped chain leader's continuation lines.
+        _skip_state = {"tx": {}, "skips": [], "chain_drop": False}
     pending_chain: Optional[Rule] = None
 
     for line in _logical_lines(text):
@@ -386,7 +471,16 @@ def parse_seclang(
                 parse_seclang(conf.read_text(), source=str(conf),
                               base_dir=conf.parent, rules=rules,
                               _seen_includes=_seen_includes,
-                              _phase_defaults=_phase_defaults)
+                              _phase_defaults=_phase_defaults,
+                              _skip_state=_skip_state)
+                # an unmatched marker must not leak past the included
+                # file (review finding: a typo'd marker would silently
+                # swallow every subsequent Include — mass
+                # under-detection).  A parent region spanning the
+                # Include still skipped the file's rules above; clearing
+                # here can only over-detect, never under-detect.
+                _skip_state["skips"] = []
+                _skip_state["chain_drop"] = False
             continue
         if directive == "SecAction":
             # config-plane rule (CRS crs-setup.conf shape): no scan
@@ -395,7 +489,11 @@ def parse_seclang(
             # level).  Emitted as an inert config Rule the compiler
             # folds into the static TX env and drops from the pack.
             actions = _parse_actions(tokens[1] if len(tokens) > 1 else "")
+            if any(p == _phase_key(actions)
+                   for _m, p in _skip_state["skips"]):
+                continue   # inside a statically-skipped region (same phase)
             sv = [v.strip("'\"") for v in actions.get("setvar", []) if v]
+            _fold_tx_assignments(_skip_state["tx"], sv)
             if sv:
                 try:
                     rid = int(actions.get("id", ["0"])[0] or 0)
@@ -405,6 +503,12 @@ def parse_seclang(
                     rule_id=rid, operator="unconditionalMatch",
                     argument="", targets=[], raw_targets=[],
                     action="pass", setvars=sv))
+            if actions.get("skipAfter"):
+                # unconditional SecAction skip: setvars above still
+                # applied (they execute before the jump in ModSecurity)
+                _skip_state["skips"].append(
+                    (actions["skipAfter"][0].strip().strip("'\""),
+                     _phase_key(actions)))
             continue
         if directive == "SecDefaultAction":
             # per-phase defaults subsequent SecRules inherit: the
@@ -418,7 +522,13 @@ def parse_seclang(
             d_t = [v for v in acts.get("t", []) if v]
             _phase_defaults[ph] = (d_action, d_t)
             continue
-        if directive in ("SecMarker", "SecComponentSignature",
+        if directive == "SecMarker":
+            # a marker ends every active skip region targeting it
+            name = tokens[1].strip().strip("'\"") if len(tokens) > 1 else ""
+            _skip_state["skips"] = [
+                s for s in _skip_state["skips"] if s[0] != name]
+            continue
+        if directive in ("SecComponentSignature",
                          "SecRuleEngine", "SecRequestBodyAccess",
                          "SecCollectionTimeout"):
             continue  # engine-control directives: no scan content
@@ -495,6 +605,12 @@ def parse_seclang(
             raise SecLangError("%s: short SecRule: %r" % (source, line))
         targets_txt, op_txt = tokens[1], tokens[2]
         actions_txt = tokens[3] if len(tokens) > 3 else ""
+        if _skip_state["chain_drop"]:
+            # continuation links of a skipped chain leader: drop until
+            # the chain ends (a link without its own "chain" action)
+            if "chain" not in _parse_actions(actions_txt):
+                _skip_state["chain_drop"] = False
+            continue
 
         negate = False
         if op_txt.startswith("!@"):
@@ -549,6 +665,36 @@ def parse_seclang(
             operator, argument = "ipMatch", ",".join(entries)
 
         actions = _parse_actions(actions_txt)
+        if pending_chain is None and any(
+                p == _phase_key(actions) for _m, p in _skip_state["skips"]):
+            # this rule's phase is inside an active skip region: it is
+            # runtime-skipped; a chain leader takes its links with it
+            if "chain" in actions:
+                _skip_state["chain_drop"] = True
+            continue
+        if actions.get("skipAfter") and pending_chain is None \
+                and "chain" not in actions:
+            # skipAfter control flow (VERDICT r04 item #7).  The CRS
+            # shape compares a SecAction-set TX variable, so the jump
+            # resolves STATICALLY: condition true → the rules between
+            # here and the SecMarker are skipped (and this control rule
+            # never detects anything itself); condition false → the
+            # jump can never fire, the control rule is inert.  A
+            # non-static condition keeps everything active — the sound
+            # fallback (the skipped-over rules were authored to run at
+            # stricter settings; running them can only over-detect,
+            # never under-detect).
+            marker = actions["skipAfter"][0].strip().strip("'\"")
+            verdict = _static_skip_condition(
+                targets_txt, negate, operator, argument,
+                _skip_state["tx"])
+            if verdict is True:
+                # the jump is scoped to THIS control rule's phase
+                _skip_state["skips"].append(
+                    (marker, _phase_key(actions)))
+                continue
+            if verdict is False:
+                continue
         try:
             rid = int(actions.get("id", ["0"])[0] or 0)
         except ValueError:
@@ -640,12 +786,19 @@ def load_seclang_dir(path: str | Path) -> List[Rule]:
     rules: List[Rule] = []
     seen: set = set()
     defaults: dict = {}   # SecDefaultAction state crosses files
+    # TX assignments (crs-setup.conf paranoia levels) must be visible to
+    # skipAfter conditions in LATER files; an active skip region does
+    # NOT cross file boundaries (CRS markers are always within-file,
+    # and letting a typo'd marker swallow every subsequent file would
+    # fail much too quietly)
+    skip_state: dict = {"tx": {}, "skips": [], "chain_drop": False}
     if p.is_file():
         seen.add(str(p.resolve()))
         return parse_seclang(p.read_text(), source=str(p),
                              base_dir=p.parent, rules=rules,
                              _seen_includes=seen,
-                             _phase_defaults=defaults)
+                             _phase_defaults=defaults,
+                             _skip_state=skip_state)
     for conf in sorted(p.glob("*.conf")):
         key = str(conf.resolve())
         if key in seen:
@@ -653,5 +806,8 @@ def load_seclang_dir(path: str | Path) -> List[Rule]:
         seen.add(key)
         parse_seclang(conf.read_text(), source=str(conf),
                       base_dir=conf.parent, rules=rules,
-                      _seen_includes=seen, _phase_defaults=defaults)
+                      _seen_includes=seen, _phase_defaults=defaults,
+                      _skip_state=skip_state)
+        skip_state["skips"] = []
+        skip_state["chain_drop"] = False
     return rules

@@ -1,0 +1,238 @@
+"""skipAfter / SecMarker control flow (VERDICT r04 item #7).
+
+Real CRS trees gate paranoia tiers with marker jumps::
+
+    SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 2" \
+        "id:942013,phase:2,pass,nolog,skipAfter:END-SQLI-PL2"
+    ... PL2 rules ...
+    SecMarker "END-SQLI-PL2"
+
+The condition compares a SecAction-set TX variable, so the jump resolves
+at parse time: true → the marker interval's rules never load; false →
+the control rule is inert and the tier stays active.  Non-static
+conditions keep everything active (sound: over-detect, never
+under-detect).  These tests pin ModSecurity-equivalent ACTIVE-RULE SETS
+for genuine CRS-shaped trees through the migration (Include) path.
+"""
+
+from __future__ import annotations
+
+from ingress_plus_tpu.compiler.seclang import load_seclang_dir, parse_seclang
+
+
+# NOTE: directory mode loads *.conf sorted — the setup file must sort
+# before the rule files for its TX assignments to be visible to
+# skipAfter conditions, exactly like the bundled pack's
+# 900-crs-setup.conf and the real CRS's entry-config Include order.
+def _tree(tmp_path, paranoia: int):
+    (tmp_path / "100-crs-setup.conf").write_text(
+        'SecAction "id:900000,phase:1,pass,nolog,'
+        'setvar:tx.detection_paranoia_level=%d"\n' % paranoia)
+    (tmp_path / "942-sqli.conf").write_text(
+        'SecRule ARGS "@rx (?i)union\\s+select" '
+        '"id:942100,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"\n'
+        'SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 2" '
+        '"id:942013,phase:2,pass,nolog,skipAfter:END-SQLI-PL2"\n'
+        'SecRule ARGS "@rx (?i)sleep\\s*\\(" '
+        '"id:942170,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"\n'
+        'SecMarker "END-SQLI-PL2"\n'
+        'SecRule ARGS "@rx (?i)xp_cmdshell" '
+        '"id:942999,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"\n')
+    return tmp_path
+
+
+def _ids(rules):
+    return [r.rule_id for r in rules if r.rule_id]
+
+
+def test_skip_taken_drops_marker_interval(tmp_path):
+    """PL=1: the @lt 2 condition holds, so the PL2 tier (942170) is
+    skipped; rules after the marker stay active; the control rule
+    itself never loads."""
+    rules = load_seclang_dir(_tree(tmp_path, paranoia=1))
+    ids = _ids(rules)
+    assert 942100 in ids and 942999 in ids
+    assert 942170 not in ids
+    assert 942013 not in ids
+
+
+def test_skip_not_taken_keeps_tier(tmp_path):
+    """PL=2: the condition is statically false — the tier loads, and
+    the inert control rule still drops."""
+    rules = load_seclang_dir(_tree(tmp_path, paranoia=2))
+    ids = _ids(rules)
+    assert 942100 in ids and 942170 in ids and 942999 in ids
+    assert 942013 not in ids
+
+
+def test_paranoia_crosses_files(tmp_path):
+    """The TX assignment lives in crs-setup.conf; the skip rule in a
+    later rules file must still see it through the shared parse state
+    (the real CRS layout)."""
+    # same tree, but also through an entry config with Includes —
+    # the migration path
+    _tree(tmp_path, paranoia=1)
+    (tmp_path / "modsecurity.conf").write_text(
+        "SecRuleEngine On\n"
+        "Include 100-crs-setup.conf\n"
+        "Include 942-sqli.conf\n")
+    rules = load_seclang_dir(tmp_path / "modsecurity.conf")
+    ids = _ids(rules)
+    assert 942100 in ids and 942999 in ids
+    assert 942170 not in ids
+
+
+def test_non_static_condition_keeps_rules_active():
+    """A skip condition on a request-time variable cannot resolve
+    statically: everything stays active (the sound fallback), including
+    the control rule (which abstains at runtime)."""
+    rules = parse_seclang(
+        'SecRule REQUEST_HEADERS:X-Mode "@streq fast" '
+        '"id:100,phase:1,pass,skipAfter:END-X"\n'
+        'SecRule ARGS "@rx evil" "id:101,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-X"\n')
+    ids = _ids(rules)
+    assert 100 in ids and 101 in ids
+
+
+def test_unconditional_secaction_skip():
+    """SecAction with skipAfter jumps unconditionally; its setvars still
+    apply first (ModSecurity executes actions before the jump)."""
+    rules = parse_seclang(
+        'SecAction "id:200,phase:2,pass,nolog,'
+        'setvar:tx.blocking_paranoia_level=1,skipAfter:END-SKIP"\n'
+        'SecRule ARGS "@rx never" "id:201,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-SKIP"\n'
+        'SecRule ARGS "@rx after" "id:202,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n")
+    ids = _ids(rules)
+    assert 201 not in ids and 202 in ids
+    # the SecAction's setvar rule is retained for the TX env fold
+    sv = [r for r in rules if r.operator == "unconditionalMatch"]
+    assert any("tx.blocking_paranoia_level=1" in v
+               for r in sv for v in r.setvars)
+
+
+def test_missing_marker_skips_rest_of_file(tmp_path):
+    """skipAfter to a marker that never appears skips to the end of the
+    file (ModSecurity behavior) — but NOT into the next file of the
+    tree (a typo'd marker must not silently swallow the whole pack)."""
+    (tmp_path / "100-crs-setup.conf").write_text(
+        'SecAction "id:900000,phase:1,pass,nolog,'
+        'setvar:tx.detection_paranoia_level=1"\n')
+    (tmp_path / "910-a.conf").write_text(
+        'SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 2" '
+        '"id:300,phase:2,pass,skipAfter:NO-SUCH-MARKER"\n'
+        'SecRule ARGS "@rx aaa" "id:301,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n")
+    (tmp_path / "920-b.conf").write_text(
+        'SecRule ARGS "@rx bbb" "id:302,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n")
+    ids = _ids(load_seclang_dir(tmp_path))
+    assert 301 not in ids
+    assert 302 in ids
+
+
+def test_nested_markers_and_ge_form(tmp_path):
+    """The executing-paranoia shape (@ge, negated sense) and multiple
+    sequential tiers in one file resolve independently."""
+    (tmp_path / "100-setup.conf").write_text(
+        'SecAction "id:900,phase:1,pass,nolog,'
+        'setvar:tx.detection_paranoia_level=3"\n')
+    (tmp_path / "900-rules.conf").write_text(
+        # tier 2: active at PL3
+        'SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 2" '
+        '"id:10,phase:2,pass,skipAfter:END-PL2"\n'
+        'SecRule ARGS "@rx t2" "id:11,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-PL2"\n'
+        # tier 4: skipped at PL3
+        'SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 4" '
+        '"id:20,phase:2,pass,skipAfter:END-PL4"\n'
+        'SecRule ARGS "@rx t4" "id:21,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-PL4"\n')
+    ids = _ids(load_seclang_dir(tmp_path))
+    assert 11 in ids
+    assert 21 not in ids
+
+
+def test_skip_is_phase_scoped():
+    """A ModSecurity jump fires during the control rule's phase only:
+    a phase:1 gate must NOT drop a phase:2 rule inside its interval
+    (review finding — CRS emits paired per-phase control rules for
+    exactly this reason)."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.pl=1"\n'
+        'SecRule TX:PL "@lt 2" "id:400,phase:1,pass,skipAfter:END-T"\n'
+        'SecRule REQUEST_HEADERS:X-A "@streq x" "id:401,phase:1,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecRule ARGS "@rx evil" "id:402,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-T"\n')
+    ids = _ids(rules)
+    assert 401 not in ids      # same phase: skipped
+    assert 402 in ids          # other phase: ModSecurity still runs it
+
+
+def test_typoed_marker_does_not_leak_past_include(tmp_path):
+    """An unmatched marker inside an Include'd file must not swallow
+    the rules of subsequent Includes (review finding: the leak compiled
+    the rest of the pack empty)."""
+    (tmp_path / "setup.conf").write_text(
+        'SecAction "id:900,phase:1,pass,nolog,'
+        'setvar:tx.detection_paranoia_level=1"\n')
+    (tmp_path / "a.conf").write_text(
+        'SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 2" '
+        '"id:500,phase:2,pass,skipAfter:TYPO-MARKER"\n'
+        'SecRule ARGS "@rx aaa" "id:501,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n")
+    (tmp_path / "b.conf").write_text(
+        'SecRule ARGS "@rx bbb" "id:502,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n")
+    (tmp_path / "modsecurity.conf").write_text(
+        "Include setup.conf\nInclude a.conf\nInclude b.conf\n")
+    ids = _ids(load_seclang_dir(tmp_path / "modsecurity.conf"))
+    assert 501 not in ids      # skipped to end of its own file
+    assert 502 in ids          # next Include unaffected
+
+
+def test_incremented_tx_variable_abstains():
+    """A later ``=+`` increment makes the variable's parse-time value
+    unknowable: the skip condition must abstain and keep the tier
+    active, not trust the stale literal (review finding — the stale
+    value dropped rules ModSecurity would run)."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,'
+        'setvar:tx.detection_paranoia_level=1"\n'
+        'SecAction "id:901,phase:1,pass,nolog,'
+        'setvar:tx.detection_paranoia_level=+1"\n'
+        'SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 2" '
+        '"id:600,phase:2,pass,skipAfter:END-PL2"\n'
+        'SecRule ARGS "@rx t2" "id:601,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-PL2"\n')
+    ids = _ids(rules)
+    assert 600 in ids and 601 in ids   # everything stays active
+
+
+def test_skipped_chain_leader_takes_links(tmp_path):
+    """A chain leader inside a skipped region must take its
+    continuation links with it — a dangling link would misparse as a
+    standalone rule."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.pl=1"\n'
+        'SecRule TX:PL "@lt 2" "id:700,phase:2,pass,skipAfter:END-C"\n'
+        'SecRule ARGS "@rx one" "id:701,phase:2,block,chain,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        '    SecRule ARGS "@rx two"\n'
+        'SecMarker "END-C"\n'
+        'SecRule ARGS "@rx three" "id:702,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n")
+    ids = _ids(rules)
+    assert 701 not in ids
+    assert 702 in ids
+    # no orphaned chain link survived as a standalone rule
+    assert not any(r.argument == "two" for r in rules)
