@@ -65,6 +65,29 @@ def test_benign_fp_rate(pipeline):
     assert len(fps) <= 5, fps[:10]
 
 
+def test_benign_fixture_corpus(pipeline):
+    """VERDICT r04 item #8: the hand-authored, generator-independent
+    benign set.  Only the documented CRS-parity residue may flag
+    (verbatim SQL statements in prose, markdown code with event
+    handlers — shapes stock ModSecurity+CRS also flags); everything
+    else — GraphQL, OAuth, nested configs with globs/templates,
+    webhooks, uploads — must pass clean."""
+    from ingress_plus_tpu.utils.benign_fixtures import fixture_corpus
+
+    corpus = fixture_corpus()
+    assert len(corpus) >= 30
+    verdicts = _detect_all(pipeline, [c.request for c in corpus])
+    fps = {c.request.request_id for c, v in zip(corpus, verdicts)
+           if v.attack}
+    known_parity = {"fixture-14", "fixture-16", "fixture-17",
+                    "fixture-18"}
+    # exact equality is the ratchet: a NEW fp fails loudly, and a rule
+    # fix that clears one of the known four also fails — forcing the
+    # set (and QUALITY.json's story) to ratchet down with it
+    assert fps == known_parity, sorted(fps.symmetric_difference(
+        known_parity))
+
+
 def test_corpus_is_not_template_derived():
     """Guard the de-circularization property itself: classic payloads must
     not be drawn from the sigpack template expansion."""
