@@ -13,7 +13,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from ingress_plus_tpu.post.brute import BruteDetector
+from ingress_plus_tpu.post.brute import BruteConfig, BruteDetector
 from ingress_plus_tpu.post.counters import NodeCounters
 from ingress_plus_tpu.post.export import Exporter
 from ingress_plus_tpu.post.queue import Hit, HitQueue
@@ -38,13 +38,17 @@ class PostChannel:
                  http_url: Optional[str] = None,
                  interval_s: float = 5.0,
                  queue_len: int = 65536,
-                 brute: bool = True):
+                 brute: bool = True,
+                 brute_config: Optional[BruteConfig] = None):
         self.queue = HitQueue(maxlen=queue_len)
         self.counters = NodeCounters()
         self.exporter = Exporter(
             self.queue, spool_dir=spool_dir, http_url=http_url,
             interval_s=interval_s,
-            brute=BruteDetector() if brute else None)
+            brute=BruteDetector(brute_config) if brute else None,
+            # exported events (incl. brute/dirbust) feed the
+            # per-application counters the status plane serves
+            on_export=self.counters.record_export_events)
 
     def record(self, request: Request, verdict) -> None:
         self.counters.record(

@@ -24,6 +24,12 @@ class NodeCounters:
         self.fail_open = 0
         self.by_class: Dict[str, int] = {}
         self.by_tenant: Dict[int, int] = {}   # attacks per tenant
+        #: EXPORTED ATTACK RECORDS by class (unit: aggregated attacks,
+        #: not requests — by_class above counts per-request verdicts).
+        #: This is the only place brute/dirbust rate detections appear:
+        #: they have no per-request verdict, so the serve-path record()
+        #: never sees them.  Keyed "class" and "class:tenant".
+        self.export_events: Dict[str, int] = {}
 
     def record(self, *, attack: bool, blocked: bool, fail_open: bool,
                classes, tenant: int, mode: int) -> None:
@@ -41,6 +47,17 @@ class NodeCounters:
                     self.by_class[c] = self.by_class.get(c, 0) + 1
                 self.by_tenant[tenant] = self.by_tenant.get(tenant, 0) + 1
 
+    def record_export_events(self, records) -> None:
+        """Fold exporter-delivered attack records (incl. brute/dirbust)
+        into the per-application counters the reference's collectd
+        scrape forwards."""
+        with self._lock:
+            for r in records:
+                cls = r.get("class", "unclassified")
+                self.export_events[cls] = self.export_events.get(cls, 0) + 1
+                key = "%s:%s" % (cls, r.get("tenant", 0))
+                self.export_events[key] = self.export_events.get(key, 0) + 1
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -52,4 +69,5 @@ class NodeCounters:
                 "fail_open": self.fail_open,
                 "by_class": dict(self.by_class),
                 "by_tenant": {str(k): v for k, v in self.by_tenant.items()},
+                "export_events": dict(self.export_events),
             }

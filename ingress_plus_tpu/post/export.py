@@ -41,6 +41,7 @@ class Exporter:
         gap_s: float = 60.0,
         brute: Optional[BruteDetector] = None,
         max_drain: int = 100_000,
+        on_export: Optional[Callable[[List[dict]], None]] = None,
     ):
         self.queue = queue
         self.spool_dir = Path(spool_dir) if spool_dir else None
@@ -49,6 +50,9 @@ class Exporter:
         self.gap_s = gap_s
         self.brute = brute
         self.max_drain = max_drain
+        #: delivered-records hook (PostChannel feeds NodeCounters so
+        #: brute/dirbust events show in /wallarm-status per application)
+        self.on_export = on_export
         self.exported_attacks = 0
         self.export_errors = 0
         self._stop = threading.Event()
@@ -72,6 +76,11 @@ class Exporter:
         ok = self._deliver(records)
         if ok:
             self.exported_attacks += len(records)
+            if self.on_export is not None:
+                try:
+                    self.on_export(records)
+                except Exception:
+                    pass   # counters are best-effort, never break export
             return len(records)
         self.export_errors += 1
         return 0

@@ -663,6 +663,15 @@ def main(argv=None) -> None:
     ap.add_argument("--export-url", default=None,
                     help="optional HTTP collector for attack export")
     ap.add_argument("--export-interval-s", type=float, default=5.0)
+    ap.add_argument("--brute-threshold", type=int, default=25,
+                    help="brute: requests per window per "
+                         "(tenant, client, auth path); 0 disables the "
+                         "rate detectors entirely")
+    ap.add_argument("--brute-window-s", type=float, default=60.0)
+    ap.add_argument("--dirbust-threshold", type=int, default=50,
+                    help="dirbust: distinct paths per window per "
+                         "(tenant, client); 0 disables dirbust only")
+    ap.add_argument("--dirbust-window-s", type=float, default=60.0)
     ap.add_argument("--artifact-dir", default=None,
                     help="watch this dir for compiled-ruleset artifacts "
                          "and hot-swap (sync-node analog)")
@@ -685,9 +694,18 @@ def main(argv=None) -> None:
     if args.spool_dir or args.export_url:
         from ingress_plus_tpu.post import PostChannel
 
-        post = PostChannel(spool_dir=args.spool_dir,
-                           http_url=args.export_url,
-                           interval_s=args.export_interval_s)
+        from ingress_plus_tpu.post.brute import BruteConfig
+
+        post = PostChannel(
+            spool_dir=args.spool_dir,
+            http_url=args.export_url,
+            interval_s=args.export_interval_s,
+            brute=args.brute_threshold > 0,
+            brute_config=BruteConfig(
+                window_s=args.brute_window_s,
+                threshold=args.brute_threshold,
+                dirbust_threshold=args.dirbust_threshold,
+                dirbust_window_s=args.dirbust_window_s))
         post.start()
 
     watcher = None
