@@ -404,7 +404,12 @@ def _parse_collection(kind: str, streams: Dict[str, bytes],
                     (n.lower(), n, v) for n, v in ent]
         elif (b"application/x-www-form-urlencoded" in ct
               or (not ct and _looks_like_form(blob))):
-            out = _split_form(blob, decode=True)
+            # the body stream may carry unpack's decoded extra segment
+            # (\x1f-joined, for double-encoding prefilter coverage) —
+            # the FORM TEXT is the base segment; splitting the joined
+            # blob would pollute the last pair's value with the decoded
+            # copy, corrupting exact values for negated/numeric ops
+            out = _split_form(blob.split(_UNPACK_SEP, 1)[0], decode=True)
         else:
             # non-form body: ModSecurity's ARGS_POST is empty here
             # (the XML processor feeds a different collection)

@@ -348,6 +348,20 @@ def unpack_body(body: bytes, headers: Dict[str, str],
 
     segs = [base]
     sniff = base.lstrip()[:5]
+    if "urlencoded" in ct:
+        # form bodies: one URL-decode segment, so the scanner's decode
+        # variants reach DOUBLE-encoded payloads.  The query string gets
+        # this for free (the args stream is parse-decoded once, then
+        # variant 1 decodes again) but the body stream's variants start
+        # from raw — a fully-%25xx-encoded form payload never showed the
+        # scanner a single literal byte, losing every factor while the
+        # confirm stage (parse-decoded value + t:urlDecodeUni) would
+        # match: a prefilter-soundness hole (round-5 finding).
+        from ingress_plus_tpu.serve.normalize import url_decode_uni
+
+        dec = url_decode_uni(base)
+        if dec != base:
+            segs.append(dec)
     if "json" not in off and ("json" in ct or sniff[:1] in (b"{", b"[")):
         ext = extract_json(base, max_out)
         if ext is not None and ext != base:
