@@ -669,6 +669,42 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
     return result
 
 
+def scrape_stage_breakdown(serve) -> dict | None:
+    """Serve-loop /metrics histograms → the BENCH json ``stage_breakdown``
+    object: per-stage p50/p99 µs (queue/prep/scan/confirm/batch/e2e) plus
+    a sum-check decomposing the serve-side end-to-end percentiles.
+
+    Importable and runnable WITHOUT a running server (the tier-1 smoke
+    test drives it on an in-process ServeLoop): ``serve`` is anything
+    with a ``_metrics_text() -> str``.  Returns None when the histograms
+    are missing or malformed — callers must treat that as a LOUD warning
+    (ISSUE 1 satellite), never a silent absence."""
+    from ingress_plus_tpu.utils.trace import stage_breakdown_from_metrics
+
+    sb = stage_breakdown_from_metrics(serve._metrics_text())
+    if not sb:
+        return None
+    out = {s: sb[s] for s in ("queue", "prep", "scan", "confirm",
+                              "batch", "e2e") if s in sb}
+    if not out:
+        return None
+    # decomposition check: queue+prep+scan+confirm should account for
+    # the serve-side e2e percentiles within slack (stream work and queue
+    # ops are the unattributed remainder)
+    if "e2e" in out:
+        check = {}
+        for p in ("p50_us", "p99_us"):
+            total = sum(out[s].get(p, 0.0)
+                        for s in ("queue", "prep", "scan", "confirm")
+                        if s in out)
+            check["stage_sum_%s" % p] = round(total, 1)
+            e2e = out["e2e"].get(p, 0.0)
+            if e2e:
+                check["stage_sum_over_e2e_%s" % p] = round(total / e2e, 3)
+        out["sum_check"] = check
+    return out
+
+
 def run_latency_leg(cr, scan_impl: str, platform: str,
                     n_requests: int = 1024) -> dict:
     """p50/p99 verdict latency through loadgen -> sidecar -> serve loop.
@@ -755,6 +791,9 @@ def run_latency_leg(cr, scan_impl: str, platform: str,
              "--connections", "2", "--inflight", "2",
              "--requests", "384"],
             capture_output=True, timeout=300)
+        # the stage histograms must describe ONLY the measured pass —
+        # drop the warmup's first-dispatch XLA compile observations
+        batcher.reset_latency_observations()
         out = subprocess.run(
             [loadgen, "--socket", side_sock, "--corpus", corpus_path,
              "--connections", "2", "--inflight", "2",
@@ -798,6 +837,24 @@ def run_latency_leg(cr, scan_impl: str, platform: str,
                 lat["chain_overhead_p99_us"] = c["p99_us"]
         except Exception as e:
             log("chain-overhead pass failed (non-fatal): %r" % (e,))
+        # stage-level latency attribution (ISSUE 1): decompose the
+        # measured p50/p99 by pipeline stage from the serve loop's own
+        # histograms.  Missing/malformed is LOUD, never silent — the
+        # 6.4x budget miss is unexplainable without it.
+        try:
+            sb = scrape_stage_breakdown(serve)
+        except Exception as e:
+            sb = None
+            log("WARNING: stage_breakdown scrape raised (%r)" % (e,))
+        if not sb:
+            log("WARNING: latency leg has NO stage_breakdown — the "
+                "/metrics stage histograms are missing or malformed; "
+                "this round's p99 cannot be decomposed by stage")
+        else:
+            lat["latency_leg"]["stage_breakdown"] = sb
+            log("stage breakdown: " + ", ".join(
+                "%s p50=%.0f p99=%.0f" % (s, v["p50_us"], v["p99_us"])
+                for s, v in sb.items() if s != "sum_check"))
         if platform != "cpu":
             lat["latency_leg"]["note"] = (
                 "per-dispatch verdicts cross the remote-TPU tunnel "

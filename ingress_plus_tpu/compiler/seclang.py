@@ -337,7 +337,10 @@ def _static_skip_condition(targets_txt: str, negate: bool, operator: str,
     if val is None:
         return None
     arg = argument.strip().strip("'\"")
-    m = re.match(r"%\{tx\.([a-zA-Z0-9_]+)\}\Z", arg)
+    # CRS writes macros in canonical caps — %{TX.blocking_paranoia_level}
+    # — so the match must be case-insensitive or static skipAfter
+    # resolution silently no-ops on real CRS trees (ADVICE r05)
+    m = re.match(r"%\{tx\.([a-zA-Z0-9_]+)\}\Z", arg, re.IGNORECASE)
     if m:
         arg = tx.get(m.group(1).lower())
         if arg is None:
@@ -375,7 +378,8 @@ def _fold_tx_assignments(tx: Dict[str, str], setvars: List[str]) -> None:
         if value[:1] in ("+", "-"):
             tx.pop(key, None)
             continue
-        m = re.match(r"%\{tx\.([a-zA-Z0-9_]+)\}\Z", value)
+        # one-hop copies also arrive as %{TX.other} on canonical trees
+        m = re.match(r"%\{tx\.([a-zA-Z0-9_]+)\}\Z", value, re.IGNORECASE)
         if m:
             resolved = tx.get(m.group(1).lower())
             if resolved is None:

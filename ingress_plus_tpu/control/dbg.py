@@ -7,9 +7,15 @@ state.  Same idea against our HTTP plane:
     python -m ingress_plus_tpu.control.dbg conf     [--server host:port]
     python -m ingress_plus_tpu.control.dbg health
     python -m ingress_plus_tpu.control.dbg metrics
+    python -m ingress_plus_tpu.control.dbg latency  [--sidecar host:port]
     python -m ingress_plus_tpu.control.dbg tenants --set '{"1": ["attack-sqli"]}'
     python -m ingress_plus_tpu.control.dbg ruleset --swap /path/artifact \
         [--paranoia 2]
+
+``latency`` renders the serve plane's stage-level latency attribution
+(ISSUE 1): per-stage p50/p90/p99 from the /metrics histograms plus the
+/debug/slow exemplar ring as terminal tables; ``--sidecar`` adds the
+native sidecar's per-upstream EWMA hop timing from its --status-port.
 """
 
 from __future__ import annotations
@@ -30,21 +36,81 @@ def _call(server: str, path: str, payload=None, timeout: float = 10) -> str:
         return resp.read().decode()
 
 
+def render_latency(metrics_text: str, slow: dict,
+                   sidecar: dict | None = None) -> str:
+    """Terminal tables for `dbg latency` (separated from main so tests
+    can drive it on real endpoint output without a TTY)."""
+    from ingress_plus_tpu.utils.trace import (
+        STAGES, stage_breakdown_from_metrics)
+
+    lines = []
+    sb = stage_breakdown_from_metrics(metrics_text)
+    if sb is None:
+        lines.append("stage histograms: MISSING or malformed in /metrics"
+                     " (server predates the latency-attribution layer?)")
+    else:
+        lines.append("%-8s %10s %12s %12s %12s"
+                     % ("stage", "count", "p50_us", "p90_us", "p99_us"))
+        order = [s for s in STAGES if s in sb] \
+            + sorted(set(sb) - set(STAGES))
+        for stage in order:
+            e = sb[stage]
+            lines.append("%-8s %10d %12.1f %12.1f %12.1f"
+                         % (stage, e["count"], e["p50_us"], e["p90_us"],
+                            e["p99_us"]))
+    ex = slow.get("slowest", [])
+    lines.append("")
+    lines.append("slowest requests (%d retained):" % len(ex))
+    lines.append("%-14s %10s %9s %9s %9s %9s  %s"
+                 % ("req_id", "e2e_us", "queue", "prep", "scan",
+                    "confirm", "rules"))
+    for e in ex[:20]:
+        b = e.get("batch", {})
+        lines.append("%-14s %10d %9d %9d %9d %9d  %s"
+                     % (str(e.get("request_id", "?"))[:14],
+                        e.get("e2e_us", 0), e.get("queue_us", 0),
+                        b.get("prep_us", 0), b.get("scan_us", 0),
+                        b.get("confirm_us", 0),
+                        ",".join(str(r) for r in
+                                 e.get("rule_ids", [])[:4]) or "-"))
+    if sidecar is not None:
+        lines.append("")
+        lines.append("sidecar hop (per-upstream EWMA, stamped sidecar-"
+                     "side): pending=%s late=%s"
+                     % (sidecar.get("pending"),
+                        sidecar.get("late_responses")))
+        for up in sidecar.get("upstreams") or []:
+            lines.append("  %-28s ewma_ms=%.3f inflight=%s"
+                         % (up.get("path", "?"), up.get("ewma_ms", 0.0),
+                            up.get("inflight", 0)))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ingress_plus_tpu.control.dbg")
     ap.add_argument("cmd",
-                    choices=["conf", "health", "metrics", "tenants",
-                             "ruleset", "acl"])
+                    choices=["conf", "health", "metrics", "latency",
+                             "tenants", "ruleset", "acl"])
     ap.add_argument("--server", default="127.0.0.1:9901")
     ap.add_argument("--set", dest="set_json", default=None,
                     help="tenants: JSON tenant→tags table to push")
     ap.add_argument("--swap", default=None,
                     help="ruleset: checkpoint artifact path to hot-swap")
     ap.add_argument("--paranoia", type=int, default=2)
+    ap.add_argument("--sidecar", default=None,
+                    help="latency: also scrape the native sidecar's "
+                         "--status-port JSON at this host:port")
     args = ap.parse_args(argv)
 
     try:
-        if args.cmd == "conf":
+        if args.cmd == "latency":
+            metrics = _call(args.server, "/metrics")
+            slow = json.loads(_call(args.server, "/debug/slow"))
+            sidecar = None
+            if args.sidecar:
+                sidecar = json.loads(_call(args.sidecar, "/"))
+            out = render_latency(metrics, slow, sidecar)
+        elif args.cmd == "conf":
             out = _call(args.server, "/configuration")
         elif args.cmd == "health":
             out = _call(args.server, "/healthz")

@@ -69,6 +69,9 @@ class PipelineStats:
     truncated_rows: int = 0
     fail_open: int = 0
     batches: int = 0
+    #: host prep: normalize/unpack/row build+merge, before any device
+    #: dispatch (the "prep" stage of the latency-attribution histograms)
+    prep_us: int = 0
     engine_us: int = 0
     confirm_us: int = 0
 
@@ -225,10 +228,15 @@ class DetectionPipeline:
         Exposed separately so the streaming body path (serve/stream.py)
         can scan a body-less request now and OR in chunk-carried body
         hits at stream end."""
+        tp0 = time.perf_counter()
         rows = rows_for_requests(requests, needed_sv=self.needed_sv)
         data_list, req_list, sv_list = merge_rows(rows)
         Q = len(requests)
         stats = self.stats
+        # stage attribution: everything up to here is host prep (the
+        # per-bucket pad/pack below is interleaved with async dispatch
+        # and rides the scan stage — documented in docs/OBSERVABILITY.md)
+        stats.prep_us += int((time.perf_counter() - tp0) * 1e6)
 
         R = self.ruleset.n_rules
         rule_hits = np.zeros((self._pad_q(Q), R), dtype=bool)

@@ -138,7 +138,10 @@ class BruteDetector:
         atk = Attack(tenant=hit.tenant, client=hit.client,
                      attack_class="dirbust", first_ts=dq[0][0],
                      last_ts=hit.ts)
-        atk.count = len(dq)
+        # count = DISTINCT paths (what crossed dirbust_threshold), not
+        # total window hits — a chatty client re-fetching each path
+        # would otherwise export an inflated sweep size (ADVICE r05)
+        atk.count = distinct
         atk.sample_uris = sorted(counts)[:Attack.MAX_SAMPLES]
         atk.sample_request_ids = [hit.request_id]
         atk.sample_points = [{

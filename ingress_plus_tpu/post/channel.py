@@ -67,7 +67,8 @@ class PostChannel:
             attack=verdict.attack, fail_open=verdict.fail_open,
             mode=request.mode,
             # verdict is duck-typed (ws/stream paths and tests pass
-            # lightweight stubs) — matches is optional on that surface
+            # lightweight stubs) — matches/elapsed are optional there
+            elapsed_us=int(getattr(verdict, "elapsed_us", 0)),
             matches=tuple(getattr(verdict, "matches", ()))))
 
     def start(self) -> None:

@@ -37,6 +37,10 @@ class Hit:
     attack: bool
     fail_open: bool = False
     mode: int = 2
+    #: detection latency (µs) the verdict carried — lets the export /
+    #: spool side correlate slow verdicts with the serve plane's
+    #: /traces/request?id= spans by request_id (ISSUE 1 attribution)
+    elapsed_us: int = 0
     #: matched points ({rule_id, var, value-snippet}) — the reference
     #: ships the serialized request and the cloud re-derives points; we
     #: ship the points themselves (bounded, raw bodies stay out)

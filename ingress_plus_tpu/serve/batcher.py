@@ -27,7 +27,17 @@ from ingress_plus_tpu.models.pipeline import DetectionPipeline, Verdict
 from ingress_plus_tpu.serve.normalize import Request
 from ingress_plus_tpu.serve.stream import StreamEngine, StreamState
 from ingress_plus_tpu.serve.unpack import GZIP_MAGIC, unpack_body
-from ingress_plus_tpu.utils.trace import BatchTrace, TraceRing
+from ingress_plus_tpu.utils.trace import (
+    STAGES,
+    BatchTrace,
+    Histogram,
+    SlowRing,
+    TraceRing,
+)
+
+#: batch-size distribution buckets: 1..4096 requests, power-of-two edges
+#: (the Q-pad tiers the engine compiles for)
+BATCH_SIZE_BUCKETS = tuple(1 << i for i in range(13))
 
 
 def _safe_set(fut: "Future", value) -> None:
@@ -95,6 +105,12 @@ class Batcher:
         self.stats = BatcherStats()
         # per-batch span records for /traces (SURVEY.md §5 tracing)
         self.traces = TraceRing()
+        # latency-attribution layer (ISSUE 1): per-stage µs histograms
+        # rendered at /metrics as ipt_stage_us{stage=...}, a batch-size
+        # distribution, and the K slowest requests served at /debug/slow
+        self.hist: dict = {s: Histogram() for s in STAGES}
+        self.batch_size_hist = Histogram(bounds=BATCH_SIZE_BUCKETS)
+        self.slow = SlowRing(capacity=32)
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._swap_lock = threading.Lock()
@@ -111,6 +127,16 @@ class Batcher:
         self._thread.start()
 
     # ------------------------------------------------------------- API
+
+    def reset_latency_observations(self) -> None:
+        """Zero the stage histograms and the slow-exemplar ring.  Bench
+        legs call this after warmup so the scraped stage_breakdown
+        describes ONLY the measured traffic, not the first-dispatch XLA
+        compiles the warmup exists to keep out of p99."""
+        for h in self.hist.values():
+            h.reset()
+        self.batch_size_hist.reset()
+        self.slow.reset()
 
     def submit(self, request: Request) -> "Future[Verdict]":
         fut: "Future[Verdict]" = Future()
@@ -150,13 +176,16 @@ class Batcher:
                 return "unpack", body, request.headers
         return None
 
-    def _submit_oversized(self, request: Request, plan,
+    def _submit_oversized(self, ts: float, request: Request, plan,
                           fut: "Future[Verdict]") -> None:
         """Hand one oversized request to the side worker; a full side
         queue fails open immediately (bounded memory under a flood of
-        maximum-size bodies)."""
+        maximum-size bodies).  ``ts`` is the original submit time — the
+        side lane's verdicts feed the e2e histogram and slow ring like
+        everyone else's (the likeliest slowest requests in the system
+        must not be invisible to /debug/slow)."""
         try:
-            self._oversized_q.put_nowait((request, plan, fut))
+            self._oversized_q.put_nowait((ts, request, plan, fut))
         except queue.Full:
             self.pipeline.stats.fail_open += 1
             _safe_set(fut, Verdict(
@@ -166,12 +195,12 @@ class Batcher:
     def _run_oversized(self) -> None:
         while not self._stop.is_set():
             try:
-                request, plan, fut = self._oversized_q.get(timeout=0.1)
+                ts, request, plan, fut = self._oversized_q.get(timeout=0.1)
             except queue.Empty:
                 continue
-            self._detect_oversized(request, plan, fut)
+            self._detect_oversized(ts, request, plan, fut)
 
-    def _detect_oversized(self, request: Request, plan,
+    def _detect_oversized(self, ts: float, request: Request, plan,
                           fut: "Future[Verdict]") -> None:
         """Run one oversized request through the stream engine (the
         oversized worker thread).  The swap lock is taken per STEP, not
@@ -208,6 +237,12 @@ class Batcher:
                         attack=False, classes=[], rule_ids=[], score=0,
                         fail_open=True)
         _safe_set(fut, v)
+        e2e_us = int((time.perf_counter() - ts) * 1e6)
+        self.hist["e2e"].observe(e2e_us)
+        if e2e_us > self.slow.threshold():
+            # side-lane: no batch stage spans, flagged oversized instead
+            self.slow.offer(e2e_us, self._exemplar(
+                request, v, time.time(), 0, oversized=True))
 
     # --------------------------------------------- streaming-body API
     # (config #5).  Queue FIFO guarantees begin ≤ chunks ≤ finish order;
@@ -289,7 +324,7 @@ class Batcher:
         # (round-3 review)
         while True:
             try:
-                request, _plan, fut = self._oversized_q.get_nowait()
+                _ts, request, _plan, fut = self._oversized_q.get_nowait()
             except queue.Empty:
                 break
             self.pipeline.stats.fail_open += 1
@@ -340,21 +375,28 @@ class Batcher:
                                             len(reqs))
             for ts, _, _ in reqs:
                 self.stats.queue_delay_us_sum += int((t0 - ts) * 1e6)
-            ps = self.pipeline.stats
-            engine_us0, confirm_us0 = ps.engine_us, ps.confirm_us
+            done: List = []   # (submit_ts, request, verdict) this cycle
             with self._swap_lock:
-                self._stream_step(begins, chunks, finishes)
+                # stage-delta capture INSIDE the lock: the oversized
+                # side worker also mutates pipeline stats (under this
+                # lock, per step) — sampling outside would attribute its
+                # work to this batch's stage histograms
+                ps = self.pipeline.stats
+                engine_us0, confirm_us0 = ps.engine_us, ps.confirm_us
+                prep_us0 = ps.prep_us
+                finish_verdicts = self._stream_step(begins, chunks,
+                                                    finishes)
                 # partition: oversized bodies go through the stream
                 # engine inline; everything else batches as usual
                 normal = []
                 for item in reqs:
-                    _, r, fut = item
+                    ts, r, fut = item
                     try:
                         plan = self._reroute_plan(r)
                     except Exception:
                         plan = None   # fall back to the batched path
                     if plan is not None:
-                        self._submit_oversized(r, plan, fut)
+                        self._submit_oversized(ts, r, plan, fut)
                     else:
                         normal.append(item)
                 requests = [r for _, r, _ in normal]
@@ -368,30 +410,109 @@ class Batcher:
                                     score=0, fail_open=True)
                             for r in requests
                         ]
-                    for (_, _, fut), v in zip(normal, verdicts):
+                    for (ts, r, fut), v in zip(normal, verdicts):
                         _safe_set(fut, v)
-            took = time.perf_counter() - t0
+                        done.append((ts, r, v))
+                # end-delta sample, still under the lock (stats object
+                # survives hot-swaps; the side lane can't interleave)
+                ps = self.pipeline.stats
+                d_engine = ps.engine_us - engine_us0
+                d_confirm = ps.confirm_us - confirm_us0
+                d_prep = ps.prep_us - prep_us0
+            t_end = time.perf_counter()
+            took = t_end - t0
             self.stats.batch_us_sum += int(took * 1e6)
             if took > self.hard_deadline_s:
                 self.stats.deadline_overruns += len(reqs) + len(finishes)
             self.stats.completed += len(reqs) + len(finishes)
-            ps = self.pipeline.stats  # same object across hot-swaps
-            self.traces.record(BatchTrace(
+            batch_us = int(took * 1e6)
+            trace = BatchTrace(
                 ts=time.time(),
                 n_requests=len(reqs),
                 n_stream_items=len(begins) + len(chunks) + len(finishes),
                 queue_delay_us=int((t0 - min(ts for _, ts, _, _ in batch))
                                    * 1e6),
-                batch_us=int(took * 1e6),
-                engine_us=ps.engine_us - engine_us0,
-                confirm_us=ps.confirm_us - confirm_us0,
-                request_ids=[r.request_id for _, r, _ in reqs[:8]]))
+                batch_us=batch_us,
+                engine_us=d_engine,
+                confirm_us=d_confirm,
+                prep_us=d_prep,
+                # only requests this batch actually scanned (`normal` +
+                # stream finishes): an oversized-rerouted id here would
+                # make /traces/request attribute the side lane's work to
+                # this batch's spans — those ids resolve via their
+                # /debug/slow exemplar instead
+                request_ids=[r.request_id for _, r, _ in normal]
+                + [h.request.request_id for h, _ in finish_verdicts])
+            self.traces.record(trace)
+            self._observe(trace, done, finish_verdicts, t0, t_end)
 
-    def _stream_step(self, begins, chunks, finishes) -> None:
+    @staticmethod
+    def _exemplar(request, verdict, ts: float, queue_us: int,
+                  body_len: Optional[int] = None, **extra) -> dict:
+        """The ONE slow-ring exemplar shape (batched / stream-finish /
+        oversized lanes all build it here): span attribution + truncated
+        normalized input sizes + rules hit — never request bytes."""
+        d = {
+            "request_id": request.request_id,
+            "ts": ts,
+            "queue_us": queue_us,
+            "input": {"uri_len": len(request.uri),
+                      "body_len": (len(request.body) if body_len is None
+                                   else body_len),
+                      "n_headers": len(request.headers)},
+            "rule_ids": list(verdict.rule_ids[:16]),
+            "score": verdict.score,
+            "attack": verdict.attack,
+            "blocked": verdict.blocked,
+            "fail_open": verdict.fail_open,
+        }
+        d.update(extra)
+        return d
+
+    def _observe(self, trace: BatchTrace, done, finish_verdicts,
+                 t0: float, t_end: float) -> None:
+        """Feed this cycle's spans into the stage histograms and the
+        slow-exemplar ring (the latency-attribution layer; never on any
+        failure path — purely additive observability)."""
+        h = self.hist
+        h["batch"].observe(trace.batch_us)
+        h["prep"].observe(trace.prep_us)
+        h["scan"].observe(trace.engine_us)
+        h["confirm"].observe(trace.confirm_us)
+        if trace.n_requests:
+            self.batch_size_hist.observe(trace.n_requests)
+        stages = trace.stages()
+        thr = self.slow.threshold()   # skip dict build for fast requests
+        for ts, r, v in done:
+            queue_us = int((t0 - ts) * 1e6)
+            e2e_us = int((t_end - ts) * 1e6)
+            h["queue"].observe(queue_us)
+            h["e2e"].observe(e2e_us)
+            if e2e_us <= thr:
+                continue
+            self.slow.offer(e2e_us, self._exemplar(
+                r, v, trace.ts, queue_us, batch=stages))
+        for handle, v in finish_verdicts:
+            # streams: end-to-end is begin→finish (the verdict's own
+            # clock), not this cycle's queue wait
+            e2e_us = int(v.elapsed_us)
+            h["e2e"].observe(e2e_us)
+            if e2e_us <= thr:
+                continue
+            self.slow.offer(e2e_us, self._exemplar(
+                handle.request, v, trace.ts, 0,
+                body_len=handle.body_len, batch=stages,
+                stream={"chunks": handle.chunks,
+                        "body_len": handle.body_len,
+                        "truncated": handle.truncated}))
+
+    def _stream_step(self, begins, chunks, finishes) -> List:
         """Streaming work for one dispatch cycle (called under the swap
-        lock, on the dispatch thread — sole owner of stream state)."""
+        lock, on the dispatch thread — sole owner of stream state).
+        Returns the (handle, verdict) pairs resolved at finish, so the
+        caller can attribute their latency."""
         if not (begins or chunks or finishes):
-            return
+            return []
         try:
             live = [h for h in begins if not h.aborted]
             if live:
@@ -416,6 +537,7 @@ class Batcher:
                 h.error = True
             for h, _ in finishes:
                 h.error = True
+        out = []
         for h, fut in finishes:
             try:
                 v = self.stream_engine.finish(h)
@@ -424,5 +546,11 @@ class Batcher:
                 v = Verdict(
                     request_id=h.request.request_id, blocked=False,
                     attack=False, classes=[], rule_ids=[], score=0,
-                    fail_open=True)
+                    fail_open=True,
+                    # genuinely slow failed streams must still carry
+                    # their real duration into the e2e histogram and
+                    # remain slow-ring eligible
+                    elapsed_us=int((time.perf_counter() - h.t0) * 1e6))
             _safe_set(fut, v)
+            out.append((h, v))
+        return out

@@ -238,14 +238,20 @@ class Request:
     #: which stream the StreamEngine chunk-scans (Response: "resp_body")
     body_stream = "body"
 
-    def streams(self) -> Dict[str, bytes]:
+    def streams(self, scan_extras: bool = True) -> Dict[str, bytes]:
         """stream name → base bytes (the 4 scan streams).
 
         ARGS is URL-decoded once *before* any rule transform, because
         ModSecurity's ARGS collection holds parsed query values, not raw
         query bytes — CRS rules without an explicit t:urlDecodeUni still
         expect decoded text there (a rule's own urlDecodeUni then catches
-        double-encoding, same as the reference engine)."""
+        double-encoding, same as the reference engine).
+
+        ``scan_extras``: prefilter-only unpack segments (the url-decoded
+        form-body copy).  Scan keeps them (soundness superset); the
+        confirm twin (confirm_streams) drops them so scalar REQUEST_BODY
+        rules with their own t:urlDecodeUni never see a double-decoded
+        copy ModSecurity would not produce (ADVICE r05)."""
         uri = self.uri.encode("utf-8", "surrogateescape")
         q = uri.find(b"?")
         args = url_decode_uni(uri[q + 1 :]) if q >= 0 else b""
@@ -255,10 +261,11 @@ class Request:
         # body unpack (gzip/b64/json/xml — SURVEY.md §3.3): the scan AND
         # the confirm stage both call streams(), so they see identical
         # unpacked bytes — the prefilter∧confirm contract holds through
-        # every decode step
+        # every decode step (modulo the scan-only extra segments above)
         body = self.body
         if body:
-            body = unpack_body(body, self.headers, self.parsers_off)
+            body = unpack_body(body, self.headers, self.parsers_off,
+                               scan_extras=scan_extras)
         return {"uri": uri, "args": args, "headers": hdr, "body": body}
 
     def confirm_streams(self) -> Dict[str, bytes]:
@@ -267,8 +274,9 @@ class Request:
         _SCALAR_BASES): REQUEST_METHOD/PROTOCOL/FILENAME/BASENAME and
         the RAW query string (ModSecurity's QUERY_STRING is undecoded,
         unlike the scanner's decoded args stream).  The scanner contract
-        is untouched — rows_for_requests iterates streams()."""
-        s = self.streams()
+        is untouched — rows_for_requests iterates streams().  Scan-only
+        extra segments are dropped (single-decode confirm semantics)."""
+        s = self.streams(scan_extras=False)
         uri = s["uri"]
         q = uri.find(b"?")
         path = uri if q < 0 else uri[:q]
@@ -316,18 +324,19 @@ class Response:
     method = "RESPONSE"
     uri = ""
 
-    def streams(self) -> Dict[str, bytes]:
+    def streams(self, scan_extras: bool = True) -> Dict[str, bytes]:
         hdr = headers_blob(self.headers)
         body = self.body
         if body:
             # same unpack stage as requests (wallarm-unpack-response):
             # gzip/base64/json/xml wrapped response bodies are scanned
             # decoded, honoring the same parser disables
-            body = unpack_body(body, self.headers, self.parsers_off)
+            body = unpack_body(body, self.headers, self.parsers_off,
+                               scan_extras=scan_extras)
         return {"resp_headers": hdr, "resp_body": body}
 
     def confirm_streams(self) -> Dict[str, bytes]:
-        s = self.streams()
+        s = self.streams(scan_extras=False)
         s["status"] = str(self.status).encode()
         return s
 

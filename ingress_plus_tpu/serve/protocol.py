@@ -80,6 +80,15 @@ identical to requests); the verdict is the stream's sticky attack state
 after the messages this frame completed.
 
 Responses may arrive out of order; req_id correlates.
+
+Observability contract: the wire ``req_id`` IS the trace id.  decode_*
+stamp it into ``Request.request_id``/``Response.request_id`` as a decimal
+string, and it survives unchanged through batcher → pipeline → confirm →
+postanalytics (post/queue.py ``Hit.request_id``), so a slow verdict is
+attributable post-hoc via ``/traces/request?id=<req_id>`` and the
+``/debug/slow`` exemplar ring (docs/OBSERVABILITY.md).  The sidecar
+additionally stamps each frame's send→verdict time on its side of the
+hop (surfaced via its --status-port JSON).
 """
 
 from __future__ import annotations

@@ -52,11 +52,17 @@ _OVERFLOW = object()        # sentinel: stream rejected by the cap
 
 class ServeLoop:
     def __init__(self, batcher: Batcher, socket_path: str,
-                 http_port: int = 0, post=None):
+                 http_port: int = 0, post=None,
+                 sidecar_status: Optional[str] = None):
         self.batcher = batcher
         self.socket_path = socket_path
         self.http_port = http_port
         self.post = post  # PostChannel | None — postanalytics write side
+        # "host:port" of the native sidecar's --status-port listener:
+        # when set, /traces/request includes the sidecar hop's per-
+        # upstream EWMA latency (the sidecar stamps every frame's
+        # send→verdict time; its status JSON is where that surfaces)
+        self.sidecar_status = sidecar_status
         self.started = time.time()
         self.connections = 0
         self._servers = []
@@ -349,6 +355,23 @@ class ServeLoop:
             % (self.batcher.pipeline.ruleset.version,
                self.batcher.pipeline.ruleset.n_rules),
         ]
+        # stage-level latency attribution (ISSUE 1): one Prometheus
+        # histogram per pipeline stage, so p50/p99 per stage are
+        # scrapeable without external tooling (the reference gets this
+        # from the controller's prometheus histograms + nginx spans)
+        lines.append("# TYPE ipt_stage_us histogram")
+        for stage, hist in self.batcher.hist.items():
+            lines += hist.prometheus("ipt_stage_us", {"stage": stage})
+        lines.append("# TYPE ipt_batch_size histogram")
+        lines += self.batcher.batch_size_hist.prometheus("ipt_batch_size")
+        lines += [
+            "# TYPE ipt_prep_us_sum counter",
+            "ipt_prep_us_sum %d" % p.prep_us,
+            "# TYPE ipt_engine_us_sum counter",
+            "ipt_engine_us_sum %d" % p.engine_us,
+            "# TYPE ipt_confirm_us_sum counter",
+            "ipt_confirm_us_sum %d" % p.confirm_us,
+        ]
         if self.post is not None:
             lines += [
                 "# TYPE ipt_post_queue_depth gauge",
@@ -363,6 +386,27 @@ class ServeLoop:
                 % self.post.exporter.export_errors,
             ]
         return "\n".join(lines) + "\n"
+
+    def _scrape_sidecar(self) -> Optional[dict]:
+        """One-shot scrape of the sidecar's --status-port JSON (runs in
+        an executor thread — never on the event loop).  The per-upstream
+        ``ewma_ms`` is the sidecar's own send→verdict stamp (peak-EWMA),
+        i.e. the hop this serve loop cannot measure from inside."""
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    "http://%s/" % self.sidecar_status, timeout=2) as r:
+                st = json.loads(r.read())
+        except Exception as e:
+            return {"error": "sidecar status unreachable: %s" % e}
+        return {
+            "note": "per-upstream EWMA of the sidecar hop "
+                    "(frame send -> verdict), stamped by the sidecar",
+            "upstreams": st.get("upstreams"),
+            "pending": st.get("pending"),
+            "late_responses": st.get("late_responses"),
+        }
 
     async def _handle_http(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
@@ -412,6 +456,33 @@ class ServeLoop:
         if path.startswith("/metrics"):
             return ("200 OK", "text/plain; version=0.0.4",
                     self._metrics_text().encode())
+        if path.startswith("/traces/request"):
+            # post-hoc slow-verdict attribution by wire req_id: the
+            # batch's per-stage spans, the slow-ring exemplar when the
+            # request was retained there, and (when --sidecar-status is
+            # configured) the sidecar hop's per-upstream EWMA timing
+            from urllib.parse import parse_qs, urlsplit
+            q = parse_qs(urlsplit(path).query, keep_blank_values=True)
+            rid = (q.get("id") or [""])[0]
+            if not rid:
+                return ("400 Bad Request", "application/json",
+                        json.dumps({"error": "missing ?id="}).encode())
+            batch = self.batcher.traces.find_request(rid)
+            exemplar = self.batcher.slow.find_request(rid)
+            out = {
+                "request_id": rid,
+                "found": batch is not None or exemplar is not None,
+                "batch": batch,
+                "stages": batch["stages"] if batch else None,
+                "exemplar": exemplar,
+            }
+            if self.sidecar_status:
+                out["sidecar"] = await loop.run_in_executor(
+                    None, self._scrape_sidecar)
+            # always 200: it's a query ("was this id seen recently"),
+            # and found=false is a meaningful answer (aged out of ring)
+            return ("200 OK", "application/json",
+                    json.dumps(out).encode())
         if path.startswith("/traces"):
             # recent per-batch span records; ?slowest[=N] sorts by batch_us
             # (request-id attribution for slow verdicts — SURVEY.md §5)
@@ -427,6 +498,19 @@ class ServeLoop:
                 body = self.batcher.traces.snapshot(50)
             return ("200 OK", "application/json",
                     json.dumps({"traces": body}).encode())
+        if path.startswith("/debug/slow"):
+            # the K slowest requests since startup: full span breakdown,
+            # truncated input sizes, rules hit (exemplar capture)
+            from urllib.parse import parse_qs, urlsplit
+            q = parse_qs(urlsplit(path).query, keep_blank_values=True)
+            try:
+                n = int((q.get("n") or ["32"])[0])
+            except ValueError:
+                n = 32
+            if n <= 0:     # negative would slice from the wrong end
+                n = 32
+            return ("200 OK", "application/json", json.dumps(
+                {"slowest": self.batcher.slow.snapshot(n)}).encode())
         if path.startswith("/wallarm-status"):
             # node counters JSON — the reference module's `/wallarm-status`
             # endpoint that collectd scrapes (SURVEY.md §3.5)
@@ -678,6 +762,10 @@ def main(argv=None) -> None:
     ap.add_argument("--trace-dir", default=None,
                     help="collect a jax.profiler (XProf) trace of the "
                          "serve loop into this dir until shutdown")
+    ap.add_argument("--sidecar-status", default=None,
+                    help="host:port of the native sidecar's --status-port"
+                         " listener; /traces/request then includes the "
+                         "sidecar hop's per-upstream EWMA timing")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -717,7 +805,8 @@ def main(argv=None) -> None:
         watcher.current_version = batcher.pipeline.ruleset.version
         watcher.start()
 
-    loop = ServeLoop(batcher, args.socket, args.http_port, post=post)
+    loop = ServeLoop(batcher, args.socket, args.http_port, post=post,
+                     sidecar_status=args.sidecar_status)
     from ingress_plus_tpu.utils.trace import profiled
     try:
         with profiled(args.trace_dir):

@@ -328,11 +328,21 @@ def decode_base64_like(data: bytes, max_out: int = DEFAULT_MAX_OUT
 
 def unpack_body(body: bytes, headers: Dict[str, str],
                 parsers_off: FrozenSet[str] = frozenset(),
-                max_out: int = DEFAULT_MAX_OUT) -> bytes:
+                max_out: int = DEFAULT_MAX_OUT,
+                scan_extras: bool = True) -> bytes:
     """The full unpack chain; returns the bytes the body stream scans.
 
     Identity for plain bodies (no compression, nothing extractable) —
-    benign traffic pays one header lookup and two sniffs."""
+    benign traffic pays one header lookup and two sniffs.
+
+    ``scan_extras``: include the prefilter-only url-decoded form-body
+    segment.  The SCAN path needs it (a fully-%25xx-encoded form payload
+    would otherwise show the scanner no literal bytes — round-5
+    prefilter-soundness fix); the CONFIRM path must NOT see it, or
+    scalar REQUEST_BODY rules with t:urlDecodeUni (942170, 932240)
+    evaluate a double-decoded copy ModSecurity would never produce
+    (ADVICE r05).  Prefilter hits from the extra segment are a sound
+    superset — the single-decode confirm decides."""
     if not body:
         return body
     off = parsers_off
@@ -348,15 +358,17 @@ def unpack_body(body: bytes, headers: Dict[str, str],
 
     segs = [base]
     sniff = base.lstrip()[:5]
-    if "urlencoded" in ct:
-        # form bodies: one URL-decode segment, so the scanner's decode
-        # variants reach DOUBLE-encoded payloads.  The query string gets
-        # this for free (the args stream is parse-decoded once, then
-        # variant 1 decodes again) but the body stream's variants start
-        # from raw — a fully-%25xx-encoded form payload never showed the
-        # scanner a single literal byte, losing every factor while the
-        # confirm stage (parse-decoded value + t:urlDecodeUni) would
-        # match: a prefilter-soundness hole (round-5 finding).
+    if scan_extras and "urlencoded" in ct:
+        # form bodies, SCAN PATH ONLY: one URL-decode segment, so the
+        # scanner's decode variants reach DOUBLE-encoded payloads.  The
+        # query string gets this for free (the args stream is
+        # parse-decoded once, then variant 1 decodes again) but the body
+        # stream's variants start from raw — a fully-%25xx-encoded form
+        # payload never showed the scanner a single literal byte, losing
+        # every factor while the confirm stage (parse-decoded value +
+        # t:urlDecodeUni) would match: a prefilter-soundness hole
+        # (round-5 finding).  Confined to scan_extras so the confirm
+        # stage keeps single-decode semantics (see docstring).
         from ingress_plus_tpu.serve.normalize import url_decode_uni
 
         dec = url_decode_uni(base)

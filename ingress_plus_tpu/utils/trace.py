@@ -1,14 +1,29 @@
-"""Tracing — per-batch spans + device profiler hooks (SURVEY.md §5).
+"""Tracing — per-stage histograms, per-batch spans, slow-request
+exemplars, device profiler hooks (SURVEY.md §5).
 
 The reference traces requests with nginx-opentracing + jaeger/zipkin C++
-clients and profiles the Go side with pprof.  The TPU-native equivalents:
+clients, exposes controller latency as Prometheus histograms, and
+profiles the Go side with pprof.  The TPU-native equivalents:
 
-  * ``TraceRing`` — a bounded ring of per-batch span records (queue delay,
-    host prep, device scan, confirm, the request ids in the batch) kept by
-    the batcher and served at ``/traces``; a slow verdict is attributable
-    to its batch, and the batch to its stage — the "propagate a request-id
-    so a slow verdict is attributable" requirement without a tracing
-    daemon.
+  * ``Histogram`` — allocation-free fixed-bucket (log2-scaled µs)
+    latency histogram.  The batcher keeps one per pipeline stage
+    (queue delay, host prep, device scan, confirm, whole batch,
+    per-request end-to-end) and the server renders them in Prometheus
+    histogram text format, so p50/p99 per stage are scrapeable without
+    any external tooling.
+  * ``BatchTrace``/``TraceRing`` — a bounded ring of per-batch span
+    records (per-stage split points + the full request-id list) kept by
+    the batcher and served at ``/traces``; ``/traces/request?id=``
+    resolves a wire req_id to its batch's per-stage spans — the
+    "propagate a request-id so a slow verdict is attributable"
+    requirement without a tracing daemon.
+  * ``SlowRing`` — the K slowest requests (span breakdown + truncated
+    input sizes + rules hit), served at ``/debug/slow`` and rendered by
+    ``dbg latency``.
+  * ``stage_breakdown_from_metrics`` — parses the Prometheus histogram
+    text back into per-stage p50/p99 (bench.py emits this as the
+    ``stage_breakdown`` object in BENCH json, decomposing the latency
+    leg by stage).
   * ``profiled`` — wraps a region in ``jax.profiler`` trace collection
     (XProf/TensorBoard — the device-side flamegraph the CUDA world gets
     from nsys); enabled on the serve loop with ``--trace-dir``.
@@ -16,17 +31,124 @@ clients and profiles the Go side with pprof.  The TPU-native equivalents:
 
 from __future__ import annotations
 
+import heapq
+import re
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import asdict, dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: log2-scaled µs bucket upper bounds: 1µs … ~8.4s, factor-2 resolution
+#: (24 finite buckets + the implicit +Inf overflow).  Fixed at import
+#: time so observe() never allocates.
+DEFAULT_BUCKETS_US: Tuple[int, ...] = tuple(1 << i for i in range(24))
+
+#: canonical stage set the serve plane attributes latency to (the order
+#: is the rendering/report order): queue delay before dispatch, host
+#: prep (normalize/unpack/row build), device scan, CPU confirm, the
+#: whole dispatch cycle, and per-request end-to-end (queue + batch).
+STAGES = ("queue", "prep", "scan", "confirm", "batch", "e2e")
+
+
+def _percentile_from_buckets(bounds: Sequence[int], counts: Sequence[int],
+                             p: float) -> float:
+    """Percentile estimate from per-bucket counts (NOT cumulative).
+
+    Linear interpolation inside the winning bucket (Prometheus'
+    histogram_quantile does the same); the +Inf overflow bucket reports
+    its lower bound — an honest floor, never an invented ceiling."""
+    total = sum(counts)
+    if total <= 0 or not bounds:
+        return 0.0
+    rank = p * total
+    seen = 0.0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        if seen + c >= rank:
+            lo = float(bounds[i - 1]) if i > 0 and i - 1 < len(bounds) \
+                else 0.0
+            if i >= len(bounds):        # +Inf overflow bucket
+                return float(bounds[-1])
+            hi = float(bounds[i])
+            frac = (rank - seen) / c
+            return lo + (hi - lo) * frac
+        seen += c
+    return float(bounds[-1])
+
+
+class Histogram:
+    """Fixed log-bucket µs histogram: observe is O(log n_buckets) with
+    zero allocation (list index increments under a short lock — many
+    producer threads, consistent snapshots for the scraper)."""
+
+    __slots__ = ("bounds", "counts", "total", "sum_us", "_lock")
+
+    def __init__(self, bounds: Sequence[int] = DEFAULT_BUCKETS_US):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf overflow
+        self.total = 0
+        self.sum_us = 0
+        self._lock = threading.Lock()
+
+    def observe(self, us: float) -> None:
+        us_i = int(us)
+        i = bisect_left(self.bounds, us_i)
+        with self._lock:
+            self.counts[i] += 1
+            self.total += 1
+            self.sum_us += us_i
+
+    def reset(self) -> None:
+        """Zero the distribution (bench legs reset after warmup so the
+        scraped breakdown describes ONLY the measured traffic)."""
+        with self._lock:
+            self.counts = [0] * (len(self.bounds) + 1)
+            self.total = 0
+            self.sum_us = 0
+
+    def snapshot(self) -> Tuple[List[int], int, int]:
+        with self._lock:
+            return list(self.counts), self.total, self.sum_us
+
+    def percentile(self, p: float) -> float:
+        counts, total, _ = self.snapshot()
+        if not total:
+            return 0.0
+        return _percentile_from_buckets(self.bounds, counts, p)
+
+    def prometheus(self, name: str, labels: Optional[Dict[str, str]] = None
+                   ) -> List[str]:
+        """Series lines (no # TYPE header — the caller groups same-name
+        series under one header) in Prometheus histogram text format:
+        cumulative _bucket{le=...} + _sum + _count."""
+        counts, total, sum_us = self.snapshot()
+        base = "".join('%s="%s",' % (k, v)
+                       for k, v in (labels or {}).items())
+        lines = []
+        cum = 0
+        for i, bound in enumerate(self.bounds):
+            cum += counts[i]
+            lines.append('%s_bucket{%sle="%d"} %d'
+                         % (name, base, bound, cum))
+        cum += counts[-1]
+        lines.append('%s_bucket{%sle="+Inf"} %d' % (name, base, cum))
+        tail = ("{%s}" % base.rstrip(",")) if base else ""
+        lines.append("%s_sum%s %d" % (name, tail, sum_us))
+        lines.append("%s_count%s %d" % (name, tail, total))
+        return lines
 
 
 @dataclass
 class BatchTrace:
-    """One dispatch cycle's span record (all µs, wall-clock host side)."""
+    """One dispatch cycle's span record (all µs, wall-clock host side).
+
+    ``request_ids`` carries the FULL id list (wire req_ids as decoded by
+    serve/protocol.py), so ``/traces/request?id=`` can resolve any
+    recent verdict to its batch — not just a sample."""
 
     ts: float                 # unix time at dispatch start
     n_requests: int
@@ -35,7 +157,22 @@ class BatchTrace:
     batch_us: int             # full dispatch cycle
     engine_us: int            # device scan portion (cumulative delta)
     confirm_us: int           # CPU confirm portion (cumulative delta)
-    request_ids: List[str] = field(default_factory=list)  # sample, ≤8
+    request_ids: List[str] = field(default_factory=list)
+    prep_us: int = 0          # host prep (normalize/unpack/row build)
+
+    def stages(self) -> Dict[str, int]:
+        """Per-stage µs breakdown; ``other_us`` is the unattributed
+        remainder of the dispatch cycle (stream scan work, queue ops)."""
+        other = self.batch_us - self.prep_us - self.engine_us \
+            - self.confirm_us
+        return {
+            "queue_us": self.queue_delay_us,
+            "prep_us": self.prep_us,
+            "scan_us": self.engine_us,
+            "confirm_us": self.confirm_us,
+            "batch_us": self.batch_us,
+            "other_us": max(other, 0),
+        }
 
 
 class TraceRing:
@@ -60,7 +197,132 @@ class TraceRing:
         with self._lock:
             items = list(self._ring)
         items.sort(key=lambda t: t.batch_us, reverse=True)
-        return [asdict(t) for t in items[:n]]
+        out = []
+        for t in items[:n]:
+            d = asdict(t)
+            d["stages"] = t.stages()
+            out.append(d)
+        return out
+
+    def find_request(self, req_id: str) -> Optional[dict]:
+        """Newest batch containing ``req_id`` → span dict + stage
+        breakdown, or None when the id has aged out of the ring."""
+        with self._lock:
+            items = list(self._ring)
+        for t in reversed(items):
+            if req_id in t.request_ids:
+                d = asdict(t)
+                d["stages"] = t.stages()
+                return d
+        return None
+
+
+class SlowRing:
+    """The K slowest requests seen so far (min-heap by end-to-end µs):
+    a request displaces the fastest retained exemplar once the ring is
+    full.  O(log K) offer, tiny fixed memory — safe on the hot path."""
+
+    def __init__(self, capacity: int = 32):
+        self.capacity = capacity
+        self._heap: List[Tuple[int, int, dict]] = []
+        self._seq = 0           # tie-break: dicts don't compare
+        self._lock = threading.Lock()
+
+    def offer(self, e2e_us: int, exemplar: dict) -> None:
+        with self._lock:
+            self._seq += 1
+            item = (int(e2e_us), self._seq, exemplar)
+            if len(self._heap) < self.capacity:
+                heapq.heappush(self._heap, item)
+            elif item[0] > self._heap[0][0]:
+                heapq.heapreplace(self._heap, item)
+
+    def threshold(self) -> int:
+        """Smallest retained e2e_us once full, else -1 (everything
+        accepted).  Lock-free read — callers use it to skip building the
+        exemplar dict for fast requests on the dispatch thread; a stale
+        value only mis-skips a borderline exemplar (offer re-checks
+        under the lock).  The local ref makes the len-check and the
+        [0] index consistent against a concurrent reset(), which
+        REBINDS _heap (never mutates it empty)."""
+        heap = self._heap
+        if len(heap) < self.capacity:
+            return -1
+        return heap[0][0]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._heap = []   # rebind, never clear() — see threshold()
+
+    def snapshot(self, n: Optional[int] = None) -> List[dict]:
+        """Exemplars, slowest first."""
+        with self._lock:
+            items = sorted(self._heap, reverse=True)
+        if n is not None:
+            items = items[:n]
+        return [dict(e, e2e_us=us) for us, _, e in items]
+
+    def find_request(self, req_id: str) -> Optional[dict]:
+        for e in self.snapshot():
+            if e.get("request_id") == req_id:
+                return e
+        return None
+
+
+# --------------------------------------------------------------- parsing
+
+_BUCKET_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{(?P<labels>[^}]*)\}'
+    r'\s+(?P<value>\d+)\s*$')
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def stage_breakdown_from_metrics(text: str,
+                                 metric: str = "ipt_stage_us",
+                                 percentiles: Sequence[float] = (
+                                     0.5, 0.9, 0.99),
+                                 ) -> Optional[Dict[str, dict]]:
+    """Parse Prometheus histogram text → per-stage percentile table.
+
+    Returns ``{stage: {"count": n, "p50_us": x, "p90_us": y,
+    "p99_us": z}, ...}`` or None when the metric is absent or malformed
+    (non-monotonic cumulative counts, unparsable le) — callers treat
+    None as a LOUD diagnostic condition, never a silent pass
+    (ISSUE satellite: a missing stage_breakdown must be a visible bench
+    warning)."""
+    series: Dict[str, List[Tuple[float, int]]] = {}
+    for line in text.splitlines():
+        m = _BUCKET_RE.match(line.strip())
+        if not m or m.group("name") != metric:
+            continue
+        labels = dict(_LABEL_RE.findall(m.group("labels")))
+        stage = labels.get("stage")
+        le = labels.get("le")
+        if stage is None or le is None:
+            return None
+        try:
+            bound = float("inf") if le == "+Inf" else float(le)
+        except ValueError:
+            return None
+        series.setdefault(stage, []).append((bound, int(m.group("value"))))
+    if not series:
+        return None
+    out: Dict[str, dict] = {}
+    for stage, pts in series.items():
+        pts.sort(key=lambda bv: bv[0])
+        cum = [v for _, v in pts]
+        if any(b > a for a, b in zip(cum[1:], cum)):  # must be monotonic
+            return None
+        bounds = [b for b, _ in pts if b != float("inf")]
+        if not bounds:      # only a +Inf bucket survived = malformed
+            return None
+        counts = [cum[0]] + [b - a for a, b in zip(cum, cum[1:])]
+        entry = {"count": cum[-1]}
+        for p in percentiles:
+            entry["p%s_us" % format(p * 100, "g")] = round(
+                _percentile_from_buckets(bounds, counts, p), 1)
+        out[stage] = entry
+    return out
 
 
 @contextmanager

@@ -1,0 +1,194 @@
+"""Stage-level latency attribution end to end (ISSUE 1): real serve
+loop subprocess, real frames over a real socket, then the three
+observability surfaces — /metrics histograms, /traces/request?id=, and
+/debug/slow — must agree on the same request's stage timings, and the
+`dbg latency` CLI must parse the live endpoints."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+PORT = 19931
+
+TINY_RULES = """
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)union\\s+select" \
+    "id:942100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-sqli'"
+"""
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("obs")
+    rules_dir = tmp / "rules"
+    rules_dir.mkdir()
+    (rules_dir / "tiny.conf").write_text(TINY_RULES)
+    sock = str(tmp / "ipt.sock")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ingress_plus_tpu.serve",
+         "--socket", sock, "--http-port", str(PORT),
+         "--rules-dir", str(rules_dir), "--platform", "cpu",
+         "--max-delay-us", "1000", "--no-warmup"],
+        cwd=str(REPO), env=env, stderr=subprocess.PIPE, text=True)
+    for _ in range(600):
+        if Path(sock).exists():
+            try:
+                s = socket.socket(socket.AF_UNIX)
+                s.connect(sock)
+                s.close()
+                break
+            except OSError:
+                pass
+        if proc.poll() is not None:
+            raise RuntimeError("server died: %s" % proc.stderr.read())
+        time.sleep(0.1)
+    else:
+        proc.kill()
+        raise RuntimeError("server socket never appeared")
+    yield sock
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def _get(path):
+    return urllib.request.urlopen(
+        "http://127.0.0.1:%d%s" % (PORT, path), timeout=10).read()
+
+
+def _drive(sock_path, reqs):
+    """Send requests over the wire; return req_id → decoded verdict."""
+    from ingress_plus_tpu.serve.protocol import (
+        RESP_MAGIC, FrameReader, decode_response, encode_request)
+
+    s = socket.socket(socket.AF_UNIX)
+    s.connect(sock_path)
+    s.settimeout(120)
+    for req, rid in reqs:
+        s.sendall(encode_request(req, req_id=rid))
+    reader, got = FrameReader(RESP_MAGIC), {}
+    while len(got) < len(reqs):
+        for f in reader.feed(s.recv(65536)):
+            r = decode_response(f)
+            got[r["req_id"]] = r
+    s.close()
+    return got
+
+
+def test_surfaces_agree_on_stage_timings(server):
+    from ingress_plus_tpu.serve.normalize import Request
+
+    reqs = [(Request(uri="/item/%d?q=benign" % i,
+                     headers={"Host": "shop.example.com"},
+                     request_id=str(4000 + i)), 4000 + i)
+            for i in range(6)]
+    reqs.append((Request(uri="/q?a=1+union+select+2",
+                         request_id="4100"), 4100))
+    got = _drive(server, reqs)
+    assert got[4100]["attack"]
+
+    # --- /metrics: Prometheus stage histograms with real observations
+    metrics = _get("/metrics").decode()
+    for stage in ("queue", "prep", "scan", "confirm", "batch", "e2e"):
+        assert 'ipt_stage_us_bucket{stage="%s"' % stage in metrics, stage
+    assert "ipt_batch_size_bucket" in metrics
+    from ingress_plus_tpu.utils.trace import stage_breakdown_from_metrics
+    sb = stage_breakdown_from_metrics(metrics)
+    assert sb is not None
+    assert sb["e2e"]["count"] >= len(reqs)
+    assert sb["queue"]["count"] >= len(reqs)
+    assert sb["e2e"]["p99_us"] > 0
+
+    # --- /traces/request?id=: the wire req_id resolves to its batch
+    tr = json.loads(_get("/traces/request?id=4100"))
+    assert tr["found"] and tr["batch"] is not None
+    assert "4100" in tr["batch"]["request_ids"]
+    stages = tr["stages"]
+    assert stages["batch_us"] > 0
+    assert stages["batch_us"] >= stages["scan_us"] + stages["confirm_us"]
+
+    # --- /debug/slow: the same request's exemplar, with matching spans
+    slow = json.loads(_get("/debug/slow"))["slowest"]
+    assert slow, "slow ring empty after traffic"
+    ex = {e["request_id"]: e for e in slow}.get("4100")
+    assert ex is not None, "attack request not retained in slow ring"
+    # the exemplar's batch breakdown IS the batch's trace record — the
+    # three surfaces describe the same dispatch cycle
+    for k in ("prep_us", "scan_us", "confirm_us", "batch_us"):
+        assert ex["batch"][k] == stages[k], (k, ex["batch"], stages)
+    assert ex["e2e_us"] >= ex["queue_us"]
+    assert ex["e2e_us"] >= stages["scan_us"]
+    assert ex["rule_ids"] == [942100]
+    assert ex["input"]["uri_len"] == len("/q?a=1+union+select+2")
+    # ...and the e2e histogram's +Inf-cumulative covers the exemplar
+    assert sb["e2e"]["count"] >= 1
+
+    # unknown id: explicit not-found, never a 500
+    missing = json.loads(_get("/traces/request?id=999999"))
+    assert not missing["found"]
+
+
+def test_oversized_body_lands_in_slow_ring(server):
+    """The oversized side lane (likeliest slowest requests) must feed
+    the e2e histogram and the slow ring too — not vanish from the
+    attribution layer."""
+    from ingress_plus_tpu.serve.normalize import Request
+
+    body = b"P" * (64 << 10) + b" 1' union select password from users --"
+    got = _drive(server, [(Request(method="POST", uri="/upload",
+                                   body=body, request_id="4200"), 4200)])
+    assert got[4200]["attack"]
+    ex = None
+    for _ in range(40):     # side lane resolves asynchronously
+        slow = json.loads(_get("/debug/slow"))["slowest"]
+        ex = {e["request_id"]: e for e in slow}.get("4200")
+        if ex is not None:
+            break
+        time.sleep(0.25)
+    assert ex is not None, "oversized request missing from slow ring"
+    assert ex.get("oversized") is True
+    assert ex["input"]["body_len"] == len(body)
+    assert ex["rule_ids"] == [942100]
+    # its id resolves via the exemplar, NOT a batch record — the side
+    # lane's work must not be attributed to a batch's stage spans
+    tr = json.loads(_get("/traces/request?id=4200"))
+    assert tr["found"] and tr["batch"] is None
+    assert tr["exemplar"]["oversized"] is True
+
+
+def test_traces_slowest_carries_stage_breakdown(server):
+    body = json.loads(_get("/traces?slowest=5"))["traces"]
+    assert body
+    assert "stages" in body[0] and "prep_us" in body[0]["stages"]
+
+
+def test_dbg_latency_parses_live_endpoints(server, capsys):
+    """ISSUE 1 satellite: `dbg latency` drives the real endpoints and
+    renders a parseable stage table."""
+    from ingress_plus_tpu.control import dbg
+
+    rc = dbg.main(["latency", "--server", "127.0.0.1:%d" % PORT])
+    assert rc == 0
+    out = capsys.readouterr().out
+    lines = out.splitlines()
+    header = next(l for l in lines if l.startswith("stage"))
+    cols = header.split()
+    assert cols == ["stage", "count", "p50_us", "p90_us", "p99_us"]
+    rows = {}
+    for l in lines[lines.index(header) + 1:]:
+        if not l.strip():
+            break
+        parts = l.split()
+        rows[parts[0]] = [float(x) for x in parts[1:]]
+    for stage in ("queue", "prep", "scan", "confirm", "e2e"):
+        assert stage in rows, out
+        assert rows[stage][0] > 0          # count
+    assert "slowest requests" in out

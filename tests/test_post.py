@@ -119,6 +119,29 @@ def test_brute_separate_clients_tracked_separately():
     assert det.observe(hits) == []  # 9 per client < 10
 
 
+def test_dirbust_count_is_distinct_paths_not_window_hits():
+    """ADVICE r05: a chatty client re-fetching each swept path must
+    export the DISTINCT sweep size (what crossed dirbust_threshold),
+    not the inflated total window hit count."""
+    det = BruteDetector(BruteConfig(window_s=60, threshold=1000,
+                                    dirbust_threshold=10,
+                                    dirbust_window_s=60))
+    hits = []
+    t = 0.0
+    for i in range(12):             # 12 distinct paths...
+        for _ in range(3):          # ...fetched 3x each = 36 hits
+            hits.append(mk_hit(ts=t, uri="/backup/%02d/config.old" % i,
+                               attack=False, blocked=False, classes=()))
+            t += 0.1
+    attacks = det.observe(hits)
+    dirbusts = [a for a in attacks if a.attack_class == "dirbust"]
+    assert len(dirbusts) == 1
+    d = dirbusts[0]
+    assert 10 <= d.count <= 12, \
+        "count must be distinct paths (threshold-compared), got %d" % d.count
+    assert "distinct paths" in d.sample_points[0]["value"]
+
+
 # --------------------------------------------------------------- counters
 
 def test_counters_math():

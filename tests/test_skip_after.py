@@ -82,6 +82,50 @@ def test_paranoia_crosses_files(tmp_path):
     assert 942170 not in ids
 
 
+def test_uppercase_tx_macro_setvar_copy_resolves(tmp_path):
+    """ADVICE r05: CRS writes macros in canonical caps —
+    ``%{TX.blocking_paranoia_level}`` — and the static resolver must
+    match them case-insensitively, or skipAfter/paranoia resolution
+    silently no-ops on canonical CRS trees.  Here the one-hop setvar
+    copy rides the caps macro: if it resolves, detection PL = 1 and the
+    ``@lt 2`` skip IS taken (tier dropped); the old lowercase-only match
+    would invalidate the variable, abstain, and keep the tier."""
+    (tmp_path / "100-crs-setup.conf").write_text(
+        'SecAction "id:900000,phase:1,pass,nolog,'
+        'setvar:tx.blocking_paranoia_level=1,'
+        'setvar:tx.detection_paranoia_level=%{TX.blocking_paranoia_level}"'
+        '\n')
+    (tmp_path / "942-sqli.conf").write_text(
+        'SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 2" '
+        '"id:942013,phase:2,pass,nolog,skipAfter:END-SQLI-PL2"\n'
+        'SecRule ARGS "@rx (?i)sleep\\s*\\(" '
+        '"id:942170,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"\n'
+        'SecMarker "END-SQLI-PL2"\n')
+    ids = _ids(load_seclang_dir(tmp_path))
+    assert 942170 not in ids    # skip taken — the caps copy resolved
+    assert 942013 not in ids
+
+
+def test_uppercase_tx_macro_condition_argument_resolves(tmp_path):
+    """Same caps form in a condition ARGUMENT:
+    ``@lt %{TX.BLOCKING_PARANOIA_LEVEL}`` must compare against the
+    resolved value (1 < 2 → skip taken → tier dropped), not abstain."""
+    (tmp_path / "100-crs-setup.conf").write_text(
+        'SecAction "id:900000,phase:1,pass,nolog,'
+        'setvar:tx.blocking_paranoia_level=2,'
+        'setvar:tx.detection_paranoia_level=1"\n')
+    (tmp_path / "942-sqli.conf").write_text(
+        'SecRule TX:DETECTION_PARANOIA_LEVEL '
+        '"@lt %{TX.BLOCKING_PARANOIA_LEVEL}" '
+        '"id:942013,phase:2,pass,nolog,skipAfter:END-SQLI-PL2"\n'
+        'SecRule ARGS "@rx (?i)sleep\\s*\\(" '
+        '"id:942170,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"\n'
+        'SecMarker "END-SQLI-PL2"\n')
+    ids = _ids(load_seclang_dir(tmp_path))
+    assert 942170 not in ids    # 1 < 2 held through the caps macro
+    assert 942013 not in ids
+
+
 def test_non_static_condition_keeps_rules_active():
     """A skip condition on a request-time variable cannot resolve
     statically: everything stays active (the sound fallback), including

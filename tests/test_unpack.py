@@ -214,6 +214,50 @@ def test_parser_disable_suppresses_detection(pipeline):
     assert not pipeline.detect([req])[0].attack
 
 
+def test_confirm_body_stream_is_single_decoded():
+    """ADVICE r05 regression pin: the extra url-decoded form-body
+    segment is a SCAN-path aid only.  A scalar REQUEST_BODY rule with
+    its own t:urlDecodeUni must evaluate the single-decoded body —
+    ModSecurity never materializes a pre-decoded REQUEST_BODY copy, so
+    a %2527 body (one decode → %27, still no quote) must NOT confirm."""
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+
+    pl = DetectionPipeline(compile_ruleset(parse_seclang(
+        'SecRule REQUEST_BODY "@rx \'" "id:942170,phase:2,block,'
+        "t:urlDecodeUni,severity:CRITICAL,tag:'attack-sqli'\"")),
+        mode="block")
+    req = Request(
+        method="POST", uri="/login",
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+        body=b"q=%2527%2520OR%25201")
+    v = pl.detect([req])[0]
+    assert not v.attack, \
+        "confirm saw a double-decoded body copy (rule_ids=%s)" % v.rule_ids
+    # the confirm stream itself carries no scan-only extra segment
+    assert req.confirm_streams()["body"] == req.body
+    # ...while the scan stream keeps it (prefilter-soundness superset)
+    assert req.streams()["body"] != req.body
+
+
+def test_double_encoded_args_payload_still_detected():
+    """Counterpart (the round-5 soundness fix must survive): a fully
+    double-encoded ARGS payload in a form body is still detected end to
+    end — the scan-only decoded segment gives the prefilter its factors,
+    and the confirm matches via the parsed ARGS value + the rule's own
+    t:urlDecodeUni (single source of double-decode, like ModSecurity)."""
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+
+    pl = DetectionPipeline(compile_ruleset(parse_seclang(
+        'SecRule ARGS "@rx (?i)union\\s+select" "id:942100,phase:2,'
+        "block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-sqli'\"")),
+        mode="block")
+    v = pl.detect([Request(
+        method="POST", uri="/search",
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+        body=b"q=union%2520select%2520password")])[0]
+    assert v.attack and 942100 in v.rule_ids
+
+
 def test_benign_json_still_passes(pipeline):
     # well-formed client headers: the round-4 920 protocol-hygiene
     # ladder correctly scores requests that omit Host/UA/Content-Length

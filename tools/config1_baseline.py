@@ -105,6 +105,9 @@ def main() -> int:
              "--connections", str(args.connections),
              "--inflight", str(args.inflight), "--requests", "512"],
             capture_output=True, timeout=600)
+        # stage histograms describe ONLY the measured pass (warmup's
+        # first-dispatch compiles would otherwise dominate p99)
+        batcher.reset_latency_observations()
         out = subprocess.run(
             [loadgen, "--socket", side_sock, "--corpus", corpus_path,
              "--connections", str(args.connections),
@@ -117,6 +120,18 @@ def main() -> int:
                   file=sys.stderr)
             return 1
         r = json.loads(out.stdout)
+        # stage-level latency attribution (ISSUE 1): same scrape path as
+        # bench.py's latency leg; missing histograms are a LOUD warning
+        from bench import scrape_stage_breakdown
+        try:
+            stage_breakdown = scrape_stage_breakdown(serve)
+        except Exception as e:
+            stage_breakdown = None
+            print("WARNING: stage_breakdown scrape raised: %r" % (e,),
+                  file=sys.stderr)
+        if not stage_breakdown:
+            print("WARNING: no stage_breakdown — /metrics stage "
+                  "histograms missing or malformed", file=sys.stderr)
         result = {
             "config": ("BASELINE config #1: wallarm-mode=monitoring, "
                        "strict-grammar (libdetection analog) confirm in "
@@ -128,6 +143,7 @@ def main() -> int:
             "p50_us": r["p50_us"], "p90_us": r["p90_us"],
             "p99_us": r["p99_us"], "p999_us": r["p999_us"],
             "fail_open": r["fail_open"],
+            "stage_breakdown": stage_breakdown,
             "flagged": r["attacks"],
             "blocked": r["blocked"],
             "mode": "monitoring",
