@@ -1,0 +1,70 @@
+"""CLI: ``python -m ingress_plus_tpu.analysis``.
+
+    python -m ingress_plus_tpu.analysis                    # bundled tree
+    python -m ingress_plus_tpu.analysis --rules path/ --format sarif
+    python -m ingress_plus_tpu.analysis --format json --output reports/RULECHECK.json
+
+Exit code 0 when no unsuppressed finding reaches ``--fail-on`` severity
+(default: error) — the CI gate contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from ingress_plus_tpu.analysis import (
+    BaselineError,
+    SEVERITIES,
+    run_rulecheck,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ingress_plus_tpu.analysis")
+    ap.add_argument("--rules", default=None,
+                    help="rules tree (directory of *.conf, or an entry "
+                         "config); default: the bundled CRS tree")
+    ap.add_argument("--format", choices=["text", "json", "sarif"],
+                    default="text")
+    ap.add_argument("--baseline", default="auto",
+                    help="suppression baseline JSON; 'auto' (default) "
+                         "uses <rules>/rulecheck-baseline.json, 'none' "
+                         "disables suppression")
+    ap.add_argument("--fail-on", choices=list(SEVERITIES),
+                    default="error",
+                    help="exit nonzero when an unsuppressed finding of "
+                         "this severity (or worse) exists")
+    ap.add_argument("--output", default=None,
+                    help="also write the rendered report to this path")
+    args = ap.parse_args(argv)
+
+    from ingress_plus_tpu.compiler.seclang import SecLangError
+
+    baseline = None if args.baseline == "none" else args.baseline
+    try:
+        report = run_rulecheck(rules_path=args.rules,
+                               baseline_path=baseline)
+    except (OSError, BaselineError, SecLangError) as e:
+        print("rulecheck: %s" % e, file=sys.stderr)
+        return 2
+
+    out = {"text": report.to_text, "json": report.to_json,
+           "sarif": report.to_sarif}[args.format]()
+    if args.output:
+        Path(args.output).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.output).write_text(out)
+    print(out, end="")
+
+    gating = report.gating(args.fail_on)
+    if gating:
+        print("rulecheck: %d unsuppressed finding(s) at or above "
+              "severity %r" % (len(gating), args.fail_on),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

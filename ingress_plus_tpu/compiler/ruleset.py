@@ -57,7 +57,10 @@ from ingress_plus_tpu.compiler.seclang import (
     Rule,
     STREAMS,
     STREAM_INDEX,
+    _classify_setvar,
     _id_matcher,
+    _invalidate_tx_names,
+    _static_skip_condition,
 )
 
 #: scan-row normalization variants (serve/normalize.py variant_chain).
@@ -82,6 +85,14 @@ _PATH_TRANSFORMS = {"normalizePath", "normalisePath", "normalizePathWin"}
 #: post-transform pattern could miss the pre-transform bytes, so rules
 #: carrying them compile always-confirm (sound; exact CPU evaluation)
 _COMMENT_TRANSFORMS = {"replaceComments", "removeCommentsChar"}
+#: decode transforms with NO scan-variant twin (the lanes only model
+#: urlDecode(Uni) + htmlEntityDecode): the pattern matches DECODED text
+#: but the scanned rows hold the encoded form — base64("expression(")
+#: contains no "expression" — so factors from these rules can miss
+#: every true match.  Always-confirm instead (rulecheck PR: the
+#: lane.unmodeled-decode analyzer class pins this invariant).
+_UNMODELED_DECODE_TRANSFORMS = {"base64Decode", "hexDecode", "jsDecode",
+                                "cssDecode"}
 _WS_BYTES = frozenset([0x20, 0x09, 0x0A, 0x0D, 0x0C, 0x0B])
 # Bytes deleted by the squash variants (stream side AND factor side).
 # Superset of what cmdLine deletes; whitespace covers compress/remove.
@@ -142,22 +153,24 @@ def resolve_macros(text: str, env: Dict[str, str],
 
 
 def _apply_setvars(env: Dict[str, str], setvars: List[str]) -> None:
-    """Fold setvar actions into the static env (assignment form only —
-    '+='-style increments are per-request state, handled as rule
-    scores, not env mutations)."""
+    """Fold setvar actions into the static env.  Form normalization is
+    shared with the parse-time env (seclang._classify_setvar) so the
+    two layers can never diverge; the compile env differs only in
+    resolving full multi-hop %{tx.*} macros.  Deletes, increments and
+    unresolvable macros INVALIDATE the entry — a stale literal would
+    expand into confirm arguments ModSecurity evaluates differently."""
     for sv in setvars:
-        name, sep, val = sv.partition("=")
-        if not sep:
+        key, kind, val = _classify_setvar(sv)
+        if kind is None:
             continue
-        name = name.strip().lower()
-        if not name.startswith("tx."):
+        if kind in ("delete", "increment"):
+            env.pop(key, None)
             continue
-        val = val.strip()
-        if val.startswith("+") or val.startswith("-"):
-            continue   # per-request increment, not a config assignment
         resolved = resolve_macros(val, env)
         if resolved is not None:
-            env[name[3:]] = resolved
+            env[key] = resolved
+        else:
+            env.pop(key, None)
 
 
 def _anomaly_increment(rule: Rule, env: Dict[str, str]) -> Optional[int]:
@@ -463,9 +476,15 @@ def _factor_group_for(rule: Rule) -> Tuple[F.Group, Dict]:
         words = [w for w in (w.strip() for w in words) if w]
         confirm["words"] = words
         group = [F.best_window(_lit_seq(w, fold=True)) for w in words]
-    elif op in ("contains", "containsWord", "streq", "beginsWith", "endsWith",
-                "within"):
+    elif op in ("contains", "containsWord", "streq", "beginsWith",
+                "endsWith"):
         group = [F.best_window(_lit_seq(rule.argument, fold))]
+    # @within is NOT in the literal family: it inverts containment (the
+    # VARIABLE must occur inside the argument), so a short variable
+    # value matches without the stream ever containing the full
+    # argument — a factor over the argument text would silently kill
+    # the rule (rulecheck PR: found statically by the prefilter audit's
+    # certification pass).  Confirm-only.
     elif op == "detectSQLi":
         group = [F.best_window(_lit_seq(w, True)) for w in _SQLI_TRIGGERS]
     elif op == "detectXSS":
@@ -500,6 +519,8 @@ def _factor_group_for(rule: Rule) -> Tuple[F.Group, Dict]:
     # Soundness fix-ups for destructive transforms (see module docstring).
     t = set(rule.transforms)
     if t & _COMMENT_TRANSFORMS:
+        return [], confirm
+    if t & _UNMODELED_DECODE_TRANSFORMS:
         return [], confirm
     if t & _PATH_TRANSFORMS and group:
         group = _split_at(group, _PATH_SEP_BYTES)
@@ -542,7 +563,13 @@ def compile_ruleset(
     resolvable %{tx.*} macros in operator arguments are expanded so the
     confirm stage sees literal values.
     """
-    # ---- pass 0: static TX environment + config-rule partition
+    # ---- pass 0: static TX environment + config-rule partition.
+    # Mirrors the parser's conditional-setvar semantics (seclang.py):
+    # a SecRule whose condition resolves statically TRUE folds like a
+    # SecAction, FALSE never fires, and a request-dependent condition
+    # INVALIDATES its written names — review finding: folding only
+    # SecActions left this env disagreeing with the parse-time env on
+    # the same tree (unresolved thresholds, stale macro expansions).
     env: Dict[str, str] = dict(_TX_DEFAULTS)
     scannable = []
     anomaly_threshold: Optional[int] = None
@@ -552,6 +579,23 @@ def compile_ruleset(
             _apply_setvars(env, rule.setvars)   # SecAction config rule
             continue
         scannable.append(rule)
+        sv_chain = list(rule.setvars)
+        if rule.chain is not None:
+            verdict = None          # conjunction: never static here
+            link: Optional[Rule] = rule.chain
+            while link is not None:
+                sv_chain.extend(link.setvars)
+                link = link.chain
+        elif sv_chain:
+            verdict = _static_skip_condition(
+                "|".join(rule.raw_targets), rule.negate, rule.operator,
+                rule.argument, env)
+        if sv_chain:
+            if verdict is True:
+                _apply_setvars(env, sv_chain)
+            elif verdict is None:
+                _invalidate_tx_names(env, sv_chain)
+            # statically FALSE: the rule never fires — env untouched
     if "detection_paranoia_level" in env or "paranoia_level" in env:
         try:
             paranoia_hint: Optional[int] = int(

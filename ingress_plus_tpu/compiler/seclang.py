@@ -178,23 +178,33 @@ class SecLangError(Exception):
     pass
 
 
-def _logical_lines(text: str) -> List[str]:
-    """Join backslash-continued lines; strip comments/blank lines."""
-    out: List[str] = []
-    cur = ""
-    for raw in text.splitlines():
+def _logical_lines_numbered(text: str) -> List[tuple]:
+    """(first_line_no, joined_line) pairs: backslash-continued lines
+    joined, comments/blank lines stripped.  The single implementation of
+    the line-joining rules — the rulecheck analyzer's position-aware
+    directive scanner (analysis/scan.py) shares it so reported line
+    numbers can never drift from what the parser loads."""
+    out: List[tuple] = []
+    cur, cur_start = "", 0
+    for i, raw in enumerate(text.splitlines(), 1):
         line = raw.rstrip()
         if not cur and (not line.strip() or line.lstrip().startswith("#")):
             continue
+        if not cur:
+            cur_start = i
         if line.endswith("\\"):
             cur += line[:-1] + " "
             continue
         cur += line
-        out.append(cur.strip())
+        out.append((cur_start, cur.strip()))
         cur = ""
     if cur.strip():
-        out.append(cur.strip())
+        out.append((cur_start, cur.strip()))
     return out
+
+
+def _logical_lines(text: str) -> List[str]:
+    return [line for _, line in _logical_lines_numbered(text)]
 
 
 def _split_directive(line: str) -> List[str]:
@@ -330,12 +340,26 @@ def _static_skip_condition(targets_txt: str, negate: bool, operator: str,
     the skipped-over rules ACTIVE, the sound fallback."""
     toks = [t.strip().strip("'\"") for t in targets_txt.split("|")
             if t.strip()]
-    if len(toks) != 1 or not toks[0].upper().startswith("TX:"):
+    if len(toks) != 1:
         return None
-    var = toks[0].split(":", 1)[1].strip().lower()
+    tok = toks[0]
+    count_form = tok.startswith("&")
+    if count_form:
+        tok = tok[1:].strip()
+    if not tok.upper().startswith("TX:"):
+        return None
+    var = tok.split(":", 1)[1].strip().lower()
     val = tx.get(var)
     if val is None:
         return None
+    if count_form:
+        # &TX:var — the variable's COUNT: statically-set means exactly
+        # one.  Without this, the canonical CRS-901 defaulting idiom
+        # (SecRule &TX:x "@eq 0" "...,setvar:tx.x=1") was undecidable
+        # and its invalidation killed static paranoia gating on real
+        # trees (review finding).  An env MISS already returned None
+        # above: the runtime count could be 0 or 1, so we abstain.
+        val = "1"
     arg = argument.strip().strip("'\"")
     # CRS writes macros in canonical caps — %{TX.blocking_paranoia_level}
     # — so the match must be case-insensitive or static skipAfter
@@ -360,6 +384,63 @@ def _static_skip_condition(targets_txt: str, negate: bool, operator: str,
     return (not res) if negate else res
 
 
+def _inert_config_rule(actions: Dict[str, List[str]],
+                       setvars: List[str]) -> Rule:
+    """Setvar assignments as an inert config rule (unconditionalMatch,
+    no targets, pass): the compile-time partition (ruleset.py pass 0)
+    folds these into its static TX env and drops them from the pack.
+    Shared by the SecAction path and the statically-true skipAfter
+    control-rule path."""
+    try:
+        rid = int(actions.get("id", ["0"])[0] or 0)
+    except ValueError:
+        rid = 0
+    return Rule(rule_id=rid, operator="unconditionalMatch", argument="",
+                targets=[], raw_targets=[], action="pass",
+                setvars=setvars)
+
+
+def _classify_setvar(sv: str):
+    """One setvar action → ``(key, kind, value)`` with kind one of
+    ``"delete"`` (``!tx.name``), ``"set"`` (literal or value-less "set
+    to 1"), ``"increment"`` (``=+``/``=-``), or ``None`` for non-TX
+    targets.  The SINGLE normalization shared by the parse-time env
+    (_fold_tx_assignments), the compile-time env (ruleset._apply_
+    setvars) and the analyzer mirrors — review finding: hand-copies of
+    these rules diverged on the delete and value-less forms."""
+    name, sep, value = sv.partition("=")
+    name = name.strip().lower()
+    if name.startswith("!"):
+        bare = name[1:].strip()
+        if bare.startswith("tx."):
+            return bare[3:], "delete", ""
+        return None, None, ""
+    if not name.startswith("tx."):
+        return None, None, ""
+    key = name[3:]
+    if not sep:
+        return key, "set", "1"     # value-less form: ModSec "set to 1"
+    value = value.strip()
+    if value[:1] in ("+", "-"):
+        return key, "increment", value
+    return key, "set", value
+
+
+def _invalidate_tx_names(tx: Dict[str, str], setvars: List[str]) -> List[str]:
+    """Drop every TX name these setvars write from the parse-time env
+    (request-dependent writes: later static conditions on them must
+    abstain).  Returns the popped-or-missing names.  Shared with the
+    rulecheck analyzer's TX-env mirror (analysis/scan.static_tx_env) so
+    the parser and its auditor can never disagree on the normalization."""
+    names = []
+    for sv in setvars:
+        key, kind, _value = _classify_setvar(sv)
+        if kind is not None:
+            tx.pop(key, None)
+            names.append(key)
+    return names
+
+
 def _fold_tx_assignments(tx: Dict[str, str], setvars: List[str]) -> None:
     """Record literal ``tx.name=value`` assignments (and one-hop
     ``%{tx.other}`` copies) in the parse-time TX env.  An increment
@@ -369,13 +450,13 @@ def _fold_tx_assignments(tx: Dict[str, str], setvars: List[str]) -> None:
     dropped rules ModSecurity would run) — an undecidable variable
     makes conditions on it abstain, which keeps rules active."""
     for sv in setvars:
-        name, sep, value = sv.partition("=")
-        name = name.strip().lower()
-        if not sep or not name.startswith("tx."):
+        key, kind, value = _classify_setvar(sv)
+        if kind is None:
             continue
-        key = name[3:]
-        value = value.strip()
-        if value[:1] in ("+", "-"):
+        if kind in ("delete", "increment"):
+            # delete: the variable is unset (a stale literal would make
+            # later skipAfter conditions confidently wrong); increment:
+            # the value is request-dependent — both invalidate
             tx.pop(key, None)
             continue
         # one-hop copies also arrive as %{TX.other} on canonical trees
@@ -499,14 +580,7 @@ def parse_seclang(
             sv = [v.strip("'\"") for v in actions.get("setvar", []) if v]
             _fold_tx_assignments(_skip_state["tx"], sv)
             if sv:
-                try:
-                    rid = int(actions.get("id", ["0"])[0] or 0)
-                except ValueError:
-                    rid = 0
-                rules.append(Rule(
-                    rule_id=rid, operator="unconditionalMatch",
-                    argument="", targets=[], raw_targets=[],
-                    action="pass", setvars=sv))
+                rules.append(_inert_config_rule(actions, sv))
             if actions.get("skipAfter"):
                 # unconditional SecAction skip: setvars above still
                 # applied (they execute before the jump in ModSecurity)
@@ -693,6 +767,19 @@ def parse_seclang(
                 targets_txt, negate, operator, argument,
                 _skip_state["tx"])
             if verdict is True:
+                # the rule fires: its setvars execute BEFORE the jump
+                # (ModSecurity action order — same as the SecAction
+                # path above; review finding: skipping the fold left a
+                # stale literal that mis-skipped a later tier)
+                sv = [v.strip("'\"") for v in actions.get("setvar", [])
+                      if v]
+                _fold_tx_assignments(_skip_state["tx"], sv)
+                if sv:
+                    # keep the assignments as an inert config rule so
+                    # the COMPILE-time env folds them too (review
+                    # finding: dropping the control rule entirely left
+                    # stale values in %{tx.*} confirm expansions)
+                    rules.append(_inert_config_rule(actions, sv))
                 # the jump is scoped to THIS control rule's phase
                 _skip_state["skips"].append(
                     (marker, _phase_key(actions)))
@@ -759,6 +846,28 @@ def parse_seclang(
                      if v],
             ctls=[v.strip("'\"") for v in actions.get("ctl", []) if v],
         )
+
+        # SecRule-carried setvars vs the parse-time TX env (the SECLANG.md
+        # "remaining limitation", now handled): a conditional rule whose
+        # condition itself resolves STATICALLY TRUE folds its assignments
+        # like a SecAction; a request-dependent condition INVALIDATES the
+        # written names instead, so a later skipAfter condition on them
+        # abstains (keeps rules active — sound) rather than trusting the
+        # stale SecAction literal it would otherwise still see (silent
+        # mis-skip).  Chain rules are conjunctions across links — never
+        # statically decidable here — so they always invalidate.
+        if rule.setvars:
+            if pending_chain is not None or "chain" in actions:
+                sv_verdict = None
+            else:
+                sv_verdict = _static_skip_condition(
+                    targets_txt, negate, operator, argument,
+                    _skip_state["tx"])
+            if sv_verdict is True:
+                _fold_tx_assignments(_skip_state["tx"], rule.setvars)
+            elif sv_verdict is None:
+                _invalidate_tx_names(_skip_state["tx"], rule.setvars)
+            # statically FALSE: the rule can never fire — env untouched
 
         if pending_chain is not None:
             # attach to deepest chain link

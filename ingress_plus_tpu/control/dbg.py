@@ -11,11 +11,18 @@ state.  Same idea against our HTTP plane:
     python -m ingress_plus_tpu.control.dbg tenants --set '{"1": ["attack-sqli"]}'
     python -m ingress_plus_tpu.control.dbg ruleset --swap /path/artifact \
         [--paranoia 2]
+    python -m ingress_plus_tpu.control.dbg rulecheck [--rules path] \
+        [--fail-on error]
 
 ``latency`` renders the serve plane's stage-level latency attribution
 (ISSUE 1): per-stage p50/p90/p99 from the /metrics histograms plus the
 /debug/slow exemplar ring as terminal tables; ``--sidecar`` adds the
 native sidecar's per-upstream EWMA hop timing from its --status-port.
+
+``rulecheck`` runs the static ruleset analyzer (ISSUE 2, analysis/ —
+see docs/ANALYSIS.md) locally over a rules tree (default: the bundled
+CRS tree) and renders the findings table; exit code mirrors the CI
+gate (nonzero on unsuppressed findings at/above ``--fail-on``).
 """
 
 from __future__ import annotations
@@ -90,8 +97,14 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ingress_plus_tpu.control.dbg")
     ap.add_argument("cmd",
                     choices=["conf", "health", "metrics", "latency",
-                             "tenants", "ruleset", "acl"])
+                             "tenants", "ruleset", "acl", "rulecheck"])
     ap.add_argument("--server", default="127.0.0.1:9901")
+    ap.add_argument("--rules", default=None,
+                    help="rulecheck: rules tree to analyze (default: "
+                         "the bundled CRS tree)")
+    ap.add_argument("--fail-on", default="error",
+                    choices=["error", "warning", "notice", "info"],
+                    help="rulecheck: gate severity for the exit code")
     ap.add_argument("--set", dest="set_json", default=None,
                     help="tenants: JSON tenant→tags table to push")
     ap.add_argument("--swap", default=None,
@@ -101,6 +114,16 @@ def main(argv=None) -> int:
                     help="latency: also scrape the native sidecar's "
                          "--status-port JSON at this host:port")
     args = ap.parse_args(argv)
+
+    if args.cmd == "rulecheck":
+        # local analysis, no serve plane involved — delegate to the
+        # analyzer CLI so dbg and `python -m ingress_plus_tpu.analysis`
+        # render and gate identically
+        from ingress_plus_tpu.analysis.__main__ import main as rc_main
+        rc_args = ["--fail-on", args.fail_on]
+        if args.rules:
+            rc_args += ["--rules", args.rules]
+        return rc_main(rc_args)
 
     try:
         if args.cmd == "latency":

@@ -1,0 +1,766 @@
+"""rulecheck static analyzer (ISSUE 2, ingress_plus_tpu/analysis/).
+
+Every check class gets a FAILING synthetic fixture plus a clean
+counterpart, and the bundled CRS tree is pinned clean of error-severity
+findings (the CI gate contract, docs/ANALYSIS.md)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from ingress_plus_tpu.analysis import (
+    Baseline,
+    BaselineError,
+    Finding,
+    run_rulecheck,
+)
+from ingress_plus_tpu.analysis.lanecheck import check_lanes
+from ingress_plus_tpu.analysis.prefilter_audit import (
+    audit_prefilter,
+    certify,
+    decode_factors,
+    derive_group,
+)
+from ingress_plus_tpu.analysis.reach import check_reachability
+from ingress_plus_tpu.analysis.redos import (
+    check_regex_hazards,
+    hazards_for_pattern,
+)
+from ingress_plus_tpu.analysis.scan import scan_tree
+from ingress_plus_tpu.analysis.txflow import check_tx_dataflow
+from ingress_plus_tpu.compiler.bitap import pack_factors
+from ingress_plus_tpu.compiler.regex_ast import parse_regex
+from ingress_plus_tpu.compiler.ruleset import RuleMeta, compile_ruleset
+from ingress_plus_tpu.compiler.seclang import Rule, parse_seclang
+
+
+def _checks(findings, severity=None):
+    return {f.check for f in findings
+            if severity is None or f.severity == severity}
+
+
+def _meta(op="rx", arg="", targets=("args",), transforms=(), variant=0,
+          has_prefilter=False, rid=1000, **confirm_extra):
+    rule = Rule(rule_id=rid, operator=op, argument=arg,
+                targets=list(targets), transforms=list(transforms))
+    confirm = {"op": op, "arg": arg, "transforms": list(transforms),
+               "fold": False, "variant": variant,
+               "targets": list(targets),
+               "raw_targets": ["ARGS"], **confirm_extra}
+    return RuleMeta(rule=rule, index=0, variant=variant,
+                    has_prefilter=has_prefilter, confirm=confirm)
+
+
+def _lit(text):
+    return tuple(frozenset([b]) for b in text.encode())
+
+
+# ------------------------------------------------- 1. prefilter audit
+
+
+def test_prefilter_sound_rule_certifies():
+    rules = parse_seclang(
+        'SecRule ARGS "@rx (?i)union\\s+select" '
+        '"id:1,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"')
+    cr = compile_ruleset(rules)
+    findings = audit_prefilter(cr.rules, cr.tables)
+    assert "prefilter.uncertified" not in _checks(findings)
+    assert "prefilter.table-corrupt" not in _checks(findings)
+
+
+def test_prefilter_unsound_factor_flagged():
+    """A case-sensitive factor packed for a case-folded rule loses the
+    upper-case matches: the audit must refuse to certify it."""
+    meta = _meta(op="rx", arg="(?i)select", fold=True)
+    meta.has_prefilter = True
+    tables = pack_factors([[_lit("select")]], n_rules=1)  # NOT folded
+    findings = audit_prefilter([meta], tables)
+    assert "prefilter.uncertified" in _checks(findings, "error")
+
+
+def test_prefilter_non_mandatory_factor_flagged():
+    """A factor that only covers ONE alternation branch is not
+    mandatory — matches of the other branch escape the prefilter."""
+    meta = _meta(op="rx", arg="select|union")
+    meta.has_prefilter = True
+    tables = pack_factors([[_lit("select")]], n_rules=1)
+    findings = audit_prefilter([meta], tables)
+    assert "prefilter.uncertified" in _checks(findings, "error")
+
+
+def test_prefilter_within_factor_flagged():
+    """@within inverts containment (variable inside argument): any
+    packed factor is unsound — short values escape it."""
+    meta = _meta(op="within", arg="HTTP/1.0 HTTP/1.1")
+    meta.has_prefilter = True
+    tables = pack_factors([[_lit("HTTP/1.0 HTTP/1.1")]], n_rules=1)
+    findings = audit_prefilter([meta], tables)
+    assert "prefilter.uncertified" in _checks(findings, "error")
+
+
+def test_prefilter_coverage_gap_flagged():
+    """An rx rule with a derivable factor but an empty packed group is
+    a coverage gap (missed prefilter power), not an accepted fall-through."""
+    meta = _meta(op="rx", arg="xp_cmdshell")
+    tables = pack_factors([[]], n_rules=1)
+    findings = audit_prefilter([meta], tables)
+    assert "prefilter.coverage-gap" in _checks(findings, "warning")
+
+
+def test_prefilter_confirm_only_reasons_are_info():
+    rules = parse_seclang(
+        'SecRule ARGS "!@rx ^[a-z]+$" "id:10,phase:2,block"\n'
+        'SecRule &ARGS "@eq 0" "id:11,phase:2,block"\n'
+        'SecRule REQUEST_METHOD "@rx ^(?:GET|POST)$" "id:12,phase:1,block"\n')
+    cr = compile_ruleset(rules)
+    findings = audit_prefilter(cr.rules, cr.tables)
+    infos = [f for f in findings if f.check == "prefilter.confirm-only"]
+    assert {f.rule_id for f in infos} == {10, 11, 12}
+    assert all(f.severity == "info" for f in infos)
+    assert not _checks(findings, "error")
+
+
+def test_prefilter_weak_factor_notice():
+    meta = _meta(op="rx", arg="[a-z0-9_.]")  # 38 bytes ≈ 2.8 bits
+    meta.has_prefilter = True
+    tables = pack_factors(
+        [[(frozenset(b"abcdefghijklmnopqrstuvwxyz0123456789_."),)]],
+        n_rules=1)
+    findings = audit_prefilter([meta], tables)
+    assert "prefilter.weak-factor" in _checks(findings, "notice")
+    assert "prefilter.uncertified" not in _checks(findings)
+
+
+def test_decode_factors_roundtrip():
+    group = [_lit("passwd"), _lit("shadow")]
+    tables = pack_factors([group], n_rules=1)
+    decoded = decode_factors(tables)
+    assert sorted(decoded) == sorted(group)
+
+
+def test_certify_primitives():
+    assert certify(parse_regex("union select"), [_lit("union")])
+    assert not certify(parse_regex("union|select"), [_lit("union")])
+    assert certify(parse_regex("union|select"),
+                   [_lit("union"), _lit("select")])
+    assert certify(parse_regex("(?:abc)+"), [_lit("abc")])
+    # squash lane: whitespace positions vanish on both sides (the
+    # enumerable bounded-whitespace shape the compiler squash-packs)
+    assert certify(parse_regex("union\\s{1,4}select"),
+                   [_lit("unionselect")], squash=True)
+    # …but an unbounded \s+ splits the pattern into runs, so the joined
+    # factor is NOT certifiable while the per-run factors are
+    assert not certify(parse_regex("union\\s+select"),
+                       [_lit("unionselect")], squash=True)
+    assert certify(parse_regex("union\\s+select"), [_lit("union")],
+                   squash=True)
+    assert derive_group(parse_regex("xp_cmdshell")) is not None
+    assert derive_group(parse_regex("[a-z]*")) is None
+
+
+def test_certify_survives_enumeration_overflow():
+    """Review finding (round 3): a wide alternation followed by the
+    factor-bearing part must not lose the part to the run-cap reset —
+    that produced false uncertified errors on sound groups."""
+    wide = "|".join("w%03d" % i for i in range(200))
+    ast = parse_regex("(?:%s)(?:SELECT|UNION)" % wide)
+    assert certify(ast, [_lit("SELECT"), _lit("UNION")])
+
+
+# --------------------------------------- 2. control-flow reachability
+
+
+def _scan_text(tmp_path, name, text):
+    (tmp_path / name).write_text(text)
+    return scan_tree(tmp_path)
+
+
+def test_flow_dangling_marker_error(tmp_path):
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.pl=1"\n'
+        'SecRule TX:PL "@lt 2" "id:100,phase:2,pass,skipAfter:NO-SUCH"\n'
+        'SecRule ARGS "@rx evil" "id:101,phase:2,block"\n')
+    findings = check_reachability(scans)
+    assert "flow.dangling-marker" in _checks(findings, "error")
+    assert any(f.subject == "NO-SUCH" for f in findings)
+
+
+def test_flow_marker_present_clean(tmp_path):
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.pl=1"\n'
+        'SecRule TX:PL "@lt 2" "id:100,phase:2,pass,skipAfter:END-T"\n'
+        'SecRule ARGS "@rx evil" "id:101,phase:2,block"\n'
+        'SecMarker "END-T"\n')
+    findings = check_reachability(scans)
+    assert "flow.dangling-marker" not in _checks(findings)
+
+
+def test_flow_marker_splits_chain_error(tmp_path):
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecRule ARGS "@rx one" "id:200,phase:2,block,chain"\n'
+        'SecMarker "MID"\n'
+        '    SecRule ARGS "@rx two"\n')
+    findings = check_reachability(scans)
+    assert "flow.marker-splits-chain" in _checks(findings, "error")
+
+
+def test_flow_unreachable_at_every_paranoia_level(tmp_path):
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 99" '
+        '"id:300,phase:2,pass,skipAfter:END-P"\n'
+        'SecRule ARGS "@rx never" "id:301,phase:2,block"\n'
+        'SecMarker "END-P"\n')
+    findings = check_reachability(scans)
+    unreachable = [f for f in findings
+                   if f.check == "flow.unreachable-paranoia"]
+    assert [f.rule_id for f in unreachable] == [301]
+
+
+def test_flow_pl2_tier_is_reachable(tmp_path):
+    """A @lt 2 gate is active at PL>=2 — NOT unreachable."""
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 2" '
+        '"id:310,phase:2,pass,skipAfter:END-P"\n'
+        'SecRule ARGS "@rx pl2" "id:311,phase:2,block"\n'
+        'SecMarker "END-P"\n')
+    findings = check_reachability(scans)
+    assert "flow.unreachable-paranoia" not in _checks(findings)
+
+
+def test_flow_conditional_write_keeps_rule_reachable(tmp_path):
+    """Review finding: a gate variable rewritten by a request-dependent
+    SecRule is undecidable — the parser keeps the region ACTIVE, and
+    the reachability sweep must agree (no false unreachable warning)."""
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.mode=1"\n'
+        'SecRule REQUEST_HEADERS:X-M "@streq on" "id:901,phase:1,pass,'
+        "setvar:'tx.mode=2'\"\n"
+        'SecRule TX:MODE "@eq 1" "id:902,phase:2,pass,skipAfter:END-X"\n'
+        'SecRule ARGS "@rx x" "id:903,phase:2,block"\n'
+        'SecMarker "END-X"\n')
+    findings = check_reachability(scans)
+    assert "flow.unreachable-paranoia" not in _checks(findings)
+
+
+def test_flow_statically_folded_write_still_detects_unreachable(tmp_path):
+    """Review finding (round 2): a statically-TRUE SecRule write FOLDS
+    (the parser drops the gated tier at every setting), so the sweep
+    must still report the tier unreachable — not abstain."""
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.mode=1"\n'
+        'SecRule TX:MODE "@eq 1" "id:901,phase:1,pass,nolog,'
+        "setvar:'tx.gate=1'\"\n"
+        'SecRule TX:GATE "@eq 1" "id:902,phase:2,pass,skipAfter:END-X"\n'
+        'SecRule ARGS "@rx x" "id:903,phase:2,block"\n'
+        'SecMarker "END-X"\n')
+    findings = check_reachability(scans)
+    unreachable = [f for f in findings
+                   if f.check == "flow.unreachable-paranoia"]
+    assert [f.rule_id for f in unreachable] == [903]
+
+
+def test_tx_statically_true_write_not_flagged_conditional(tmp_path):
+    """Review finding (round 2): a statically-true SecRule write folds
+    like a SecAction — tx.conditional-setvar-skip must not claim 'rules
+    stay active' for a tier the parser statically skips."""
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.mode=1"\n'
+        'SecRule TX:MODE "@eq 1" "id:901,phase:1,pass,nolog,'
+        "setvar:'tx.pl=1'\"\n"
+        'SecRule TX:PL "@lt 2" "id:902,phase:2,pass,skipAfter:E"\n'
+        'SecMarker "E"\n')
+    findings = check_tx_dataflow(scans)
+    assert "tx.conditional-setvar-skip" not in _checks(findings)
+
+
+def test_flow_condition_before_write_stays_reachable(tmp_path):
+    """Review finding (round 4): the sweep must evaluate conditions at
+    their LOAD POINT — a SecAction write after the skip rule cannot
+    retroactively take the region (the parser abstained and kept 101)."""
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecRule TX:A "@eq 2" "id:100,phase:2,pass,skipAfter:END-M"\n'
+        'SecRule ARGS "@rx x" "id:101,phase:2,block"\n'
+        'SecMarker "END-M"\n'
+        'SecAction "id:102,phase:1,pass,nolog,setvar:tx.a=2"\n')
+    findings = check_reachability(scans)
+    assert "flow.unreachable-paranoia" not in _checks(findings)
+
+
+def test_flow_mid_file_rewrite_detects_skip(tmp_path):
+    """Converse: a statically-true control rule that rewrites the gate
+    variable BEFORE jumping skips its interval at every setting — the
+    sweep must see the fold in order and report 902."""
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.pl=1"\n'
+        'SecRule TX:PL "@eq 1" "id:901,phase:2,pass,nolog,'
+        'setvar:tx.pl=9,skipAfter:END-A"\n'
+        'SecRule ARGS "@rx inskip" "id:902,phase:2,block"\n'
+        'SecMarker "END-A"\n'
+        'SecRule TX:PL "@lt 2" "id:903,phase:2,pass,skipAfter:END-B"\n'
+        'SecRule ARGS "@rx evil" "id:904,phase:2,block"\n'
+        'SecMarker "END-B"\n')
+    findings = check_reachability(scans)
+    unreachable = {f.rule_id for f in findings
+                   if f.check == "flow.unreachable-paranoia"}
+    assert 902 in unreachable    # jumped over at every PL
+    assert 904 not in unreachable  # tx.pl=9 folded → tier active
+
+
+def test_flow_marker_in_included_file_not_dangling(tmp_path):
+    """Review finding (round 5): the parser resolves a skipAfter whose
+    marker lives in the subsequently-Include'd file — no dangling error,
+    and the included rules before the marker ARE skipped."""
+    (tmp_path / "sub.conf").write_text(
+        'SecRule ARGS "@rx a" "id:101,phase:2,block"\n'
+        'SecMarker "END-X"\n'
+        'SecRule ARGS "@rx b" "id:102,phase:2,block"\n')
+    (tmp_path / "entry.conf").write_text(
+        'SecAction "id:900,phase:1,pass,nolog,'
+        'setvar:tx.detection_paranoia_level=1"\n'
+        'SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 99" '
+        '"id:100,phase:2,pass,skipAfter:END-X"\n'
+        'Include sub.conf\n')
+    findings = check_reachability(scan_tree(tmp_path / "entry.conf"))
+    assert "flow.dangling-marker" not in _checks(findings)
+    unreachable = {f.rule_id for f in findings
+                   if f.check == "flow.unreachable-paranoia"}
+    assert 101 in unreachable      # inside the cross-file region
+    assert 102 not in unreachable  # after the marker
+
+
+def test_flow_bad_paranoia_tag(tmp_path):
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecRule ARGS "@rx x" "id:320,phase:2,block,'
+        "tag:'paranoia-level/7'\"\n")
+    findings = check_reachability(scans)
+    assert "flow.bad-paranoia-tag" in _checks(findings, "warning")
+
+
+# ------------------------------------------------ 3. TX/setvar dataflow
+
+
+def test_tx_read_never_written(tmp_path):
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecRule TX:NO_SUCH_VAR "@eq 1" "id:400,phase:2,pass,'
+        'skipAfter:END"\n'
+        'SecMarker "END"\n')
+    findings = check_tx_dataflow(scans)
+    assert "tx.read-before-write" in _checks(findings, "warning")
+
+
+def test_tx_read_before_write_positional(tmp_path):
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecRule TX:LATE "@eq 1" "id:410,phase:2,pass,skipAfter:E"\n'
+        'SecMarker "E"\n'
+        'SecAction "id:411,phase:1,pass,nolog,setvar:tx.late=1"\n')
+    findings = check_tx_dataflow(scans)
+    hits = [f for f in findings if f.check == "tx.read-before-write"]
+    assert hits and "before its first write" in hits[0].message
+
+
+def test_tx_write_then_read_clean(tmp_path):
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecAction "id:420,phase:1,pass,nolog,setvar:tx.mode=1"\n'
+        'SecRule TX:MODE "@eq 1" "id:421,phase:2,pass,skipAfter:E"\n'
+        'SecMarker "E"\n')
+    findings = check_tx_dataflow(scans)
+    assert "tx.read-before-write" not in _checks(findings)
+
+
+def test_tx_dead_write_notice(tmp_path):
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecAction "id:430,phase:1,pass,nolog,setvar:tx.orphan=1"\n')
+    findings = check_tx_dataflow(scans)
+    dead = [f for f in findings if f.check == "tx.dead-write"]
+    assert dead and dead[0].subject == "tx.orphan"
+    assert dead[0].severity == "notice"
+
+
+def test_tx_anomaly_family_not_dead(tmp_path):
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecRule ARGS "@rx evil" "id:440,phase:2,block,'
+        "setvar:'tx.anomaly_score_pl1=+5'\"\n")
+    findings = check_tx_dataflow(scans)
+    assert "tx.dead-write" not in _checks(findings)
+
+
+def test_tx_threshold_unreachable_error(tmp_path):
+    scans = _scan_text(tmp_path, "a.conf", "# empty\n")
+    findings = check_tx_dataflow(scans, anomaly_threshold=1000,
+                                 max_anomaly_sum=12)
+    assert "tx.threshold-unreachable" in _checks(findings, "error")
+    clean = check_tx_dataflow(scans, anomaly_threshold=5,
+                              max_anomaly_sum=12)
+    assert "tx.threshold-unreachable" not in _checks(clean)
+
+
+def test_tx_anomaly_never_evaluated_needs_explicit_increments(tmp_path):
+    """Only trees that opt into anomaly mode (explicit setvar
+    increments) warn about a missing threshold rule — plain block
+    trees use severity-fallback scores and the engine default."""
+    scans = _scan_text(tmp_path, "a.conf", "# empty\n")
+    warned = check_tx_dataflow(scans, anomaly_threshold=None,
+                               max_anomaly_sum=9, explicit_anomaly=True)
+    assert "tx.anomaly-never-evaluated" in _checks(warned, "warning")
+    plain = check_tx_dataflow(scans, anomaly_threshold=None,
+                              max_anomaly_sum=9, explicit_anomaly=False)
+    assert "tx.anomaly-never-evaluated" not in _checks(plain)
+
+
+def test_tx_conditional_setvar_skip_warning(tmp_path):
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecRule REQUEST_HEADERS:X-M "@streq y" "id:450,phase:1,pass,'
+        "setvar:'tx.mode=2'\"\n"
+        'SecRule TX:MODE "@eq 2" "id:451,phase:2,pass,skipAfter:E"\n'
+        'SecMarker "E"\n')
+    findings = check_tx_dataflow(scans)
+    assert "tx.conditional-setvar-skip" in _checks(findings, "warning")
+
+
+def test_tx_load_order_follows_includes(tmp_path):
+    """Review finding (round 6): load order interleaves at the Include
+    point — a post-Include read of a variable written INSIDE the
+    include is not read-before-write."""
+    (tmp_path / "sub.conf").write_text(
+        'SecAction "id:10,phase:1,pass,nolog,setvar:tx.x=1"\n')
+    (tmp_path / "entry.conf").write_text(
+        'Include sub.conf\n'
+        'SecRule TX:X "@eq 1" "id:11,phase:2,pass,skipAfter:E"\n'
+        'SecMarker "E"\n')
+    findings = check_tx_dataflow(scan_tree(tmp_path / "entry.conf"))
+    assert "tx.read-before-write" not in _checks(findings)
+
+
+def test_static_tx_env_chain_state_is_per_file(tmp_path):
+    """Review finding (round 6): a dangling chain leader at one file's
+    EOF must not make the next file's first rule classify as a link."""
+    from ingress_plus_tpu.analysis.scan import static_tx_env
+    (tmp_path / "a.conf").write_text(
+        'SecRule ARGS "@rx x" "id:20,phase:2,block,chain,'
+        "setvar:'tx.z=1'\"\n")          # dangling leader
+    (tmp_path / "b.conf").write_text(
+        'SecAction "id:21,phase:1,pass,nolog,setvar:tx.m=1"\n'
+        'SecRule TX:M "@eq 1" "id:22,phase:1,pass,nolog,'
+        "setvar:'tx.q=7'\"\n")
+    env, cond = static_tx_env(scan_tree(tmp_path))
+    assert env.get("q") == "7"          # folded, not link-invalidated
+    assert "q" not in cond
+
+
+def test_tx_regex_selector_reads_matching_writes(tmp_path):
+    """Review finding (round 9): the CRS ``TX:/^prefix_/`` selector
+    shape reads every matching variable — no false read-before-write
+    for the selector, no false dead-write for the matched names."""
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecAction "id:30,phase:1,pass,nolog,setvar:tx.sqli_score=0"\n'
+        'SecRule TX:/^sqli_/ "@gt 0" "id:31,phase:2,block"\n')
+    findings = check_tx_dataflow(scans)
+    assert "tx.read-before-write" not in _checks(findings)
+    assert "tx.dead-write" not in _checks(findings)
+    # a selector matching nothing is still worth a warning
+    scans2 = _scan_text(tmp_path, "b.conf",
+        'SecRule TX:/^nothing_/ "@gt 0" "id:32,phase:2,block"\n')
+    findings2 = check_tx_dataflow(scans2)
+    assert any(f.check == "tx.read-before-write" and "selector" in
+               f.message for f in findings2)
+
+
+def test_tx_conditional_write_after_read_not_flagged(tmp_path):
+    """Review finding (round 4): a request-dependent write AFTER the
+    skipAfter read leaves the parser's static resolution intact — no
+    'rules stay active' warning for a tier the parser skips."""
+    scans = _scan_text(tmp_path, "a.conf",
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.pl=1"\n'
+        'SecRule TX:PL "@lt 2" "id:901,phase:2,pass,skipAfter:E"\n'
+        'SecRule ARGS "@rx x" "id:902,phase:2,block"\n'
+        'SecMarker "E"\n'
+        'SecRule REQUEST_HEADERS:X-P "@streq hi" "id:903,phase:1,pass,'
+        "setvar:'tx.pl=4'\"\n")
+    findings = check_tx_dataflow(scans)
+    assert "tx.conditional-setvar-skip" not in _checks(findings)
+
+
+# ----------------------------------------------- 4. regex hazards / ReDoS
+
+
+def test_redos_nested_quantifier_detected():
+    assert any(c == "regex.redos-nested-quantifier"
+               for c, _ in hazards_for_pattern(parse_regex("(a+)+")))
+    assert any(c == "regex.redos-nested-quantifier"
+               for c, _ in hazards_for_pattern(
+                   parse_regex(r"(?:[^)]{0,64},){1,}")))
+
+
+def test_redos_separator_disambiguates_clean():
+    """The fixed 942370 shape: the inner class excludes the separator,
+    so iteration boundaries are unambiguous."""
+    assert not any(c == "regex.redos-nested-quantifier"
+                   for c, _ in hazards_for_pattern(
+                       parse_regex(r"(?:[^),]{0,64},){1,}")))
+    # cookie-jar shape: every inner repeat is separator-delimited
+    assert not hazards_for_pattern(
+        parse_regex(r"(?:[^=;\s]+=[^;]*;){40,}"))
+
+
+def test_redos_overlapping_alternation():
+    assert any(c == "regex.redos-overlapping-alternation"
+               for c, _ in hazards_for_pattern(parse_regex("(?:a|ab)+")))
+    assert not any(c == "regex.redos-overlapping-alternation"
+                   for c, _ in hazards_for_pattern(
+                       parse_regex("(?:ab|cd)+")))
+
+
+def test_redos_adjacent_quantifiers_notice():
+    assert any(c == "regex.redos-adjacent-quantifiers"
+               for c, _ in hazards_for_pattern(parse_regex(r"\s*\s*x")))
+    assert not any(c == "regex.redos-adjacent-quantifiers"
+                   for c, _ in hazards_for_pattern(
+                       parse_regex(r"\d+[a-z]+")))
+
+
+def test_redos_findings_have_severities():
+    rules = parse_seclang(
+        'SecRule ARGS "@rx (?:\\w+)+$" "id:500,phase:2,block"')
+    cr = compile_ruleset(rules)
+    findings = check_regex_hazards(cr.rules)
+    assert "regex.redos-nested-quantifier" in _checks(findings, "error")
+
+
+def test_confirm_unparsable_regex_is_error():
+    """The 941290/941300 shape: the tokenizer halves backslashes and the
+    confirm engine rejects the resulting escape — silently dead rule."""
+    rules = parse_seclang(
+        'SecRule ARGS "@rx (?:\\\\u00[0-7]){4,}" "id:510,phase:2,block"')
+    assert rules[0].argument == r"(?:\u00[0-7]){4,}"
+    cr = compile_ruleset(rules)
+    findings = check_regex_hazards(cr.rules)
+    dead = [f for f in findings if f.check == "regex.confirm-unparsable"]
+    assert dead and dead[0].severity == "error"
+
+
+def test_degraded_construct_notice():
+    rules = parse_seclang(
+        'SecRule ARGS "@rx foo(?=bar)" "id:520,phase:2,block"')
+    cr = compile_ruleset(rules)
+    findings = check_regex_hazards(cr.rules)
+    assert "regex.degraded-construct" in _checks(findings, "notice")
+
+
+# ------------------------------------------ 5. transform-lane consistency
+
+
+def test_lane_variant_mismatch_error():
+    meta = _meta(op="rx", arg="select",
+                 transforms=["htmlEntityDecode"], variant=0)
+    findings = check_lanes([meta])
+    assert "lane.variant-mismatch" in _checks(findings, "error")
+
+
+def test_lane_unmodeled_decode_with_prefilter_error():
+    meta = _meta(op="rx", arg="expression",
+                 transforms=["urlDecodeUni", "cssDecode"], variant=1,
+                 has_prefilter=True)
+    findings = check_lanes([meta])
+    assert "lane.unmodeled-decode" in _checks(findings, "error")
+
+
+def test_lane_compiler_drops_unmodeled_decode_factors():
+    """The compiler-side fix this lint class pins: a cssDecode rule
+    compiles always-confirm (no factors over text the scan never sees)."""
+    rules = parse_seclang(
+        'SecRule ARGS "@rx (?i)expression\\s*\\(" '
+        '"id:600,phase:2,block,t:urlDecodeUni,t:cssDecode"')
+    cr = compile_ruleset(rules)
+    assert cr.tables.rule_nfactors[0] == 0
+    assert "lane.unmodeled-decode" not in _checks(check_lanes(cr.rules))
+
+
+def test_within_compiles_confirm_only():
+    rules = parse_seclang(
+        'SecRule REQUEST_HEADERS:X-Proto "@within HTTP/1.0 HTTP/1.1" '
+        '"id:610,phase:1,block"')
+    cr = compile_ruleset(rules)
+    assert cr.tables.rule_nfactors[0] == 0
+
+
+def test_lane_unknown_transform_warning():
+    meta = _meta(op="rx", arg="x", transforms=["urldecode"])  # typo'd case
+    findings = check_lanes([meta])
+    assert "lane.unknown-transform" in _checks(findings, "warning")
+
+
+def test_lane_noop_transform_notice():
+    meta = _meta(op="rx", arg="x", transforms=["utf8toUnicode"])
+    findings = check_lanes([meta])
+    assert "lane.noop-transform" in _checks(findings, "notice")
+
+
+def test_lane_clean_rule_no_findings():
+    rules = parse_seclang(
+        'SecRule ARGS "@rx (?i)<script" '
+        '"id:620,phase:2,block,t:urlDecodeUni,t:htmlEntityDecode,'
+        't:lowercase"')
+    cr = compile_ruleset(rules)
+    assert check_lanes(cr.rules) == []
+
+
+def test_compile_env_mirrors_conditional_setvar_semantics():
+    """Review finding (round 7): the compile-time env must fold a
+    statically-TRUE conditional SecRule's assignments (threshold
+    resolution) and invalidate request-dependent ones, exactly like
+    the parse-time env."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.mode=1"\n'
+        'SecRule TX:MODE "@eq 1" "id:901,phase:1,pass,nolog,'
+        "setvar:'tx.inbound_anomaly_score_threshold=7'\"\n"
+        'SecRule TX:ANOMALY_SCORE "@ge '
+        '%{tx.inbound_anomaly_score_threshold}" '
+        '"id:949110,phase:2,deny,severity:CRITICAL"\n')
+    cr = compile_ruleset(rules)
+    assert cr.anomaly_threshold == 7
+    # request-dependent write: the stale SecAction literal must NOT be
+    # baked into macro expansions
+    rules2 = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.lim=5"\n'
+        'SecRule REQUEST_HEADERS:X-L "@streq big" "id:901,phase:1,pass,'
+        "setvar:'tx.lim=50'\"\n"
+        'SecRule ARGS "@contains %{tx.lim}" "id:902,phase:2,block"\n')
+    cr2 = compile_ruleset(rules2)
+    assert "%{" in cr2.rules[-1].confirm["arg"]   # abstains, not stale 5
+
+
+def test_compile_env_sees_skip_rule_setvars():
+    """Review finding (round 8): a statically-true skipAfter control
+    rule's setvars execute before the jump — they must reach the
+    COMPILE env too (the parser drops the control rule itself)."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.lvl=1"\n'
+        'SecRule TX:LVL "@eq 1" "id:901,phase:1,pass,nolog,'
+        'setvar:tx.lvl=9,skipAfter:END-S"\n'
+        'SecMarker "END-S"\n'
+        'SecRule ARGS "@streq %{tx.lvl}" "id:902,phase:2,block"\n')
+    cr = compile_ruleset(rules)
+    assert cr.rules[-1].confirm["arg"] == "9"
+
+
+def test_compile_time_env_honors_delete_form():
+    """Review finding (round 6): the compile-time TX env must drop a
+    ``setvar:!tx.name`` delete like the parse-time env does — a stale
+    literal would expand %{tx.name} macros ModSecurity sees as unset."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.foo=5"\n'
+        'SecAction "id:901,phase:1,pass,nolog,setvar:!tx.foo"\n'
+        'SecRule ARGS "@contains %{tx.foo}" "id:902,phase:2,block"\n')
+    cr = compile_ruleset(rules)
+    assert "%{" in cr.rules[0].confirm["arg"]   # unresolved: abstains
+    rules2 = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.foo=5"\n'
+        'SecRule ARGS "@contains %{tx.foo}" "id:902,phase:2,block"\n')
+    cr2 = compile_ruleset(rules2)
+    assert cr2.rules[0].confirm["arg"] == "5"   # without delete: expands
+
+
+# --------------------------------------------- baseline + report plumbing
+
+
+def test_baseline_suppression(tmp_path):
+    bl_path = tmp_path / "baseline.json"
+    bl_path.write_text(json.dumps({"suppressions": [
+        {"check": "regex.degraded-construct", "rule_id": 7,
+         "reason": "accepted"}]}))
+    bl = Baseline.load(bl_path)
+    f1 = Finding(check="regex.degraded-construct", severity="notice",
+                 message="m", rule_id=7)
+    f2 = Finding(check="regex.degraded-construct", severity="notice",
+                 message="m", rule_id=8)
+    bl.apply([f1, f2])
+    assert f1.suppressed and f1.suppress_reason == "accepted"
+    assert not f2.suppressed
+
+
+def test_baseline_auto_resolves_next_to_entry_config(tmp_path):
+    """Review finding (round 3): --rules may name an entry-config FILE;
+    the sibling baseline must still auto-apply."""
+    (tmp_path / "r.conf").write_text(
+        'SecRule ARGS "@rx foo(?=bar)" "id:70,phase:2,block"\n')
+    (tmp_path / "entry.conf").write_text("Include r.conf\n")
+    (tmp_path / "rulecheck-baseline.json").write_text(json.dumps(
+        {"suppressions": [{"check": "regex.degraded-construct",
+                           "rule_id": 70, "reason": "accepted"}]}))
+    report = run_rulecheck(rules_path=tmp_path / "entry.conf")
+    degraded = [f for f in report.findings
+                if f.check == "regex.degraded-construct"]
+    assert degraded and all(f.suppressed for f in degraded)
+
+
+def test_baseline_rejects_entries_without_reason(tmp_path):
+    bl_path = tmp_path / "bad.json"
+    bl_path.write_text(json.dumps([{"check": "x"}]))
+    with pytest.raises(BaselineError):
+        Baseline.load(bl_path)
+
+
+# --------------------------------- the CI gate: bundled CRS tree is clean
+
+
+@pytest.fixture(scope="module")
+def bundled_report():
+    return run_rulecheck()
+
+
+def test_bundled_crs_tree_clean_of_errors(bundled_report):
+    gating = bundled_report.gating("error")
+    assert gating == [], [f.to_dict() for f in gating]
+    # stronger: warnings are clean too, and notices are all baselined
+    assert bundled_report.counts()["warning"] == 0
+    assert bundled_report.counts()["notice"] == 0
+
+
+def test_bundled_report_formats(bundled_report):
+    d = json.loads(bundled_report.to_json())
+    assert d["tool"] == "rulecheck" and d["n_rules"] > 200
+    assert d["counts"]["error"] == 0
+    # no machine-specific absolute paths in reports (SARIF uri mapping)
+    assert all(not f.get("file", "").startswith("/")
+               for f in d["findings"])
+    sarif = json.loads(bundled_report.to_sarif())
+    assert sarif["version"] == "2.1.0"
+    assert sarif["runs"][0]["tool"]["driver"]["name"] == "rulecheck"
+    suppressed = [r for r in sarif["runs"][0]["results"]
+                  if r.get("suppressions")]
+    assert suppressed, "baselined findings must carry SARIF suppressions"
+    text = bundled_report.to_text()
+    assert "0 error" in text
+
+
+def test_cli_exits_zero_on_bundled_tree(tmp_path, capsys):
+    from ingress_plus_tpu.analysis.__main__ import main
+    out = tmp_path / "rc.json"
+    assert main(["--format", "json", "--output", str(out)]) == 0
+    assert json.loads(out.read_text())["counts"]["error"] == 0
+    capsys.readouterr()
+
+
+def test_cli_fails_on_dirty_tree(tmp_path, capsys):
+    (tmp_path / "bad.conf").write_text(
+        'SecRule ARGS "@rx (?:\\\\u00[0-7]){4,}" "id:1,phase:2,block"\n')
+    from ingress_plus_tpu.analysis.__main__ import main
+    assert main(["--rules", str(tmp_path), "--format", "json"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_reports_seclang_errors_cleanly(tmp_path, capsys):
+    """A malformed tree exits 2 with the tool's own diagnostic, not a
+    traceback (review finding)."""
+    (tmp_path / "broken.conf").write_text('SecRule ARGS\n')
+    from ingress_plus_tpu.analysis.__main__ import main
+    assert main(["--rules", str(tmp_path)]) == 2
+    assert "rulecheck:" in capsys.readouterr().err
+
+
+def test_dbg_rulecheck_smoke(capsys):
+    from ingress_plus_tpu.control.dbg import main
+    assert main(["rulecheck"]) == 0
+    assert "rulecheck:" in capsys.readouterr().out

@@ -262,6 +262,148 @@ def test_incremented_tx_variable_abstains():
     assert 600 in ids and 601 in ids   # everything stays active
 
 
+def test_conditional_secrule_setvar_invalidates_stale_literal():
+    """ISSUE 2 satellite: a request-dependent SecRule that rewrites a TX
+    variable must INVALIDATE the parse-time literal — the old behavior
+    left the SecAction value in place and a later skipAfter condition
+    confidently mis-skipped rules ModSecurity would run."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.pl=1"\n'
+        # request-dependent override (cannot resolve at parse time)
+        'SecRule REQUEST_HEADERS:X-Paranoia "@streq high" '
+        '"id:901,phase:1,pass,setvar:tx.pl=4"\n'
+        'SecRule TX:PL "@lt 2" "id:902,phase:2,pass,skipAfter:END-T"\n'
+        'SecRule ARGS "@rx evil" "id:903,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-T"\n')
+    ids = _ids(rules)
+    assert 903 in ids      # condition abstained: tier stays ACTIVE
+    assert 902 in ids      # control rule kept (abstains at runtime)
+
+
+def test_statically_true_secrule_setvar_folds():
+    """A SecRule whose own condition resolves statically TRUE folds its
+    setvars like a SecAction (the conditional crs-setup shape)."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.mode=1"\n'
+        'SecRule TX:MODE "@eq 1" "id:901,phase:1,pass,nolog,'
+        'setvar:tx.pl=1"\n'
+        'SecRule TX:PL "@lt 2" "id:902,phase:2,pass,skipAfter:END-T"\n'
+        'SecRule ARGS "@rx evil" "id:903,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-T"\n')
+    ids = _ids(rules)
+    assert 903 not in ids  # tx.pl=1 folded → @lt 2 true → tier skipped
+    assert 902 not in ids
+
+
+def test_statically_false_secrule_setvar_ignored():
+    """A statically-FALSE condition never fires: its setvars neither
+    fold nor invalidate (the SecAction literal stays authoritative)."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,'
+        'setvar:tx.mode=1,setvar:tx.pl=1"\n'
+        'SecRule TX:MODE "@eq 5" "id:901,phase:1,pass,nolog,'
+        'setvar:tx.pl=9"\n'
+        'SecRule TX:PL "@lt 2" "id:902,phase:2,pass,skipAfter:END-T"\n'
+        'SecRule ARGS "@rx evil" "id:903,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-T"\n')
+    ids = _ids(rules)
+    assert 903 not in ids  # tx.pl stayed 1 → skip taken
+
+
+def test_skip_rule_setvars_fold_before_jump():
+    """A statically-TRUE skipAfter control rule executes its setvars
+    BEFORE jumping (ModSecurity action order) — review finding: skipping
+    the fold left the stale literal and a later tier was mis-skipped."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.pl=1"\n'
+        'SecRule TX:PL "@eq 1" "id:901,phase:2,pass,nolog,'
+        'setvar:tx.pl=9,skipAfter:END-A"\n'
+        'SecRule ARGS "@rx inskip" "id:902,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-A"\n'
+        'SecRule TX:PL "@lt 2" "id:903,phase:2,pass,skipAfter:END-B"\n'
+        'SecRule ARGS "@rx evil" "id:904,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-B"\n')
+    ids = _ids(rules)
+    assert 902 not in ids  # the taken jump skipped its interval
+    assert 904 in ids      # tx.pl=9 folded → @lt 2 false → tier ACTIVE
+
+
+def test_crs901_count_defaulting_idiom_stays_static():
+    """Review finding: the canonical CRS-901 defaulting shape —
+    ``SecRule &TX:var "@eq 0" "...,setvar:tx.var=1"`` — must resolve
+    statically FALSE when the variable is already set (count is 1), not
+    invalidate the very paranoia variable crs-setup just assigned."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,'
+        'setvar:tx.detection_paranoia_level=1"\n'
+        'SecRule &TX:DETECTION_PARANOIA_LEVEL "@eq 0" '
+        '"id:901,phase:1,pass,nolog,'
+        'setvar:tx.detection_paranoia_level=1"\n'
+        'SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 2" '
+        '"id:902,phase:2,pass,skipAfter:END-PL2"\n'
+        'SecRule ARGS "@rx pl2" "id:903,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-PL2"\n')
+    ids = _ids(rules)
+    assert 903 not in ids  # the gate still resolved: tier skipped @ PL1
+    assert 902 not in ids
+
+
+def test_valueless_setvar_sets_one():
+    """``setvar:tx.NAME`` with no value is ModSecurity's "set to 1" —
+    review finding: ignoring it left a stale literal and a later
+    skipAfter condition confidently mis-skipped a tier."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,'
+        'setvar:tx.mode=1,setvar:tx.foo=0"\n'
+        'SecRule TX:MODE "@eq 1" "id:901,phase:1,pass,nolog,'
+        'setvar:tx.foo"\n'
+        'SecRule TX:FOO "@eq 0" "id:902,phase:2,pass,skipAfter:END-T"\n'
+        'SecRule ARGS "@rx evil" "id:903,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-T"\n')
+    ids = _ids(rules)
+    assert 903 in ids      # tx.foo folded to 1 → @eq 0 false → active
+
+
+def test_delete_form_setvar_clears_parse_time_env():
+    """``setvar:!tx.NAME`` deletes the variable — the parse-time env
+    entry must go too (review finding: the stale literal made a later
+    skipAfter condition confidently wrong and dropped a tier)."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,'
+        'setvar:tx.mode=1,setvar:tx.pl=1"\n'
+        'SecRule TX:MODE "@eq 1" "id:901,phase:1,pass,nolog,'
+        'setvar:!tx.pl"\n'
+        'SecRule TX:PL "@lt 2" "id:902,phase:2,pass,skipAfter:END-T"\n'
+        'SecRule ARGS "@rx evil" "id:903,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-T"\n')
+    ids = _ids(rules)
+    assert 903 in ids      # tx.pl deleted → condition abstains → active
+
+
+def test_chain_carried_setvar_invalidates():
+    """Chain-carried setvars are conjunction-conditioned — never
+    statically decidable here — so they always invalidate."""
+    rules = parse_seclang(
+        'SecAction "id:900,phase:1,pass,nolog,setvar:tx.pl=1"\n'
+        'SecRule ARGS "@rx a" "id:901,phase:2,pass,chain,'
+        'setvar:tx.pl=3"\n'
+        '    SecRule ARGS "@rx b"\n'
+        'SecRule TX:PL "@lt 2" "id:902,phase:2,pass,skipAfter:END-T"\n'
+        'SecRule ARGS "@rx evil" "id:903,phase:2,block,'
+        "severity:CRITICAL,tag:'attack-generic'\"\n"
+        'SecMarker "END-T"\n')
+    ids = _ids(rules)
+    assert 903 in ids      # tx.pl undecidable → abstain → tier active
+
+
 def test_skipped_chain_leader_takes_links(tmp_path):
     """A chain leader inside a skipped region must take its
     continuation links with it — a dangling link would misparse as a
