@@ -615,6 +615,8 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
             tun["p50_us"] = lat.get("added_latency_p50_us")
             tun["p99_us"] = lat.get("added_latency_p99_us")
             result["latency_leg_tunnel"] = tun
+            if "rule_stats" in lat:
+                result["rule_stats"] = lat["rule_stats"]
             for key in ("chain_overhead_p50_us", "chain_overhead_p99_us"):
                 if key in lat:
                     result[key] = lat[key]
@@ -666,6 +668,12 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
                 log("local latency leg rc=%d (non-fatal)" % out.returncode)
         except Exception as e:
             log("local latency leg failed (non-fatal): %r" % (e,))
+    if "rule_stats" not in result:
+        # mirror the stage_breakdown contract: the absence of the
+        # detection-efficiency block must be visible in the round log
+        log("WARNING: BENCH json carries NO rule_stats block — "
+            "per-family false-candidate rate and padding-waste ratio "
+            "are unreported this round")
     return result
 
 
@@ -855,6 +863,28 @@ def run_latency_leg(cr, scan_impl: str, platform: str,
             log("stage breakdown: " + ", ".join(
                 "%s p50=%.0f p99=%.0f" % (s, v["p50_us"], v["p99_us"])
                 for s, v in sb.items() if s != "sum_check"))
+        # detection-plane telemetry (ISSUE 3): per-family false-
+        # candidate rate + padding-waste gauges from the pipeline's
+        # RuleStats, mirroring the stage_breakdown convention —
+        # missing/None is a LOUD warning, never silently absent
+        from ingress_plus_tpu.models.rule_stats import bench_block
+        try:
+            rsb = bench_block(batcher.pipeline)
+        except Exception as e:
+            rsb = None
+            log("WARNING: rule_stats collection raised (%r)" % (e,))
+        if not rsb:
+            log("WARNING: latency leg has NO rule_stats — per-family "
+                "false-candidate rate and padding-waste are "
+                "unmeasured; the detection-efficiency axis is missing "
+                "from this round's BENCH json")
+        else:
+            lat["rule_stats"] = rsb
+            log("rule_stats: fc_rate=%s pad_waste=%s fill=%s "
+                "runtime_dead=%s"
+                % (rsb.get("false_candidate_rate"),
+                   rsb.get("padding_waste_ratio"),
+                   rsb.get("dispatch_fill"), rsb.get("runtime_dead")))
         if platform != "cpu":
             lat["latency_leg"]["note"] = (
                 "per-dispatch verdicts cross the remote-TPU tunnel "

@@ -13,6 +13,15 @@ state.  Same idea against our HTTP plane:
         [--paranoia 2]
     python -m ingress_plus_tpu.control.dbg rulecheck [--rules path] \
         [--fail-on error]
+    python -m ingress_plus_tpu.control.dbg rules    [--server host:port]
+    python -m ingress_plus_tpu.control.dbg drift    [--server host:port]
+
+``rules`` renders the detection-plane telemetry (ISSUE 3): top rules by
+prefilter candidates with confirm outcomes and false-candidate rates
+(from ``/rules/stats``), the runtime dead-rule list (``/rules/health``
+— the runtime twin of ``rulecheck``), and the device-efficiency
+gauges; ``drift`` renders per-rule hit-rate deltas across the most
+recent hot reload (``/rules/drift``), went-quiet rules flagged.
 
 ``latency`` renders the serve plane's stage-level latency attribution
 (ISSUE 1): per-stage p50/p90/p99 from the /metrics histograms plus the
@@ -93,11 +102,91 @@ def render_latency(metrics_text: str, slow: dict,
     return "\n".join(lines)
 
 
+def render_rules(stats: dict, health: dict, top: int = 20) -> str:
+    """Terminal tables for `dbg rules` (ISSUE 3): the top rules by
+    prefilter candidates with their confirm outcomes, the runtime
+    dead-rule list, and the device-efficiency gauges."""
+    lines = []
+    eff = stats.get("efficiency") or {}
+    dev = stats.get("device") or {}
+    lines.append("ruleset %s  requests=%d  scan_impl=%s"
+                 % (stats.get("version", "?"), stats.get("requests", 0),
+                    dev.get("scan_impl", "?")))
+    lines.append("efficiency: pad_waste=%s dispatch_fill=%s recompiles=%s"
+                 % (eff.get("padding_waste_ratio"),
+                    eff.get("dispatch_fill"),
+                    eff.get("engine_recompiles")))
+    lines.append("")
+    lines.append("%-8s %-7s %10s %10s %8s %8s %9s"
+                 % ("rule_id", "family", "cand", "confirmed", "errors",
+                    "fc_rate", "score_sum"))
+    for r in (stats.get("rules") or [])[:top]:
+        lines.append("%-8d %-7s %10d %10d %8d %8.3f %9d"
+                     % (r["rule_id"], r["family"], r["candidates"],
+                        r["confirmed"], r["confirm_errors"],
+                        r["false_candidate_rate"], r["score_sum"]))
+    dead = health.get("runtime_dead") or []
+    lines.append("")
+    lines.append("runtime-dead rules (%d):" % len(dead))
+    for d in dead:
+        lines.append("  %d  confirm_errors=%d  %s"
+                     % (d["rule_id"], d["confirm_errors"],
+                        d.get("reason", "")))
+    for d in health.get("latent_dead") or []:
+        lines.append("  %d  LATENT (no candidates yet)  %s"
+                     % (d["rule_id"], d.get("reason", "")))
+    nh = health.get("never_hit") or {}
+    lines.append("never-hit: %s/%s rules over %s requests"
+                 % (nh.get("count"), nh.get("total_rules"),
+                    health.get("requests")))
+    waste = health.get("top_false_candidates") or []
+    if waste:
+        lines.append("")
+        lines.append("top confirm-CPU waste (false candidates):")
+        for w in waste[:10]:
+            lines.append("  %-8d %-7s wasted=%-8d fc_rate=%.3f"
+                         % (w["rule_id"], w["family"],
+                            w["wasted_confirms"],
+                            w["false_candidate_rate"]))
+    return "\n".join(lines)
+
+
+def render_drift(drift: dict, top: int = 20) -> str:
+    """Terminal table for `dbg drift`: per-rule hit-rate deltas across
+    the most recent hot reload, went-quiet rules first."""
+    if not drift.get("rules") and drift.get("note"):
+        return drift["note"]
+    lines = ["drift %s -> %s  (requests %s -> %s)"
+             % (drift.get("old_version", "?"),
+                drift.get("new_version", "?"),
+                drift.get("old_requests"), drift.get("new_requests"))]
+    quiet = drift.get("went_quiet") or []
+    lines.append("went quiet after reload (%d): %s"
+                 % (len(quiet),
+                    ", ".join(str(r) for r in quiet[:20]) or "-"))
+    lines.append("")
+    lines.append("%-8s %12s %12s %12s  %s"
+                 % ("rule_id", "old_rate", "new_rate", "delta", "flag"))
+    for r in (drift.get("rules") or [])[:top]:
+        lines.append("%-8d %12.6f %12.6f %+12.6f  %s"
+                     % (r["rule_id"], r["old_hit_rate"],
+                        r["new_hit_rate"], r["delta"],
+                        "QUIET" if r.get("went_quiet") else ""))
+    added = drift.get("added_rules") or []
+    removed = drift.get("removed_rules") or []
+    if added or removed:
+        lines.append("")
+        lines.append("pack delta: +%d rules, -%d rules"
+                     % (len(added), len(removed)))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ingress_plus_tpu.control.dbg")
     ap.add_argument("cmd",
                     choices=["conf", "health", "metrics", "latency",
-                             "tenants", "ruleset", "acl", "rulecheck"])
+                             "tenants", "ruleset", "acl", "rulecheck",
+                             "rules", "drift"])
     ap.add_argument("--server", default="127.0.0.1:9901")
     ap.add_argument("--rules", default=None,
                     help="rulecheck: rules tree to analyze (default: "
@@ -126,7 +215,14 @@ def main(argv=None) -> int:
         return rc_main(rc_args)
 
     try:
-        if args.cmd == "latency":
+        if args.cmd == "rules":
+            stats = json.loads(_call(args.server, "/rules/stats?n=64"))
+            rules_health = json.loads(_call(args.server, "/rules/health"))
+            out = render_rules(stats, rules_health)
+        elif args.cmd == "drift":
+            out = render_drift(json.loads(_call(args.server,
+                                                "/rules/drift")))
+        elif args.cmd == "latency":
             metrics = _call(args.server, "/metrics")
             slow = json.loads(_call(args.server, "/debug/slow"))
             sidecar = None

@@ -490,6 +490,24 @@ class ConfirmRule:
         self._plan, self._exclusions = self._compile_targets()
         self._matched_spec = self._parse_matched_spec()
 
+    def dead_reason(self) -> Optional[str]:
+        """Why this rule can never fire at runtime, or None.
+
+        The runtime twin of rulecheck's ``regex.confirm-unparsable``: a
+        pattern Python ``re`` rejects makes ``_op_match`` abstain on
+        every value, and a chain with such a link can never satisfy the
+        all-links conjunction (a negated broken link abstains too — an
+        abstain never counts as a hit).  Surfaced per candidate by the
+        RuleStats confirm-error counter so a dead rule is visible
+        within minutes of deploy, not at the next static audit."""
+        if self.compile_error is not None:
+            return "regex-unparsable: %s" % self.compile_error
+        for link in self.chain:
+            r = link.dead_reason()
+            if r is not None:
+                return "chain-link %s" % r
+        return None
+
     def _compile_targets(self):
         """raw_targets → ([(count, BASE, selector_or_None)], exclusions).
 

@@ -203,6 +203,19 @@ class DetectionEngine:
         eng.pallas_interpret = self.pallas_interpret
         return eng
 
+    def device_info(self) -> dict:
+        """Geometry + impl of the live device tables (served by
+        /rules/stats so an operator can see what the scan plane is
+        actually running without opening the checkpoint artifact)."""
+        t = self.ruleset.tables
+        return {
+            "scan_impl": self.scan_impl,
+            "n_rules": int(self.ruleset.n_rules),
+            "n_factors": int(t.n_factors),
+            "n_words": int(t.n_words),
+            "max_factor_len": int(t.max_factor_len),
+        }
+
     def swap_ruleset(self, cr: CompiledRuleset) -> None:
         # tables are a jit *argument* (pytree), so a geometry change just
         # keys a fresh executable on next call — never clear the cache

@@ -27,6 +27,7 @@ from ingress_plus_tpu.compiler.seclang import CLASSES, STREAMS
 from ingress_plus_tpu.models.acl import AclStore
 from ingress_plus_tpu.models.confirm import ConfirmRule, parse_exclusion_token
 from ingress_plus_tpu.models.engine import DetectionEngine
+from ingress_plus_tpu.models.rule_stats import RuleStats
 
 #: wallarm_mode precedence (weakest → strongest).  Wire values (frame
 #: mode bits 0-1) are historical — safe_blocking arrived round 4 as
@@ -74,6 +75,33 @@ class PipelineStats:
     prep_us: int = 0
     engine_us: int = 0
     confirm_us: int = 0
+    # device-efficiency accounting (ISSUE 3): the padded (B, L)
+    # rectangles the engine actually scans vs their live rows/bytes
+    # (padding-waste ratio, dispatch fill), per-L-tier bucket occupancy,
+    # and serve-time jit compiles for shapes warmup had not covered.
+    # live_rows/live_row_bytes duplicate rows/row_bytes under the
+    # RESETTABLE group: the cumulative Prometheus counters above span
+    # warmup and swaps, while this group is zeroed after warmup
+    # (reset_detection_observations) so the ratios describe only
+    # measured traffic — the stage-histogram convention of PR 1.
+    live_rows: int = 0
+    live_row_bytes: int = 0
+    padded_rows: int = 0
+    padded_bytes: int = 0
+    engine_compiles: int = 0
+    bucket_rows: Dict[int, int] = field(default_factory=dict)
+    bucket_padded_rows: Dict[int, int] = field(default_factory=dict)
+
+    def reset_efficiency(self) -> None:
+        """Zero the resettable device-efficiency group only (the
+        cumulative counters keep their Prometheus contract)."""
+        self.live_rows = 0
+        self.live_row_bytes = 0
+        self.padded_rows = 0
+        self.padded_bytes = 0
+        self.engine_compiles = 0
+        self.bucket_rows = {}
+        self.bucket_padded_rows = {}
 
 
 class DetectionPipeline:
@@ -133,6 +161,9 @@ class DetectionPipeline:
         # (B, L, Q_pad) engine shapes served so far — a replacement
         # pipeline warms exactly these before it is swapped in
         self.seen_shapes: set = set()
+        #: the outgoing generation's counters, frozen at the last
+        #: hot-swap (drift's "before"; None until a swap happens)
+        self.frozen_rule_stats = None
         self._install(ruleset, paranoia_level)
 
     # ------------------------------------------------------------- setup
@@ -140,6 +171,9 @@ class DetectionPipeline:
     def _install(self, ruleset: CompiledRuleset, paranoia_level: int) -> None:
         self.ruleset = ruleset
         self.confirms = [ConfirmRule(m.confirm) for m in ruleset.rules]
+        # detection-plane telemetry keyed by THIS generation's rule axis
+        # (a swap starts fresh counters; the old ones freeze for drift)
+        self.rule_stats = RuleStats(ruleset, self.confirms)
         self.paranoia_mask = ruleset.rule_paranoia <= paranoia_level
         self.needed_sv = set(
             int(sv) for sv in np.nonzero(ruleset.rule_sv_mask.any(axis=0))[0])
@@ -176,6 +210,10 @@ class DetectionPipeline:
                 (int(ci), remove_mask, target_excl, engine))
             if ruleset.rule_action[ci] == 0:   # pass-action config rule:
                 self._ctl_pass_idx.add(int(ci))  # never a detection hit
+        if self._ctl_pass_idx:
+            # config machinery out of the health views (never-hit /
+            # never-candidate) — it can't confirm by design
+            self.rule_stats.ignored[sorted(self._ctl_pass_idx)] = True
 
     def swap_ruleset(self, ruleset: CompiledRuleset,
                      paranoia_level: Optional[int] = None) -> None:
@@ -184,7 +222,19 @@ class DetectionPipeline:
         self.engine.swap_ruleset(ruleset)
         if paranoia_level is None:   # same precedence as __init__
             paranoia_level = getattr(ruleset, "paranoia_hint", None) or 2
+        frozen = self.rule_stats.freeze()
         self._install(ruleset, paranoia_level)
+        self.frozen_rule_stats = frozen
+
+    def reset_detection_observations(self) -> None:
+        """Zero the detection-plane telemetry (RuleStats counters + the
+        resettable device-efficiency group) so it describes only the
+        traffic that follows — called after warmup (whose synthetic
+        corpus would otherwise pollute per-rule hit rates, and whose
+        first-dispatch compiles would read as serve-time recompiles),
+        the same convention as Batcher.reset_latency_observations."""
+        self.rule_stats.reset()
+        self.stats.reset_efficiency()
 
     def warm_shape(self, B: int, L: int, Q_pad: int) -> None:
         """Pre-compile one engine executable (serving swap path).
@@ -272,10 +322,23 @@ class DetectionPipeline:
                     row_sv[j, sv_list[i]] = 1
                 dispatched.append(self.engine.detect_device(
                     tokens, lengths, row_req, row_sv, self._pad_q(Q)))
-                self.seen_shapes.add(
-                    (tokens.shape[0], tokens.shape[1], self._pad_q(Q)))
+                shape = (tokens.shape[0], tokens.shape[1], self._pad_q(Q))
+                if shape not in self.seen_shapes:
+                    # a shape warmup never compiled: this dispatch paid
+                    # a serve-time jit compile (the recompile gauge)
+                    stats.engine_compiles += 1
+                self.seen_shapes.add(shape)
+                nbytes = sum(len(r) for r in rows_b)
                 stats.rows += len(idxs)
-                stats.row_bytes += sum(len(r) for r in rows_b)
+                stats.row_bytes += nbytes
+                stats.live_rows += len(idxs)
+                stats.live_row_bytes += nbytes
+                stats.padded_rows += B_pad
+                stats.padded_bytes += B_pad * tokens.shape[1]
+                stats.bucket_rows[L] = \
+                    stats.bucket_rows.get(L, 0) + len(idxs)
+                stats.bucket_padded_rows[L] = \
+                    stats.bucket_padded_rows.get(L, 0) + B_pad
             for rh_dev in dispatched:
                 rule_hits |= np.asarray(rh_dev)
             stats.engine_us += int((time.perf_counter() - te0) * 1e6)
@@ -305,6 +368,14 @@ class DetectionPipeline:
         tc0 = time.perf_counter()
         verdicts: List[Verdict] = []
         rs = self.ruleset
+        # per-rule telemetry accumulators for this batch (folded into
+        # RuleStats in ONE vectorized update after the loop);
+        # excl_rows: requests where a matched runtime-ctl rule removed
+        # rules before confirm — those (request, rule) candidates were
+        # never confirm-evaluated and must not book as wasted confirms
+        all_confirmed: List[int] = []
+        all_blocked: List[bool] = []
+        excl_rows: List[tuple] = []
         for qi, req in enumerate(requests):
             hit_rules = np.nonzero(rule_hits[qi])[0]
             confirmed: List[int] = []
@@ -336,6 +407,8 @@ class DetectionPipeline:
                     merged = extra_excl.setdefault(idx, {})
                     for kind, sels in excl_map.items():
                         merged.setdefault(kind, set()).update(sels)
+            if excluded is not None:
+                excl_rows.append((qi, excluded))
             points: List[dict] = []
             for r in hit_rules:
                 r = int(r)
@@ -393,6 +466,18 @@ class DetectionPipeline:
                 score=score,
                 matches=points,
             ))
+            all_confirmed.extend(confirmed)
+            all_blocked.extend([blocked] * len(confirmed))
+        cand_hits = rule_hits[:len(requests)]
+        if excl_rows:
+            # copy only when a runtime ctl exclusion actually matched
+            # (rare); ctl-pass config rules are suppressed inside
+            # observe_finalize via the RuleStats.ignored mask
+            cand_hits = cand_hits.copy()
+            for qi, ex in excl_rows:
+                cand_hits[qi, ex] = False
+        self.rule_stats.observe_finalize(
+            cand_hits, all_confirmed, all_blocked)
         stats.confirm_us += int((time.perf_counter() - tc0) * 1e6)
         stats.confirmed_rule_hits += sum(len(v.rule_ids) for v in verdicts)
 

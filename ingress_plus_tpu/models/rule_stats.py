@@ -1,0 +1,378 @@
+"""Detection-plane telemetry keyed by sigpack row (ISSUE 3).
+
+PR 1 answered "where did the time go" (stage latency) and PR 2 answered
+"is the compiled ruleset sound" (static rulecheck).  This layer answers
+"what is each rule actually doing in production":
+
+  * ``RuleStats`` — vectorized per-rule counters updated once per
+    finalize batch (numpy adds under a short lock, O(R) per batch —
+    never per request): prefilter candidates, confirm hits, anomaly
+    score / block contributions, and **confirm errors** — the runtime
+    twin of rulecheck's ``regex.confirm-unparsable``.  A rule whose
+    confirm regex fails at runtime silently abstains (models/confirm.py
+    ``_op_match`` → None), so without this counter it is invisible
+    until the next static audit; with it, the rule shows as
+    runtime-dead in ``/rules/health`` after its first candidate.
+  * ``FrozenRuleStats`` / ``drift_report`` — reload-drift detection:
+    the batcher freezes the outgoing ruleset version's stats on hot
+    swap, and ``/rules/drift`` joins old vs new per rule id (hit-rate
+    deltas, rules that went quiet after a reload — the class of
+    regression a proton.db-style sync ships silently).
+  * ``device_efficiency`` / ``bench_block`` — device-efficiency gauges
+    the bench hints at but the server never exported: bucket occupancy,
+    padding-waste ratio, dispatch fill, recompile count; plus the
+    per-family false-candidate summary the BENCH json carries as its
+    ``rule_stats`` block (the prefilter over-approximation axis — the
+    wasted confirm CPU the bitap prefilter trades for device
+    throughput, cf. the approximate-automata NIDS line in PAPERS.md).
+
+Cardinality policy: per-RULE detail is JSON-only (``/rules/*``);
+Prometheus gets per-FAMILY series with a hard label budget
+(``utils/trace.py bounded_counter_series``).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def family_of(rule_id: int) -> str:
+    """CRS family label for a rule id: the leading 3 digits of a 6+
+    digit id (942100 → "942"); shorter ids (sigpack signatures, local
+    rules) fold into "custom".  Families are the bounded label space the
+    Prometheus series use — never the full id set."""
+    rid = int(rule_id)
+    return str(rid)[:3] if rid >= 100000 else "custom"
+
+
+@dataclass
+class FrozenRuleStats:
+    """Immutable snapshot of one ruleset version's counters, taken at
+    hot-swap time (the old version's last word — drift's "before")."""
+
+    version: str
+    requests: int
+    rule_ids: np.ndarray     # (R,) int64
+    candidates: np.ndarray   # (R,) int64
+    confirmed: np.ndarray    # (R,) int64
+
+
+class RuleStats:
+    """Per-rule runtime counters for one CompiledRuleset generation.
+
+    All mutation is batch-granular and vectorized; the only per-rule
+    Python work is on confirmed hits (already a short list).  Thread
+    safety: the dispatch thread and the oversized side worker both
+    finalize (each under the batcher's swap lock), direct library
+    callers may not hold any lock — so updates take a short internal
+    lock of their own."""
+
+    def __init__(self, ruleset, confirms: Optional[Sequence] = None):
+        R = int(ruleset.n_rules)
+        self.version: str = ruleset.version
+        self.rule_ids = np.asarray(ruleset.rule_ids, dtype=np.int64).copy()
+        self.rule_score = np.asarray(ruleset.rule_score,
+                                     dtype=np.int64).copy()
+        self.families: List[str] = [family_of(r) for r in self.rule_ids]
+        self.candidates = np.zeros((R,), dtype=np.int64)
+        self.confirmed = np.zeros((R,), dtype=np.int64)
+        self.confirm_errors = np.zeros((R,), dtype=np.int64)
+        self.score_sum = np.zeros((R,), dtype=np.int64)
+        self.block_hits = np.zeros((R,), dtype=np.int64)
+        self.requests = 0
+        # config machinery (ctl-carrying pass-action rules): never a
+        # detection hit by design, excluded from the never-hit /
+        # never-candidate health views (the pipeline marks them)
+        self.ignored = np.zeros((R,), dtype=bool)
+        # rules whose confirm can never evaluate (broken regex in the
+        # rule or any chain link): every candidate is a confirm error
+        self.broken = np.zeros((R,), dtype=bool)
+        self.broken_reason: Dict[int, str] = {}
+        if confirms is not None:
+            for i, c in enumerate(confirms):
+                reason = c.dead_reason()
+                if reason is not None:
+                    self.broken[i] = True
+                    self.broken_reason[i] = reason
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- update
+
+    def reset(self) -> None:
+        """Zero the counters (warmup exclusion — see
+        DetectionPipeline.reset_detection_observations); the broken-rule
+        mask is structural and survives."""
+        with self._lock:
+            for a in (self.candidates, self.confirmed,
+                      self.confirm_errors, self.score_sum,
+                      self.block_hits):
+                a[:] = 0
+            self.requests = 0
+
+    def observe_finalize(self, rule_hits: np.ndarray,
+                         confirmed_idx: Sequence[int],
+                         confirmed_blocked: Sequence[bool]) -> None:
+        """Fold one finalize batch.
+
+        ``rule_hits``: the (Q, R) masked candidate matrix the batch
+        confirmed against (the caller zeroes per-request runtime-ctl
+        exclusions first — those rules were never confirm-evaluated);
+        ``confirmed_idx``: flat rule indices of every confirmed
+        (request, rule) hit across the batch; ``confirmed_blocked``:
+        same length, whether that request's verdict blocked."""
+        cand = rule_hits.sum(axis=0, dtype=np.int64)
+        # config machinery (ignored mask) is never a detection
+        # candidate — suppress on the reduced vector, one place
+        cand[self.ignored] = 0
+        with self._lock:
+            self.requests += int(rule_hits.shape[0])
+            self.candidates += cand
+            if self.broken.any():
+                self.confirm_errors += np.where(self.broken, cand, 0)
+            if len(confirmed_idx):
+                idx = np.asarray(confirmed_idx, dtype=np.int64)
+                np.add.at(self.confirmed, idx, 1)
+                np.add.at(self.score_sum, idx, self.rule_score[idx])
+                bidx = idx[np.asarray(confirmed_blocked, dtype=bool)]
+                if len(bidx):
+                    np.add.at(self.block_hits, bidx, 1)
+
+    # -------------------------------------------------------- snapshot
+
+    def _snap(self):
+        with self._lock:
+            return (self.requests, self.candidates.copy(),
+                    self.confirmed.copy(), self.confirm_errors.copy(),
+                    self.score_sum.copy(), self.block_hits.copy())
+
+    def freeze(self) -> FrozenRuleStats:
+        requests, cand, conf, _err, _sc, _bl = self._snap()
+        return FrozenRuleStats(version=self.version, requests=requests,
+                               rule_ids=self.rule_ids.copy(),
+                               candidates=cand, confirmed=conf)
+
+    def rules_json(self, limit: int = 0) -> List[dict]:
+        """Per-rule records, candidates-descending (full detail is
+        JSON-only by the cardinality policy); ``limit`` 0 = all."""
+        _req, cand, conf, err, score, block = self._snap()
+        order = np.argsort(-cand, kind="stable")
+        if limit:
+            order = order[:limit]
+        out = []
+        for i in order:
+            i = int(i)
+            c = int(cand[i])
+            rec = {
+                "rule_id": int(self.rule_ids[i]),
+                "family": self.families[i],
+                "candidates": c,
+                "confirmed": int(conf[i]),
+                "confirm_errors": int(err[i]),
+                "false_candidates": c - int(conf[i]),
+                "false_candidate_rate":
+                    round((c - int(conf[i])) / c, 4) if c else 0.0,
+                "score_sum": int(score[i]),
+                "block_hits": int(block[i]),
+            }
+            if i in self.broken_reason:
+                rec["dead_reason"] = self.broken_reason[i]
+            out.append(rec)
+        return out
+
+    def family_totals(self) -> Dict[str, Dict[str, int]]:
+        _req, cand, conf, err, _score, _block = self._snap()
+        out: Dict[str, Dict[str, int]] = {}
+        for i, fam in enumerate(self.families):
+            t = out.setdefault(fam, {"candidates": 0, "confirmed": 0,
+                                     "confirm_errors": 0, "rules": 0})
+            t["candidates"] += int(cand[i])
+            t["confirmed"] += int(conf[i])
+            t["confirm_errors"] += int(err[i])
+            t["rules"] += 1
+        return out
+
+    def health(self, never_hit_cap: int = 50,
+               top_waste: int = 20) -> dict:
+        """The /rules/health body: runtime-dead rules (confirm can never
+        evaluate AND candidates reached it), latent-dead rules (broken
+        but not yet candidated), never-hit rules, and the top false-
+        candidate rules ranked by wasted confirm evaluations (the
+        confirm-CPU cost of prefilter over-approximation)."""
+        requests, cand, conf, err, _score, _block = self._snap()
+        runtime_dead, latent_dead = [], []
+        for i in np.nonzero(self.broken)[0]:
+            i = int(i)
+            rec = {"rule_id": int(self.rule_ids[i]),
+                   "confirm_errors": int(err[i]),
+                   "candidates": int(cand[i]),
+                   "reason": self.broken_reason.get(i, "")}
+            (runtime_dead if cand[i] > 0 else latent_dead).append(rec)
+        never = np.nonzero((conf == 0) & ~self.ignored)[0]
+        never_cand = np.nonzero((cand == 0) & ~self.ignored)[0]
+        # broken rules are reported under runtime_dead, not here: their
+        # candidates all "waste" by definition (confirm aborts on the
+        # None pattern instantly), and a loose-factored dead rule would
+        # otherwise bury the genuinely tunable rules this list targets
+        waste = np.where(self.broken, 0, cand - conf)
+        worder = np.argsort(-waste, kind="stable")[:top_waste]
+        top = []
+        for i in worder:
+            i = int(i)
+            if waste[i] <= 0:
+                break
+            top.append({"rule_id": int(self.rule_ids[i]),
+                        "family": self.families[i],
+                        "candidates": int(cand[i]),
+                        "confirmed": int(conf[i]),
+                        "wasted_confirms": int(waste[i]),
+                        "false_candidate_rate":
+                            round(int(waste[i]) / int(cand[i]), 4)})
+        return {
+            "version": self.version,
+            "requests": requests,
+            "runtime_dead": runtime_dead,
+            "latent_dead": latent_dead,
+            "never_hit": {
+                "count": int(len(never)),
+                "total_rules": int(len(self.rule_ids)),
+                "sample_rule_ids":
+                    [int(self.rule_ids[i]) for i in never[:never_hit_cap]],
+                "note": "confirmed == 0 over the requests above; expect "
+                        "many on low traffic — judge against `requests`",
+            },
+            "never_candidate_count": int(len(never_cand)),
+            "top_false_candidates": top,
+        }
+
+
+def drift_report(frozen: Optional[FrozenRuleStats], live: RuleStats,
+                 top: int = 200, min_new_requests: int = 100) -> dict:
+    """Join the frozen (pre-swap) stats against the live generation by
+    rule id: per-rule confirm-hit-rate deltas plus the went-quiet flag
+    (confirmed before the reload, silent after).  ``frozen`` None means
+    no hot swap has happened yet — an explicit note, not an error.
+
+    ``min_new_requests``: traffic floor before went_quiet fires —
+    right after a swap essentially every previously-active rule has
+    confirmed==0 simply because no matching request arrived yet, so an
+    unfloored flag would report dozens of false regressions
+    (``/rules/drift?min=N`` overrides; the deltas report regardless)."""
+    if frozen is None:
+        return {"note": "no ruleset swap since startup; /rules/drift "
+                        "compares across the most recent hot reload",
+                "new_version": live.version, "rules": []}
+    requests, cand, conf, _err, _sc, _bl = live._snap()
+    old_idx = {int(r): i for i, r in enumerate(frozen.rule_ids)}
+    new_idx = {int(r): i for i, r in enumerate(live.rule_ids)}
+    old_req = max(frozen.requests, 1)
+    new_req = max(requests, 1)
+    quiet_eligible = requests >= min_new_requests
+    rows = []
+    went_quiet = []
+    for rid, ni in new_idx.items():
+        oi = old_idx.get(rid)
+        if oi is None:
+            continue
+        old_rate = float(frozen.confirmed[oi]) / old_req
+        new_rate = float(conf[ni]) / new_req
+        if old_rate == 0.0 and new_rate == 0.0:
+            continue
+        quiet = (quiet_eligible and frozen.confirmed[oi] > 0
+                 and conf[ni] == 0)
+        rows.append({
+            "rule_id": rid,
+            "old_confirmed": int(frozen.confirmed[oi]),
+            "new_confirmed": int(conf[ni]),
+            "old_hit_rate": round(old_rate, 6),
+            "new_hit_rate": round(new_rate, 6),
+            "delta": round(new_rate - old_rate, 6),
+            "went_quiet": bool(quiet),
+        })
+        if quiet:
+            went_quiet.append(rid)
+    rows.sort(key=lambda r: abs(r["delta"]), reverse=True)
+    added = sorted(set(new_idx) - set(old_idx))
+    removed = sorted(set(old_idx) - set(new_idx))
+    return {
+        "old_version": frozen.version,
+        "new_version": live.version,
+        "old_requests": frozen.requests,
+        "new_requests": requests,
+        "min_new_requests": min_new_requests,
+        "rules": rows[:top],
+        "went_quiet": sorted(went_quiet),
+        "added_rules": added[:100],
+        "removed_rules": removed[:100],
+    }
+
+
+def device_efficiency(stats) -> dict:
+    """Device-efficiency gauges from PipelineStats: how much of the
+    padded (B, L) rectangles the engine scans is live bytes, how full
+    the dispatched row dimension runs, how often serving hit a shape
+    the warmup had not compiled, and per-L-tier bucket occupancy.
+
+    Reads the RESETTABLE group (live_* / padded_* — zeroed after
+    warmup), not the cumulative Prometheus counters.  The bucket dicts
+    are copied via dict() FIRST: that copy is a single C-level op under
+    the GIL, safe against the dispatch thread inserting a new L tier
+    mid-scrape (a plain comprehension over the live dict can raise
+    "dict changed size during iteration")."""
+    pad_bytes = getattr(stats, "padded_bytes", 0)
+    pad_rows = getattr(stats, "padded_rows", 0)
+    bucket_rows = dict(getattr(stats, "bucket_rows", {}))
+    bucket_padded = dict(getattr(stats, "bucket_padded_rows", {}))
+    return {
+        "padding_waste_ratio":
+            round(1.0 - stats.live_row_bytes / pad_bytes, 4) if pad_bytes
+            else None,
+        "dispatch_fill":
+            round(stats.live_rows / pad_rows, 4) if pad_rows else None,
+        "engine_recompiles": getattr(stats, "engine_compiles", 0),
+        "bucket_rows":
+            {str(k): v for k, v in sorted(bucket_rows.items())},
+        "bucket_padded_rows":
+            {str(k): v for k, v in sorted(bucket_padded.items())},
+    }
+
+
+def bench_block(pipeline) -> Optional[dict]:
+    """The BENCH json ``rule_stats`` block (per-family false-candidate
+    rate + the padding-waste / dispatch-fill gauges), mirroring the
+    ``stage_breakdown`` convention: callers treat None as a LOUD
+    warning, never a silent absence."""
+    rs = getattr(pipeline, "rule_stats", None)
+    if rs is None or rs.requests == 0:
+        return None
+    fams = rs.family_totals()
+    per_family = {}
+    tot_cand = tot_conf = 0
+    for fam, t in sorted(fams.items()):
+        c, cf = t["candidates"], t["confirmed"]
+        tot_cand += c
+        tot_conf += cf
+        if c == 0:
+            continue
+        per_family[fam] = {
+            "candidates": c, "confirmed": cf,
+            "false_candidate_rate": round((c - cf) / c, 4),
+        }
+    health = rs.health()
+    out = {
+        "version": rs.version,
+        "requests": rs.requests,
+        "false_candidate_rate":
+            round((tot_cand - tot_conf) / tot_cand, 4) if tot_cand
+            else None,
+        "per_family": per_family,
+        "runtime_dead":
+            [d["rule_id"] for d in health["runtime_dead"]],
+        "latent_dead":
+            [d["rule_id"] for d in health["latent_dead"]],
+    }
+    out.update(device_efficiency(pipeline.stats))
+    return out

@@ -106,6 +106,20 @@ class MeshEngine:
             self._tables = EngineTables.from_ruleset(self.ruleset)
         return self._tables
 
+    def device_info(self) -> dict:
+        """Engine-API twin of DetectionEngine.device_info (served by
+        /rules/stats), plus the mesh shape the scan is sharded over."""
+        t = self.ruleset.tables
+        return {
+            "scan_impl": self.scan_impl,
+            "n_rules": int(self.ruleset.n_rules),
+            "n_factors": int(t.n_factors),
+            "n_words": int(t.n_words),
+            "max_factor_len": int(t.max_factor_len),
+            "mesh": {str(k): int(v)
+                     for k, v in self.mesh.shape.items()},
+        }
+
     def swap_ruleset(self, cr: CompiledRuleset) -> None:
         self.ruleset = cr
         self._tables = None

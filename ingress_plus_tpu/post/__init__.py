@@ -28,8 +28,10 @@ from ingress_plus_tpu.post.counters import NodeCounters
 from ingress_plus_tpu.post.brute import BruteDetector
 from ingress_plus_tpu.post.export import Exporter, RulesetWatcher
 from ingress_plus_tpu.post.channel import PostChannel
+from ingress_plus_tpu.post.topk import SpaceSaving
 
 __all__ = [
     "Hit", "HitQueue", "Attack", "aggregate_attacks", "NodeCounters",
     "BruteDetector", "Exporter", "RulesetWatcher", "PostChannel",
+    "SpaceSaving",
 ]

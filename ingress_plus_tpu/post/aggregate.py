@@ -30,6 +30,10 @@ class Attack:
     sample_rule_ids: List[int] = field(default_factory=list)
     sample_request_ids: List[str] = field(default_factory=list)
     sample_points: List[dict] = field(default_factory=list)
+    # companion set for sample_rule_ids dedup: O(1) membership on the
+    # verdict-record path instead of list scans; the exported to_dict
+    # stays the capped, insertion-ordered LIST above
+    _rid_seen: set = field(default_factory=set, repr=False, compare=False)
 
     MAX_SAMPLES = 8
 
@@ -45,7 +49,8 @@ class Attack:
         for r in hit.rule_ids:
             if len(self.sample_rule_ids) >= self.MAX_SAMPLES:
                 break
-            if r not in self.sample_rule_ids:
+            if r not in self._rid_seen:
+                self._rid_seen.add(r)
                 self.sample_rule_ids.append(r)
         for p in hit.matches:
             if len(self.sample_points) >= self.MAX_SAMPLES:

@@ -17,6 +17,7 @@ from ingress_plus_tpu.post.brute import BruteConfig, BruteDetector
 from ingress_plus_tpu.post.counters import NodeCounters
 from ingress_plus_tpu.post.export import Exporter
 from ingress_plus_tpu.post.queue import Hit, HitQueue
+from ingress_plus_tpu.post.topk import SpaceSaving
 from ingress_plus_tpu.serve.normalize import Request
 
 _CLIENT_HEADERS = ("x-real-ip", "x-forwarded-for", "x-client-ip")
@@ -42,6 +43,11 @@ class PostChannel:
                  brute_config: Optional[BruteConfig] = None):
         self.queue = HitQueue(maxlen=queue_len)
         self.counters = NodeCounters()
+        # top-K attacked paths / tenants (bounded space-saving sketch,
+        # post/topk.py) — heavy-hitter visibility without a counter per
+        # distinct URI (a scanner sweep has unbounded distinct paths)
+        self.top_paths = SpaceSaving(capacity=32)
+        self.top_tenants = SpaceSaving(capacity=32)
         self.exporter = Exporter(
             self.queue, spool_dir=spool_dir, http_url=http_url,
             interval_s=interval_s,
@@ -55,6 +61,9 @@ class PostChannel:
             attack=verdict.attack, blocked=verdict.blocked,
             fail_open=verdict.fail_open, classes=verdict.classes,
             tenant=request.tenant, mode=request.mode)
+        if verdict.attack:
+            self.top_paths.offer(request.uri.split("?", 1)[0][:128])
+            self.top_tenants.offer(str(request.tenant))
         # every request is queued (brute-detect needs clean-request rates);
         # the aggregator ignores non-attacks for attack export
         self.queue.put(Hit(
@@ -83,4 +92,10 @@ class PostChannel:
                       "total": self.queue.total}
         d["export"] = {"attacks": self.exporter.exported_attacks,
                        "errors": self.exporter.export_errors}
+        d["top_attacked"] = {
+            "paths": self.top_paths.items(10),
+            "tenants": self.top_tenants.items(10),
+            "note": "space-saving sketch: count may over-estimate by "
+                    "up to max_error",
+        }
         return d

@@ -10,9 +10,32 @@ import time
 from typing import Dict
 
 
+def _bump(d: Dict, key, cap: int, overflow) -> None:
+    """Increment ``d[key]`` under a fixed key budget (the overflow
+    bucket counts toward it): new keys past the budget fold into
+    ``overflow`` — a hostile tenant/class stream can therefore never
+    grow the status JSON without limit.  Existing keys keep counting."""
+    if key in d:
+        d[key] += 1
+    elif len(d) < cap - (0 if overflow in d else 1):
+        d[key] = 1
+    else:
+        d[overflow] = d.get(overflow, 0) + 1
+
+
 class NodeCounters:
     """Monotonic counters, thread-safe, cheap enough for the verdict path
-    (single lock, integer adds)."""
+    (single lock, integer adds).  Keyed dicts are cardinality-capped
+    (``MAX_*_KEYS`` + an ``other``/-1 overflow bucket)."""
+
+    MAX_CLASS_KEYS = 64        # attack classes are a small closed set
+    # tenants are system-bounded at control/sync.py MAX_TENANTS (4096):
+    # the budget must cover every legal tenant (+1 for the overflow
+    # slot) or late-arriving tenants lose attribution permanently
+    # (_bump never evicts); export_events keys the composite
+    # "class:tenant" space, so it gets a multiple of that bound
+    MAX_TENANT_KEYS = 4096 + 1
+    MAX_EXPORT_KEYS = 4 * 4096
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -44,8 +67,8 @@ class NodeCounters:
                 elif mode == 1:
                     self.monitored += 1
                 for c in classes:
-                    self.by_class[c] = self.by_class.get(c, 0) + 1
-                self.by_tenant[tenant] = self.by_tenant.get(tenant, 0) + 1
+                    _bump(self.by_class, c, self.MAX_CLASS_KEYS, "other")
+                _bump(self.by_tenant, tenant, self.MAX_TENANT_KEYS, -1)
 
     def record_export_events(self, records) -> None:
         """Fold exporter-delivered attack records (incl. brute/dirbust)
@@ -54,9 +77,11 @@ class NodeCounters:
         with self._lock:
             for r in records:
                 cls = r.get("class", "unclassified")
-                self.export_events[cls] = self.export_events.get(cls, 0) + 1
+                _bump(self.export_events, cls,
+                      self.MAX_EXPORT_KEYS, "other")
                 key = "%s:%s" % (cls, r.get("tenant", 0))
-                self.export_events[key] = self.export_events.get(key, 0) + 1
+                _bump(self.export_events, key,
+                      self.MAX_EXPORT_KEYS, "other")
 
     def snapshot(self) -> dict:
         with self._lock:

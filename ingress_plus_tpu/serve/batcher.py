@@ -129,14 +129,17 @@ class Batcher:
     # ------------------------------------------------------------- API
 
     def reset_latency_observations(self) -> None:
-        """Zero the stage histograms and the slow-exemplar ring.  Bench
-        legs call this after warmup so the scraped stage_breakdown
-        describes ONLY the measured traffic, not the first-dispatch XLA
-        compiles the warmup exists to keep out of p99."""
+        """Zero the stage histograms, the slow-exemplar ring, AND the
+        detection-plane telemetry (RuleStats + device-efficiency group).
+        Bench legs call this after warmup so every scraped observation
+        layer — stage_breakdown and rule_stats alike — describes ONLY
+        the measured traffic, not the synthetic warmup corpus or its
+        first-dispatch XLA compiles."""
         for h in self.hist.values():
             h.reset()
         self.batch_size_hist.reset()
         self.slow.reset()
+        self.pipeline.reset_detection_observations()
 
     def submit(self, request: Request) -> "Future[Verdict]":
         fut: "Future[Verdict]" = Future()
@@ -294,6 +297,11 @@ class Batcher:
             new.warm_shape(*shape)
         new.stats = old.stats  # counters span swaps (Prometheus contract)
         with self._swap_lock:
+            # reload-drift snapshot (ISSUE 3): freeze the outgoing
+            # version's per-rule counters at the instant it stops
+            # serving — /rules/drift joins them against the new
+            # generation's (fresh) RuleStats by rule id
+            new.frozen_rule_stats = self.pipeline.rule_stats.freeze()
             self.pipeline = new
             # in-flight streams carry old-table state words; StreamEngine
             # detects the version change and fails them open at finish

@@ -320,7 +320,17 @@ class ServeLoop:
 
     def _metrics_text(self) -> str:
         s = self.batcher.stats
-        p = self.batcher.pipeline.stats
+        pipeline = self.batcher.pipeline
+        p = pipeline.stats
+        # the live ruleset version, attached ONLY to per-generation
+        # series (RuleStats-backed values that reset at each hot swap,
+        # so a version change is an honest Prometheus counter reset).
+        # The cumulative counters spanning swaps stay UNLABELED — a
+        # mutable label on a counter that keeps its value would strand
+        # the old series and pre-load the new one; cross-reload
+        # attribution for those is the ipt_ruleset_info join (the
+        # pattern this reuses, ISSUE 3 satellite).
+        ver = 'version="%s"' % pipeline.ruleset.version
         lines = [
             "# TYPE ipt_requests_total counter",
             "ipt_requests_total %d" % s.completed,
@@ -352,9 +362,57 @@ class ServeLoop:
             "ipt_confirmed_hits_total %d" % p.confirmed_rule_hits,
             "# TYPE ipt_ruleset_info gauge",
             'ipt_ruleset_info{version="%s",rules="%d"} 1'
-            % (self.batcher.pipeline.ruleset.version,
-               self.batcher.pipeline.ruleset.n_rules),
+            % (pipeline.ruleset.version, pipeline.ruleset.n_rules),
         ]
+        # --- detection-plane telemetry (ISSUE 3): family-level hit
+        # series (bounded cardinality — full per-rule detail is
+        # JSON-only at /rules/stats) + device-efficiency gauges
+        rs = pipeline.rule_stats
+        from ingress_plus_tpu.models.rule_stats import device_efficiency
+        from ingress_plus_tpu.utils.trace import bounded_counter_series
+        fams = rs.family_totals()
+        lines.append("# TYPE ipt_rule_family_hits_total counter")
+        lines += bounded_counter_series(
+            "ipt_rule_family_hits_total", "family",
+            {f: t["confirmed"] for f, t in fams.items()},
+            extra={"version": rs.version})
+        lines.append("# TYPE ipt_rule_family_candidates_total counter")
+        lines += bounded_counter_series(
+            "ipt_rule_family_candidates_total", "family",
+            {f: t["candidates"] for f, t in fams.items()},
+            extra={"version": rs.version})
+        health_dead = int(((rs.candidates > 0) & rs.broken).sum())
+        eff = device_efficiency(p)
+        lines += [
+            "# TYPE ipt_confirm_errors_total counter",
+            "ipt_confirm_errors_total{%s} %d"
+            % (ver, int(rs.confirm_errors.sum())),
+            "# TYPE ipt_rules_runtime_dead gauge",
+            "ipt_rules_runtime_dead{%s} %d" % (ver, health_dead),
+            "# TYPE ipt_padded_rows_total counter",
+            "ipt_padded_rows_total %d" % p.padded_rows,
+            "# TYPE ipt_padded_bytes_total counter",
+            "ipt_padded_bytes_total %d" % p.padded_bytes,
+            # NaN when no dispatch happened yet (post-warmup reset): a
+            # literal 0 would read as worst-case fill / perfect waste
+            # and fire threshold alerts on every restart
+            "# TYPE ipt_pad_waste_ratio gauge",
+            "ipt_pad_waste_ratio %s"
+            % (eff["padding_waste_ratio"]
+               if eff["padding_waste_ratio"] is not None else "NaN"),
+            "# TYPE ipt_dispatch_fill gauge",
+            "ipt_dispatch_fill %s"
+            % (eff["dispatch_fill"]
+               if eff["dispatch_fill"] is not None else "NaN"),
+            "# TYPE ipt_engine_recompiles_total counter",
+            "ipt_engine_recompiles_total %d" % p.engine_compiles,
+        ]
+        lines.append("# TYPE ipt_bucket_rows_total counter")
+        # dict() first: atomic copy vs the dispatch thread inserting a
+        # new L tier mid-scrape (see rule_stats.device_efficiency)
+        lines += bounded_counter_series(
+            "ipt_bucket_rows_total", "bucket",
+            {str(k): v for k, v in dict(p.bucket_rows).items()})
         # stage-level latency attribution (ISSUE 1): one Prometheus
         # histogram per pipeline stage, so p50/p99 per stage are
         # scrapeable without external tooling (the reference gets this
@@ -518,6 +576,52 @@ class ServeLoop:
                       else {"postanalytics": "disabled"})
             return ("200 OK", "application/json",
                     json.dumps(status).encode())
+        if path.startswith("/rules/stats"):
+            # per-rule runtime accounting (ISSUE 3) — full detail is
+            # JSON-only here by the cardinality policy (Prometheus gets
+            # the bounded family series).  ?n= caps the rule list
+            # (candidates-descending); default is the whole pack.
+            from urllib.parse import parse_qs, urlsplit
+            from ingress_plus_tpu.models.rule_stats import (
+                device_efficiency)
+            q = parse_qs(urlsplit(path).query, keep_blank_values=True)
+            try:
+                n = int((q.get("n") or ["0"])[0])
+            except ValueError:
+                n = 0
+            rs = pipeline.rule_stats
+            body = {
+                "version": rs.version,
+                "requests": rs.requests,
+                "device": pipeline.engine.device_info(),
+                "efficiency": device_efficiency(pipeline.stats),
+                "rules": rs.rules_json(limit=max(n, 0)),
+            }
+            return ("200 OK", "application/json",
+                    json.dumps(body).encode())
+        if path.startswith("/rules/health"):
+            # runtime dead-rule + false-candidate view: the runtime
+            # twin of the static rulecheck audit (docs/ANALYSIS.md) —
+            # a rule whose confirm regex fails at runtime surfaces here
+            # after its FIRST candidate, not at the next audit
+            return ("200 OK", "application/json",
+                    json.dumps(pipeline.rule_stats.health()).encode())
+        if path.startswith("/rules/drift"):
+            # hit-rate deltas across the most recent hot reload: the
+            # outgoing version's counters freeze at swap; rules that
+            # went quiet after the reload are flagged once ?min= (or
+            # the default floor) of new traffic has accumulated
+            from urllib.parse import parse_qs, urlsplit
+            from ingress_plus_tpu.models.rule_stats import drift_report
+            q = parse_qs(urlsplit(path).query, keep_blank_values=True)
+            try:
+                mn = int((q.get("min") or ["100"])[0])
+            except ValueError:
+                mn = 100
+            return ("200 OK", "application/json", json.dumps(
+                drift_report(pipeline.frozen_rule_stats,
+                             pipeline.rule_stats,
+                             min_new_requests=max(mn, 1))).encode())
         if path == "/configuration/tenants" and method == "POST":
             # EP tenant table push: {"<tenant>": ["tag", ...], ...}
             from ingress_plus_tpu.control.sync import MAX_TENANTS
@@ -690,6 +794,10 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
         pipeline.engine.scan_impl = scan_impl
     if warmup:
         warmup_pipeline(pipeline, max_batch)
+        # the warmup corpus is synthetic (20% attacks): drop it from
+        # the detection-plane telemetry so /rules/* and the efficiency
+        # gauges describe real traffic from request one
+        pipeline.reset_detection_observations()
     return Batcher(pipeline, max_batch=max_batch, max_delay_s=max_delay_s)
 
 

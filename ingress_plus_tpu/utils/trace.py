@@ -269,6 +269,40 @@ class SlowRing:
         return None
 
 
+def bounded_counter_series(name: str, label: str,
+                           counts: Dict[str, int], cap: int = 30,
+                           extra: Optional[Dict[str, str]] = None,
+                           ) -> List[str]:
+    """Prometheus counter lines for one labeled series with a HARD
+    cardinality budget (the detection-plane telemetry policy: per-rule
+    detail is JSON-only, Prometheus gets bounded label sets).
+
+    The first ``cap`` label values in SORTED label order are emitted
+    verbatim; the tail folds into one ``label="other"`` series carrying
+    the summed remainder — a hostile key stream can therefore never
+    grow the scrape.  Membership is deterministic BY LABEL, not by
+    count: count-ranked membership would reshuffle between scrapes as
+    counts race, making the "other" counter non-monotonic (a fold-set
+    change reads as a process reset to PromQL rate()).  With a fixed
+    label universe per series generation (rule families are fixed per
+    ruleset version, L tiers are static) every series is monotonic.
+    ``extra`` labels (e.g. the ruleset version) ride every line.  No
+    # TYPE header — the caller groups series under one."""
+    base = "".join('%s="%s",' % (k, v)
+                   for k, v in (extra or {}).items())
+    ordered = sorted(counts.items())
+    lines = []
+    other = 0
+    for i, (val, n) in enumerate(ordered):
+        if i < cap and val != "other":
+            lines.append('%s{%s%s="%s"} %d' % (name, base, label, val, n))
+        else:
+            other += n
+    if other or len(ordered) > cap:
+        lines.append('%s{%s%s="other"} %d' % (name, base, label, other))
+    return lines
+
+
 # --------------------------------------------------------------- parsing
 
 _BUCKET_RE = re.compile(

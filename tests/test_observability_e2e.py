@@ -170,6 +170,59 @@ def test_traces_slowest_carries_stage_breakdown(server):
     assert "stages" in body[0] and "prep_us" in body[0]["stages"]
 
 
+def test_rules_stats_and_health_after_traffic(server):
+    """ISSUE 3: the detection-plane telemetry surfaces appear on the
+    live server after traffic — /rules/stats carries per-rule
+    candidate/confirm accounting, /rules/health the dead/never-hit
+    view, /rules/drift answers (no swap yet), and /metrics gains the
+    family series + device-efficiency gauges."""
+    from ingress_plus_tpu.serve.normalize import Request
+
+    got = _drive(server, [(Request(uri="/q?a=9+union+select+9",
+                                   request_id="4300"), 4300)])
+    assert got[4300]["attack"]
+
+    stats = json.loads(_get("/rules/stats"))
+    assert stats["requests"] >= 1
+    rows = {r["rule_id"]: r for r in stats["rules"]}
+    assert rows[942100]["candidates"] >= 1
+    assert rows[942100]["confirmed"] >= 1
+    assert stats["efficiency"]["dispatch_fill"] is not None
+    assert stats["device"]["n_rules"] == len(rows)
+
+    health = json.loads(_get("/rules/health"))
+    assert health["runtime_dead"] == []        # tiny pack is healthy
+    assert health["requests"] >= 1
+
+    drift = json.loads(_get("/rules/drift"))
+    assert "note" in drift                     # no hot swap happened
+
+    metrics = _get("/metrics").decode()
+    assert 'ipt_rule_family_hits_total{' in metrics
+    assert 'family="942"' in metrics
+    assert "ipt_pad_waste_ratio" in metrics
+    assert "ipt_dispatch_fill" in metrics
+    assert "ipt_engine_recompiles_total" in metrics
+    # per-generation series carry the version label (satellite)
+    assert 'ipt_rules_runtime_dead{version="' in metrics
+    assert 'ipt_confirm_errors_total{version="' in metrics
+
+
+def test_dbg_rules_renders_live_endpoints(server, capsys):
+    from ingress_plus_tpu.control import dbg
+
+    rc = dbg.main(["rules", "--server", "127.0.0.1:%d" % PORT])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "942100" in out
+    assert "runtime-dead rules (0)" in out
+
+    rc = dbg.main(["drift", "--server", "127.0.0.1:%d" % PORT])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "no ruleset swap since startup" in out
+
+
 def test_dbg_latency_parses_live_endpoints(server, capsys):
     """ISSUE 1 satellite: `dbg latency` drives the real endpoints and
     renders a parseable stage table."""

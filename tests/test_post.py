@@ -160,6 +160,108 @@ def test_counters_math():
     assert s["by_tenant"] == {"1": 2}
 
 
+def test_counters_cardinality_capped():
+    """ISSUE 3 satellite: a hostile tenant/class stream must not grow
+    the /wallarm-status JSON without limit — past the key budget, new
+    keys fold into the overflow bucket ("other" / tenant -1)."""
+    c = NodeCounters()
+    for i in range(NodeCounters.MAX_CLASS_KEYS + 50):
+        c.record(attack=True, blocked=False, fail_open=False,
+                 classes=["class-%d" % i], tenant=i, mode=1)
+    s = c.snapshot()
+    assert len(s["by_class"]) <= NodeCounters.MAX_CLASS_KEYS
+    assert s["by_class"]["other"] >= 50
+    # existing keys keep counting after the cap is reached
+    c.record(attack=True, blocked=False, fail_open=False,
+             classes=["class-0"], tenant=0, mode=1)
+    assert c.snapshot()["by_class"]["class-0"] == 2
+    # total attacks are preserved across the fold
+    assert sum(s["by_class"].values()) == s["attacks"]
+
+    # the tenant budget must cover every legal tenant id (+ overflow):
+    # post/ deliberately doesn't import the control plane, so pin the
+    # two constants against each other here
+    from ingress_plus_tpu.control.sync import MAX_TENANTS
+    assert NodeCounters.MAX_TENANT_KEYS == MAX_TENANTS + 1
+
+    c2 = NodeCounters()
+    for i in range(NodeCounters.MAX_TENANT_KEYS + 10):
+        c2.record(attack=True, blocked=False, fail_open=False,
+                  classes=["sqli"], tenant=i, mode=1)
+    s2 = c2.snapshot()
+    assert len(s2["by_tenant"]) <= NodeCounters.MAX_TENANT_KEYS
+    assert s2["by_tenant"]["-1"] >= 10         # overflow tenant bucket
+    assert sum(s2["by_tenant"].values()) == s2["attacks"]
+
+    c3 = NodeCounters()
+    c3.record_export_events(
+        [{"class": "c%d" % i, "tenant": i}
+         for i in range(NodeCounters.MAX_EXPORT_KEYS)])
+    s3 = c3.snapshot()
+    assert len(s3["export_events"]) <= NodeCounters.MAX_EXPORT_KEYS
+    assert s3["export_events"].get("other", 0) > 0
+
+
+def test_attack_rule_id_dedup_capped_and_ordered():
+    """ISSUE 3 satellite: sample_rule_ids dedup via the companion set —
+    output stays capped at MAX_SAMPLES and insertion-ordered, and the
+    set never appears in the export dict."""
+    from ingress_plus_tpu.post.aggregate import Attack
+
+    a = Attack(tenant=0, client="c", attack_class="sqli",
+               first_ts=0.0, last_ts=0.0)
+    a.add(mk_hit(rule_ids=(3, 1, 3, 2)))
+    a.add(mk_hit(rule_ids=tuple(range(100, 120))))
+    d = a.to_dict()
+    assert d["sample_rule_ids"] == [3, 1, 2, 100, 101, 102, 103, 104]
+    assert len(d["sample_rule_ids"]) == Attack.MAX_SAMPLES
+    assert "_rid_seen" not in d
+
+
+def test_space_saving_sketch_topk():
+    from ingress_plus_tpu.post.topk import SpaceSaving
+
+    sk = SpaceSaving(capacity=4)
+    for _ in range(50):
+        sk.offer("/login")
+    for _ in range(30):
+        sk.offer("/admin")
+    for i in range(20):                        # distinct-key sweep
+        sk.offer("/sweep/%d" % i)
+    items = sk.items()
+    assert len(items) == 4                     # bounded, always
+    top = items[0]
+    assert top["key"] == "/login"
+    # true count lies within [count - max_error, count]
+    assert top["count"] - top["max_error"] <= 50 <= top["count"]
+    second = items[1]
+    assert second["key"] == "/admin"
+    assert second["count"] - second["max_error"] <= 30 <= second["count"]
+    assert sk.items(1) == [top]
+
+
+def test_post_channel_top_attacked_in_status():
+    ch = PostChannel(brute=False)
+
+    class V:
+        attack = True
+        blocked = True
+        fail_open = False
+        classes = ("sqli",)
+        rule_ids = (942100,)
+        score = 5
+
+    for i in range(5):
+        ch.record(Request(uri="/login?u=%d" % i, request_id=str(i),
+                          tenant=3), V())
+    ch.record(Request(uri="/other", request_id="x", tenant=1), V())
+    st = ch.status()
+    top = st["top_attacked"]
+    assert top["paths"][0]["key"] == "/login"
+    assert top["paths"][0]["count"] == 5
+    assert top["tenants"][0]["key"] == "3"
+
+
 # --------------------------------------------------------------- exporter
 
 def test_exporter_spools_attacks(tmp_path):

@@ -1,0 +1,458 @@
+"""Detection-plane telemetry (ISSUE 3): vectorized per-rule counters vs
+a scalar reference, confirm-error accounting on a deliberately broken
+rule, the reload-drift snapshot across a live /configuration/ruleset
+hot swap, the /rules/* endpoints, the bounded-cardinality Prometheus
+rendering, and the dbg terminal views."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.models.rule_stats import (
+    RuleStats,
+    bench_block,
+    device_efficiency,
+    drift_report,
+    family_of,
+)
+from ingress_plus_tpu.serve.normalize import Request
+
+RULES = r"""
+SecRule ARGS "@rx (?i)union\s+select" \
+    "id:942100,phase:2,block,severity:CRITICAL,tag:'attack-sqli'"
+SecRule ARGS|REQUEST_URI "@rx (?i)<script" \
+    "id:941100,phase:2,block,severity:CRITICAL,tag:'attack-xss'"
+SecRule ARGS "@contains etcpasswd" \
+    "id:930120,phase:2,block,severity:CRITICAL,tag:'attack-lfi'"
+"""
+
+#: variable-width lookbehind: RegexUnsupported for the factor compiler
+#: (→ always-confirm) AND rejected by Python re (→ confirm abstains on
+#: every value) — the silently-dead rule class rulecheck catches
+#: statically, here injected to prove the RUNTIME twin catches it too
+BROKEN_RULE = r"""
+SecRule ARGS "@rx (?<=x+)y" \
+    "id:999901,phase:2,block,severity:CRITICAL,tag:'attack-sqli'"
+"""
+
+
+def _requests():
+    return [
+        Request(uri="/q?a=1+union+select+2", request_id="1"),
+        Request(uri="/p?b=%3Cscript%3Ealert(1)", request_id="2"),
+        Request(uri="/ok?c=hello", request_id="3"),
+        Request(uri="/q?a=union+select+1&d=<script>", request_id="4"),
+    ]
+
+
+def test_family_of():
+    assert family_of(942100) == "942"
+    assert family_of(100000) == "100"
+    assert family_of(99999) == "custom"
+    assert family_of(7) == "custom"
+
+
+def test_vectorized_counters_match_scalar_reference():
+    """Batched accounting must equal per-request (batch of 1) scalar
+    accumulation — the vectorization is pure bookkeeping."""
+    cr = compile_ruleset(parse_seclang(RULES))
+    batched = DetectionPipeline(cr, mode="block")
+    scalar = DetectionPipeline(cr, mode="block")
+    reqs = _requests()
+    verdicts = batched.detect(reqs)
+
+    ref_cand = np.zeros(cr.n_rules, np.int64)
+    ref_conf = np.zeros(cr.n_rules, np.int64)
+    ref_score = np.zeros(cr.n_rules, np.int64)
+    ref_block = np.zeros(cr.n_rules, np.int64)
+    for req in reqs:
+        hits = scalar.prefilter([req])
+        ref_cand += hits[0]
+        v = scalar.finalize([req], hits, 0.0)[0]
+        for rid in v.rule_ids:
+            idx = int(np.nonzero(cr.rule_ids == rid)[0][0])
+            ref_conf[idx] += 1
+            ref_score[idx] += int(cr.rule_score[idx])
+            ref_block[idx] += int(v.blocked)
+
+    rs = batched.rule_stats
+    assert rs.requests == len(reqs)
+    np.testing.assert_array_equal(rs.candidates, ref_cand)
+    np.testing.assert_array_equal(rs.confirmed, ref_conf)
+    np.testing.assert_array_equal(rs.score_sum, ref_score)
+    np.testing.assert_array_equal(rs.block_hits, ref_block)
+    # the scalar pipeline accumulated the same traffic one by one
+    np.testing.assert_array_equal(scalar.rule_stats.candidates, ref_cand)
+    np.testing.assert_array_equal(scalar.rule_stats.confirmed, ref_conf)
+    # verdict agreement between the two pipelines (sanity)
+    assert [v.rule_ids for v in verdicts] == \
+        [scalar.detect([r])[0].rule_ids for r in reqs]
+
+
+def test_confirm_error_accounting_on_broken_rule():
+    """ISSUE 3 acceptance: a rule whose confirm regex fails at runtime
+    shows up as runtime-dead with nonzero confirm_errors after a SINGLE
+    request that candidates it."""
+    cr = compile_ruleset(parse_seclang(RULES + BROKEN_RULE))
+    pipe = DetectionPipeline(cr, mode="block")
+    idx = int(np.nonzero(cr.rule_ids == 999901)[0][0])
+    # always-confirm (no prefilter factors): one request candidates it
+    assert cr.tables.rule_nfactors[idx] == 0
+    pipe.detect([Request(uri="/q?a=xy", request_id="1")])
+
+    rs = pipe.rule_stats
+    assert rs.broken[idx]
+    assert rs.candidates[idx] >= 1
+    assert rs.confirm_errors[idx] >= 1
+    assert rs.confirmed[idx] == 0
+    health = rs.health()
+    dead = {d["rule_id"]: d for d in health["runtime_dead"]}
+    assert 999901 in dead
+    assert dead[999901]["confirm_errors"] >= 1
+    assert "regex-unparsable" in dead[999901]["reason"]
+    # the healthy rules never enter the dead lists
+    assert not any(d["rule_id"] == 942100
+                   for d in health["runtime_dead"] + health["latent_dead"])
+    # ...and the dead rule stays OUT of the tuning target list — its
+    # waste is reported under runtime_dead, not as tunable confirm CPU
+    assert all(w["rule_id"] != 999901
+               for w in health["top_false_candidates"])
+
+
+def test_broken_chain_link_is_dead_too():
+    rules = parse_seclang(r"""
+SecRule ARGS "@contains foo" "id:999902,phase:2,block,chain"
+    SecRule ARGS "@rx (?<=x+)y" ""
+""")
+    cr = compile_ruleset(rules)
+    pipe = DetectionPipeline(cr, mode="block")
+    rs = pipe.rule_stats
+    idx = int(np.nonzero(cr.rule_ids == 999902)[0][0])
+    assert rs.broken[idx]
+    assert "chain-link" in rs.broken_reason[idx]
+
+
+def test_health_false_candidate_ranking():
+    """A rule that candidates but never confirms ranks by wasted
+    confirm evaluations."""
+    cr = compile_ruleset(parse_seclang(r"""
+SecRule ARGS "@rx select.{0,60}from" "id:942101,phase:2,block"
+"""))
+    pipe = DetectionPipeline(cr, mode="block")
+    # "select" + "from" factors fire, the full regex doesn't (order)
+    pipe.detect([Request(uri="/q?a=from+me+select", request_id="1"),
+                 Request(uri="/q?a=from+you+select", request_id="2")])
+    h = pipe.rule_stats.health()
+    top = h["top_false_candidates"]
+    assert top and top[0]["rule_id"] == 942101
+    assert top[0]["wasted_confirms"] == 2
+    assert top[0]["false_candidate_rate"] == 1.0
+
+
+def test_device_efficiency_gauges_counted():
+    cr = compile_ruleset(parse_seclang(RULES))
+    pipe = DetectionPipeline(cr, mode="block")
+    pipe.detect(_requests())
+    eff = device_efficiency(pipe.stats)
+    assert eff["padding_waste_ratio"] is not None
+    assert 0.0 <= eff["padding_waste_ratio"] < 1.0
+    assert 0.0 < eff["dispatch_fill"] <= 1.0
+    assert eff["engine_recompiles"] >= 1       # no warmup: first shape
+    assert eff["bucket_rows"]                  # at least one L tier hit
+    # a repeat batch of the same shape adds no recompile
+    before = pipe.stats.engine_compiles
+    pipe.detect(_requests())
+    assert pipe.stats.engine_compiles == before
+
+
+def test_bench_block_shape():
+    cr = compile_ruleset(parse_seclang(RULES + BROKEN_RULE))
+    pipe = DetectionPipeline(cr, mode="block")
+    assert bench_block(pipe) is None      # no traffic yet → LOUD path
+    pipe.detect(_requests() + [Request(uri="/q?a=xy", request_id="9")])
+    b = bench_block(pipe)
+    assert b is not None
+    assert b["requests"] == 5
+    assert "942" in b["per_family"]
+    assert 0.0 <= b["per_family"]["942"]["false_candidate_rate"] <= 1.0
+    assert b["padding_waste_ratio"] is not None
+    assert 999901 in b["runtime_dead"]
+
+
+def test_in_place_swap_freezes_stats():
+    """DetectionPipeline.swap_ruleset (library path) freezes the
+    outgoing generation for drift, same as the batcher path."""
+    cr_a = compile_ruleset(parse_seclang(RULES))
+    pipe = DetectionPipeline(cr_a, mode="block")
+    pipe.detect(_requests())
+    old_confirmed = pipe.rule_stats.confirmed.copy()
+    cr_b = compile_ruleset(parse_seclang(RULES))
+    pipe.swap_ruleset(cr_b)
+    assert pipe.frozen_rule_stats is not None
+    assert pipe.frozen_rule_stats.requests == 4
+    np.testing.assert_array_equal(
+        pipe.frozen_rule_stats.confirmed, old_confirmed)
+    assert pipe.rule_stats.requests == 0      # fresh generation
+
+
+def test_reset_detection_observations_drops_warmup():
+    """Warmup traffic must not pollute the telemetry: the reset zeroes
+    RuleStats and the device-efficiency group (keeping the structural
+    broken mask and the cumulative Prometheus counters)."""
+    cr = compile_ruleset(parse_seclang(RULES + BROKEN_RULE))
+    pipe = DetectionPipeline(cr, mode="block")
+    pipe.detect(_requests() + [Request(uri="/q?a=xy", request_id="w")])
+    assert pipe.rule_stats.requests == 5
+    rows_before = pipe.stats.rows
+    pipe.reset_detection_observations()
+    rs = pipe.rule_stats
+    assert rs.requests == 0
+    assert rs.candidates.sum() == 0 and rs.confirm_errors.sum() == 0
+    assert rs.broken.any()                     # structural mask survives
+    assert pipe.stats.padded_rows == 0
+    assert pipe.stats.engine_compiles == 0
+    assert pipe.stats.bucket_rows == {}
+    assert pipe.stats.rows == rows_before      # Prometheus counter kept
+    # post-reset traffic counts cleanly; a same-shape batch adds no
+    # recompile (the shapes were compiled before the reset — only
+    # genuinely NEW shapes count after it)
+    pipe.detect(_requests() + [Request(uri="/q?a=xy", request_id="w2")])
+    assert pipe.rule_stats.requests == 5
+    assert pipe.stats.engine_compiles == 0
+    eff = device_efficiency(pipe.stats)
+    assert eff["dispatch_fill"] is not None
+
+
+def test_ctl_pass_rules_not_counted_as_candidates():
+    """Config machinery (ctl-carrying pass rules) never reaches the
+    confirm loop as a detection — it must not read as wasted confirm
+    CPU or a never-hit rule in /rules/health."""
+    cr = compile_ruleset(parse_seclang(r"""
+SecRule REQUEST_URI "@contains /admin" \
+    "id:900900,phase:1,pass,ctl:ruleRemoveById=942100"
+SecRule ARGS "@rx (?i)union\s+select" \
+    "id:942100,phase:2,block,severity:CRITICAL"
+"""))
+    pipe = DetectionPipeline(cr, mode="block")
+    idx = int(np.nonzero(cr.rule_ids == 900900)[0][0])
+    assert idx in pipe._ctl_pass_idx
+    pipe.detect([Request(uri="/admin?x=1", request_id="1")])
+    assert pipe.rule_stats.candidates[idx] == 0
+    assert all(w["rule_id"] != 900900
+               for w in pipe.rule_stats.health()["top_false_candidates"])
+
+
+def test_runtime_ctl_excluded_rules_not_counted_as_candidates():
+    """A rule removed per-request by a matched runtime ctl rule never
+    reaches confirm for that request — it must not book candidates
+    (wasted-confirm CPU) on the traffic that excluded it."""
+    cr = compile_ruleset(parse_seclang(r"""
+SecRule REQUEST_URI "@contains /admin" \
+    "id:900901,phase:1,pass,ctl:ruleRemoveById=942100"
+SecRule ARGS "@rx (?i)union\s+select" \
+    "id:942100,phase:2,block,severity:CRITICAL"
+"""))
+    pipe = DetectionPipeline(cr, mode="block")
+    idx = int(np.nonzero(cr.rule_ids == 942100)[0][0])
+    # excluded on /admin traffic: no verdict hit AND no candidate
+    v = pipe.detect([Request(uri="/admin?a=1+union+select+2",
+                             request_id="1")])[0]
+    assert not v.attack
+    assert pipe.rule_stats.candidates[idx] == 0
+    # un-excluded traffic still counts normally
+    v = pipe.detect([Request(uri="/q?a=1+union+select+2",
+                             request_id="2")])[0]
+    assert v.attack
+    assert pipe.rule_stats.candidates[idx] == 1
+    assert pipe.rule_stats.confirmed[idx] == 1
+
+
+def test_drift_report_no_swap_note():
+    cr = compile_ruleset(parse_seclang(RULES))
+    pipe = DetectionPipeline(cr, mode="block")
+    d = drift_report(pipe.frozen_rule_stats, pipe.rule_stats)
+    assert "note" in d and d["rules"] == []
+
+
+# ------------------------------------------------- serve-plane e2e
+
+@pytest.fixture()
+def serve_stack(tmp_path):
+    from ingress_plus_tpu.serve.batcher import Batcher
+    from ingress_plus_tpu.serve.server import ServeLoop
+
+    cr = compile_ruleset(parse_seclang(RULES))
+    pipe = DetectionPipeline(cr, mode="block")
+    batcher = Batcher(pipe, max_delay_s=0.001)
+    serve = ServeLoop(batcher, str(tmp_path / "ipt.sock"))
+    yield serve, batcher, tmp_path
+    batcher.close()
+
+
+def _route(serve, method, path, payload=b""):
+    status, _ctype, body = asyncio.run(
+        serve._route_http(method, path, payload))
+    return status, json.loads(body)
+
+
+def test_drift_across_live_ruleset_swap(serve_stack):
+    """ISSUE 3 acceptance: /rules/drift returns per-rule hit-rate
+    deltas after a live /configuration/ruleset (the /wallarm sync-node
+    analog) hot swap, and flags the rule that went quiet."""
+    serve, batcher, tmp_path = serve_stack
+    attack = Request(uri="/q?a=1+union+select+2", request_id="a")
+    assert batcher.submit(attack).result(30).attack
+    assert batcher.submit(
+        Request(uri="/ok?c=1", request_id="b")).result(30).attack is False
+
+    # ruleset B: 942100's pattern can no longer match anything the
+    # traffic carries — the rule goes quiet after the reload
+    cr_b = compile_ruleset(parse_seclang(r"""
+SecRule ARGS "@rx (?i)union\s+selectzzz9" \
+    "id:942100,phase:2,block,severity:CRITICAL,tag:'attack-sqli'"
+SecRule ARGS|REQUEST_URI "@rx (?i)<script" \
+    "id:941100,phase:2,block,severity:CRITICAL,tag:'attack-xss'"
+"""))
+    art = tmp_path / "pack_b"
+    cr_b.save(art)
+    status, body = _route(
+        serve, "POST", "/configuration/ruleset",
+        json.dumps({"path": str(art)}).encode())
+    assert status.startswith("200"), body
+    assert body["ruleset"] == cr_b.version
+
+    # same traffic against the new pack: 942100 silent, 941100 alive
+    assert batcher.submit(replace_id(attack, "c")).result(30).attack \
+        is False
+    assert batcher.submit(Request(
+        uri="/p?b=<script>alert(1)", request_id="d")).result(30).attack
+
+    # default traffic floor (min=100): the deltas report but nothing
+    # is flagged quiet off 2 requests of new traffic
+    _status, unfloored = _route(serve, "GET", "/rules/drift")
+    assert unfloored["went_quiet"] == []
+    assert any(r["rule_id"] == 942100 for r in unfloored["rules"])
+
+    status, drift = _route(serve, "GET", "/rules/drift?min=2")
+    assert status.startswith("200")
+    assert drift["old_version"] != drift["new_version"]
+    assert drift["old_requests"] == 2 and drift["new_requests"] == 2
+    rows = {r["rule_id"]: r for r in drift["rules"]}
+    assert 942100 in rows
+    assert rows[942100]["old_hit_rate"] == 0.5
+    assert rows[942100]["new_hit_rate"] == 0.0
+    assert rows[942100]["delta"] == -0.5
+    assert rows[942100]["went_quiet"]
+    assert drift["went_quiet"] == [942100]
+    # 941100: quiet before, hitting after — positive delta, not quiet
+    assert rows[941100]["delta"] == 0.5
+    assert not rows[941100]["went_quiet"]
+    # the removed third rule shows in the pack delta
+    assert 930120 in drift["removed_rules"]
+
+
+def replace_id(req, rid):
+    from dataclasses import replace
+    return replace(req, request_id=rid)
+
+
+def test_rules_stats_and_health_endpoints(serve_stack):
+    serve, batcher, _tmp = serve_stack
+    batcher.submit(Request(uri="/q?a=1+union+select+2",
+                           request_id="a")).result(30)
+    status, stats = _route(serve, "GET", "/rules/stats")
+    assert status.startswith("200")
+    assert stats["requests"] == 1
+    assert stats["device"]["scan_impl"]
+    assert stats["efficiency"]["dispatch_fill"] is not None
+    rows = {r["rule_id"]: r for r in stats["rules"]}
+    assert rows[942100]["confirmed"] == 1
+    assert rows[942100]["block_hits"] == 1
+    # ?n= caps the per-rule list
+    _status, capped = _route(serve, "GET", "/rules/stats?n=1")
+    assert len(capped["rules"]) == 1
+    _status, health = _route(serve, "GET", "/rules/health")
+    assert health["runtime_dead"] == []
+    assert health["never_hit"]["count"] == 2   # 941100 + 930120 silent
+
+
+def test_metrics_family_series_and_gauges(serve_stack):
+    serve, batcher, _tmp = serve_stack
+    batcher.submit(Request(uri="/q?a=1+union+select+2",
+                           request_id="a")).result(30)
+    text = serve._metrics_text()
+    ver = batcher.pipeline.ruleset.version
+    assert ('ipt_rule_family_hits_total{version="%s",family="942"} 1'
+            % ver) in text
+    assert "ipt_pad_waste_ratio" in text
+    assert "ipt_dispatch_fill" in text
+    assert "ipt_engine_recompiles_total" in text
+    # version labels only on per-generation series (they reset at each
+    # swap, so the label change is an honest counter reset); cumulative
+    # counters stay unlabeled and attribute via the ipt_ruleset_info
+    # join (the satellite's "where it's free" boundary)
+    assert ('ipt_confirm_errors_total{version="%s"}' % ver) in text
+    assert ('ipt_rules_runtime_dead{version="%s"}' % ver) in text
+    assert "\nipt_confirmed_hits_total %d" % \
+        batcher.pipeline.stats.confirmed_rule_hits in text
+    assert ('ipt_ruleset_info{version="%s"' % ver) in text
+
+
+def test_bounded_counter_series_caps_cardinality():
+    from ingress_plus_tpu.utils.trace import bounded_counter_series
+
+    counts = {"f%03d" % i: i + 1 for i in range(50)}
+    lines = bounded_counter_series("m", "family", counts, cap=10)
+    assert len(lines) == 11                    # 10 + the other bucket
+    other = [l for l in lines if 'family="other"' in l]
+    assert len(other) == 1
+    # the fold carries the summed remainder, so nothing is lost
+    total = sum(int(l.rsplit(" ", 1)[1]) for l in lines)
+    assert total == sum(counts.values())
+    # top keys survive verbatim, version label rides every line
+    lines_v = bounded_counter_series("m", "family", {"a": 5}, cap=10,
+                                     extra={"version": "v1"})
+    assert lines_v == ['m{version="v1",family="a"} 5']
+
+
+def test_dbg_rules_and_drift_render():
+    from ingress_plus_tpu.control.dbg import render_drift, render_rules
+
+    stats = {"version": "v1", "requests": 10,
+             "device": {"scan_impl": "pair"},
+             "efficiency": {"padding_waste_ratio": 0.5,
+                            "dispatch_fill": 0.9,
+                            "engine_recompiles": 1},
+             "rules": [{"rule_id": 942100, "family": "942",
+                        "candidates": 5, "confirmed": 2,
+                        "confirm_errors": 0,
+                        "false_candidate_rate": 0.6, "score_sum": 10}]}
+    health = {"requests": 10,
+              "runtime_dead": [{"rule_id": 999901, "confirm_errors": 3,
+                                "reason": "regex-unparsable: boom"}],
+              "latent_dead": [],
+              "never_hit": {"count": 1, "total_rules": 2},
+              "top_false_candidates": [
+                  {"rule_id": 942100, "family": "942",
+                   "wasted_confirms": 3, "false_candidate_rate": 0.6}]}
+    out = render_rules(stats, health)
+    assert "942100" in out and "999901" in out
+    assert "runtime-dead rules (1)" in out
+    assert "regex-unparsable: boom" in out
+
+    drift = {"old_version": "a", "new_version": "b",
+             "old_requests": 4, "new_requests": 4,
+             "went_quiet": [942100],
+             "rules": [{"rule_id": 942100, "old_hit_rate": 0.5,
+                        "new_hit_rate": 0.0, "delta": -0.5,
+                        "went_quiet": True}],
+             "added_rules": [], "removed_rules": [930120]}
+    out = render_drift(drift)
+    assert "QUIET" in out and "942100" in out
+    assert "-1 rules" in out
+    assert render_drift({"note": "no swap", "rules": []}) == "no swap"

@@ -132,6 +132,19 @@ def main() -> int:
         if not stage_breakdown:
             print("WARNING: no stage_breakdown — /metrics stage "
                   "histograms missing or malformed", file=sys.stderr)
+        # detection-plane telemetry (ISSUE 3): same convention as
+        # stage_breakdown — missing is a LOUD warning, never silent
+        from ingress_plus_tpu.models.rule_stats import bench_block
+        try:
+            rule_stats = bench_block(pipeline)
+        except Exception as e:
+            rule_stats = None
+            print("WARNING: rule_stats collection raised: %r" % (e,),
+                  file=sys.stderr)
+        if not rule_stats:
+            print("WARNING: no rule_stats — per-family false-candidate "
+                  "rate and padding-waste ratio unmeasured",
+                  file=sys.stderr)
         result = {
             "config": ("BASELINE config #1: wallarm-mode=monitoring, "
                        "strict-grammar (libdetection analog) confirm in "
@@ -144,6 +157,7 @@ def main() -> int:
             "p99_us": r["p99_us"], "p999_us": r["p999_us"],
             "fail_open": r["fail_open"],
             "stage_breakdown": stage_breakdown,
+            "rule_stats": rule_stats,
             "flagged": r["attacks"],
             "blocked": r["blocked"],
             "mode": "monitoring",

@@ -3,16 +3,22 @@
     python tools/lint.py           # run everything, report, exit status
     python tools/lint.py --ci      # same + write reports/RULECHECK.json
 
-Three gates, one verdict:
+Four gates, one verdict:
 
   ruff       style/correctness lint per [tool.ruff] in pyproject.toml
              (zero-warning baseline: the selected rule set must be
              clean; new violations fail the gate)
-  mypy       targeted type check of compiler/, analysis/, serve/ per
-             [tool.mypy] in pyproject.toml
+  mypy       targeted type check of compiler/, analysis/, serve/ (+ the
+             detection-telemetry modules) per [tool.mypy] in
+             pyproject.toml
   rulecheck  the ruleset static analyzer (ingress_plus_tpu/analysis/,
              docs/ANALYSIS.md) over the bundled CRS tree: zero
              unsuppressed error-severity findings required
+  deadrules  the RUNTIME twin of rulecheck (docs/OBSERVABILITY.md,
+             detection-plane telemetry): the bench corpus runs through
+             a CPU pipeline and any runtime-dead rule (confirm regex
+             the runtime cannot evaluate) not suppressed in
+             rulecheck-baseline.json fails the gate
 
 The container policy is "no new installs": when ruff or mypy are not
 present, those gates report SKIPPED (recorded in the CI report so the
@@ -37,7 +43,9 @@ if str(REPO) not in sys.path:  # script execution puts tools/ first
 #: the mypy gate is TARGETED: the correctness-critical planes first;
 #: widen as modules gain annotations (zero-warning baseline per scope)
 MYPY_SCOPE = ["ingress_plus_tpu/compiler", "ingress_plus_tpu/analysis",
-              "ingress_plus_tpu/serve"]
+              "ingress_plus_tpu/serve",
+              "ingress_plus_tpu/models/rule_stats.py",
+              "ingress_plus_tpu/post/topk.py"]
 
 
 def _tool_available(module: str, binary: str) -> bool:
@@ -99,11 +107,61 @@ def run_rulecheck(write_report: bool) -> dict:
     return result
 
 
+def run_dead_rules() -> dict:
+    """Runtime dead-rule gate (ISSUE 3): compile the bundled pack,
+    drive the bench corpus through a CPU pipeline, and fail on any
+    runtime-dead or latent-dead rule (confirm regex the runtime cannot
+    evaluate — the runtime twin of rulecheck's
+    ``regex.confirm-unparsable``) that is not already suppressed in the
+    CRS tree's rulecheck-baseline.json.  This is the dynamic
+    counterpart of the rulecheck gate: a rule the static audit missed
+    still fails CI the moment real traffic candidates it."""
+    t0 = time.time()
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+    from ingress_plus_tpu.analysis import BUNDLED_RULES
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.utils.corpus import generate_corpus
+
+    cr = compile_ruleset(load_bundled_rules())
+    pipe = DetectionPipeline(cr, mode="monitoring")
+    reqs = [lr.request for lr in
+            generate_corpus(n=256, attack_fraction=0.2, seed=42)]
+    for i in range(0, len(reqs), 64):
+        pipe.detect(reqs[i:i + 64])
+    health = pipe.rule_stats.health()
+
+    suppressed = set()
+    baseline = BUNDLED_RULES / "rulecheck-baseline.json"
+    if baseline.exists():
+        spec = json.loads(baseline.read_text())
+        for e in spec.get("suppressions", []):
+            if e.get("check") in ("regex.confirm-unparsable",
+                                  "runtime.dead-rule"):
+                suppressed.add(e.get("rule_id"))
+    dead = [d for d in health["runtime_dead"] + health["latent_dead"]
+            if d["rule_id"] not in suppressed]
+    return {
+        "status": "FAIL" if dead else "OK",
+        "seconds": round(time.time() - t0, 2),
+        "requests": health["requests"],
+        "detail": "; ".join(
+            "rule %d dead at runtime (%s)" % (d["rule_id"], d["reason"])
+            for d in dead) or
+            "0 unsuppressed runtime-dead rules over %d corpus requests"
+            % health["requests"],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tools/lint.py")
     ap.add_argument("--ci", action="store_true",
                     help="CI mode: also write reports/RULECHECK.json")
-    ap.add_argument("--only", choices=["ruff", "mypy", "rulecheck"],
+    ap.add_argument("--only",
+                    choices=["ruff", "mypy", "rulecheck", "deadrules"],
                     default=None)
     args = ap.parse_args(argv)
 
@@ -114,6 +172,8 @@ def main(argv=None) -> int:
         gates["mypy"] = run_mypy()
     if args.only in (None, "rulecheck"):
         gates["rulecheck"] = run_rulecheck(write_report=args.ci)
+    if args.only in (None, "deadrules"):
+        gates["deadrules"] = run_dead_rules()
 
     failed = False
     for name, r in gates.items():
