@@ -885,6 +885,23 @@ def run_latency_leg(cr, scan_impl: str, platform: str,
                 % (rsb.get("false_candidate_rate"),
                    rsb.get("padding_waste_ratio"),
                    rsb.get("dispatch_fill"), rsb.get("runtime_dead")))
+        # fail-safe plane sanity (docs/ROBUSTNESS.md): the CLEAN latency
+        # leg must never shed, degrade, or trip the breaker — any of
+        # those here means the fail-safe layer is costing the happy
+        # path, which is a regression the p99 alone could hide
+        rb = {
+            "shed": dict(batcher.pipeline.stats.shed),
+            "degraded_verdicts": batcher.pipeline.stats.degraded,
+            "breaker": batcher.breaker.snapshot()["state"],
+            "breaker_trips": batcher.breaker.snapshot()["trips"],
+            "watchdog_hangs": batcher.stats.hangs,
+        }
+        lat["latency_leg"]["robustness"] = rb
+        if (rb["shed"] or rb["degraded_verdicts"]
+                or rb["breaker"] != "closed" or rb["watchdog_hangs"]):
+            log("WARNING: fail-safe plane activated on the CLEAN "
+                "latency leg (%s) — bounded admission / breaker / "
+                "brownout are interfering with the happy path" % rb)
         if platform != "cpu":
             lat["latency_leg"]["note"] = (
                 "per-dispatch verdicts cross the remote-TPU tunnel "

@@ -15,6 +15,8 @@ state.  Same idea against our HTTP plane:
         [--fail-on error]
     python -m ingress_plus_tpu.control.dbg rules    [--server host:port]
     python -m ingress_plus_tpu.control.dbg drift    [--server host:port]
+    python -m ingress_plus_tpu.control.dbg breaker  [--server host:port]
+    python -m ingress_plus_tpu.control.dbg faults   [--set 'site:times=1']
 
 ``rules`` renders the detection-plane telemetry (ISSUE 3): top rules by
 prefilter candidates with confirm outcomes and false-candidate rates
@@ -27,6 +29,12 @@ recent hot reload (``/rules/drift``), went-quiet rules flagged.
 (ISSUE 1): per-stage p50/p90/p99 from the /metrics histograms plus the
 /debug/slow exemplar ring as terminal tables; ``--sidecar`` adds the
 native sidecar's per-upstream EWMA hop timing from its --status-port.
+
+``breaker`` renders the fail-safe serve plane (docs/ROBUSTNESS.md):
+circuit-breaker state/trips, the brownout ladder rung + queue-delay
+EWMA, admission queue depth and shed counters (from ``/healthz``);
+``faults`` inspects — or with ``--set`` installs, ``--set ''``
+clears — the deterministic fault-injection plan (``/faults``).
 
 ``rulecheck`` runs the static ruleset analyzer (ISSUE 2, analysis/ —
 see docs/ANALYSIS.md) locally over a rules tree (default: the bundled
@@ -151,6 +159,59 @@ def render_rules(stats: dict, health: dict, top: int = 20) -> str:
     return "\n".join(lines)
 
 
+def render_breaker(health: dict) -> str:
+    """Terminal view for `dbg breaker`: the fail-safe plane's state
+    out of /healthz's robustness block."""
+    rb = health.get("robustness") or {}
+    if not rb:
+        return ("no robustness block in /healthz "
+                "(server predates the fail-safe serve plane?)")
+    brk = rb.get("breaker") or {}
+    lad = rb.get("ladder") or {}
+    lines = [
+        "breaker: %s  trips=%s closes=%s probes=%s  last_trip=%s"
+        % (brk.get("state", "?"), brk.get("trips"), brk.get("closes"),
+           brk.get("probes"), brk.get("last_trip_reason") or "-"),
+        "  consecutive_failures=%s/%s  cooldown_s=%s"
+        % (brk.get("consecutive_failures"), brk.get("failure_threshold"),
+           brk.get("cooldown_s")),
+        "ladder:  level=%s (%s)  queue_delay_ewma_us=%s  steps=%s up/%s "
+        "down"
+        % (lad.get("level"), lad.get("mode"),
+           lad.get("queue_delay_ewma_us"), lad.get("steps_up"),
+           lad.get("steps_down")),
+        "queue:   depth=%s/%s" % (rb.get("queue_depth"),
+                                  rb.get("queue_cap")),
+        "fallback: hangs=%s cpu_fallback_batches=%s watchdog_released=%s"
+        % (rb.get("hangs"), rb.get("cpu_fallback_batches"),
+           rb.get("watchdog_released")),
+        "degraded_verdicts=%s" % rb.get("degraded_verdicts"),
+    ]
+    shed = rb.get("shed") or {}
+    lines.append("shed:    %s"
+                 % (", ".join("%s=%d" % kv for kv in sorted(shed.items()))
+                    or "-"))
+    return "\n".join(lines)
+
+
+def render_faults(state: dict) -> str:
+    """Terminal view for `dbg faults`: the active plan + counters."""
+    if not state.get("active"):
+        return "no fault plan active"
+    plan = state.get("plan") or {}
+    lines = ["fault plan (seed=%s):" % plan.get("seed")]
+    lines.append("%-16s %7s %7s %9s %6s %9s %7s"
+                 % ("site", "after", "times", "delay_s", "prob",
+                    "arrivals", "fired"))
+    for r in plan.get("rules") or []:
+        lines.append("%-16s %7d %7s %9.3f %6.2f %9d %7d"
+                     % (r["site"], r["after"],
+                        r["times"] if r["times"] is not None else "inf",
+                        r["delay_s"], r["prob"], r["arrivals"],
+                        r["fired"]))
+    return "\n".join(lines)
+
+
 def render_drift(drift: dict, top: int = 20) -> str:
     """Terminal table for `dbg drift`: per-rule hit-rate deltas across
     the most recent hot reload, went-quiet rules first."""
@@ -186,7 +247,7 @@ def main(argv=None) -> int:
     ap.add_argument("cmd",
                     choices=["conf", "health", "metrics", "latency",
                              "tenants", "ruleset", "acl", "rulecheck",
-                             "rules", "drift"])
+                             "rules", "drift", "breaker", "faults"])
     ap.add_argument("--server", default="127.0.0.1:9901")
     ap.add_argument("--rules", default=None,
                     help="rulecheck: rules tree to analyze (default: "
@@ -222,6 +283,17 @@ def main(argv=None) -> int:
         elif args.cmd == "drift":
             out = render_drift(json.loads(_call(args.server,
                                                 "/rules/drift")))
+        elif args.cmd == "breaker":
+            out = render_breaker(json.loads(_call(args.server,
+                                                  "/healthz")))
+        elif args.cmd == "faults":
+            if args.set_json is not None:
+                # --set 'dispatch_hang:times=1' installs; --set '' clears
+                out = render_faults(json.loads(_call(
+                    args.server, "/faults", {"spec": args.set_json})))
+            else:
+                out = render_faults(json.loads(_call(args.server,
+                                                     "/faults")))
         elif args.cmd == "latency":
             metrics = _call(args.server, "/metrics")
             slow = json.loads(_call(args.server, "/debug/slow"))

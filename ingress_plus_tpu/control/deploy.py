@@ -223,6 +223,19 @@ def render_deployment(v: DeployValues) -> str:
             % (v.http_port + i),
             "            initialDelaySeconds: 30",
             "            periodSeconds: 5",
+            "          # readiness is split from liveness "
+            "(docs/ROBUSTNESS.md):",
+            "          # /readyz goes 503 while the dispatch breaker "
+            "is open or the",
+            "          # brownout ladder is above full detection, "
+            "pulling the pod",
+            "          # from rotation instead of routing traffic "
+            "into a brownout",
+            "          readinessProbe:",
+            "            httpGet: {path: /readyz, port: %d}"
+            % (v.http_port + i),
+            "            initialDelaySeconds: 10",
+            "            periodSeconds: 3",
             "          volumeMounts:",
             "            - {name: ipt-run, mountPath: /run/ipt}",
             "            - {name: ipt-rules, mountPath: /etc/ipt/rules}",

@@ -32,6 +32,7 @@ import numpy as np
 from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, N_SV
 from ingress_plus_tpu.compiler.seclang import CLASSES
 from ingress_plus_tpu.ops.scan import ScanTables, scan_bytes, scan_pairs
+from ingress_plus_tpu.utils import faults
 
 
 @jax.tree_util.register_pytree_node_class
@@ -239,8 +240,21 @@ class DetectionEngine:
             self._pallas2 = PallasPairScanner(self.tables.scan)
         return self._pallas2
 
+    def drop_compiled(self) -> None:
+        """Forget every compiled executable (the recompile_storm fault
+        site's hammer; also useful to measure cold-dispatch cost) —
+        subsequent dispatches pay fresh XLA compiles."""
+        jax.clear_caches()
+        self._pallas = None
+        self._pallas2 = None
+
     def _rule_hits_device(self, tokens, lengths, row_req, row_sv,
                           num_requests: int):
+        # fault-injection sites (utils/faults.py): a wedged device is a
+        # sleep here (the batcher's dispatch watchdog must catch it), a
+        # crashed dispatch is a raise (the breaker must count it)
+        faults.sleep_if("dispatch_hang")
+        faults.raise_if("dispatch_raise")
         tokens = jnp.asarray(tokens)
         lengths = jnp.asarray(lengths)
         row_req = jnp.asarray(row_req)

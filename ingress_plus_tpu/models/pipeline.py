@@ -28,6 +28,8 @@ from ingress_plus_tpu.models.acl import AclStore
 from ingress_plus_tpu.models.confirm import ConfirmRule, parse_exclusion_token
 from ingress_plus_tpu.models.engine import DetectionEngine
 from ingress_plus_tpu.models.rule_stats import RuleStats
+from ingress_plus_tpu.utils import faults
+from ingress_plus_tpu.utils.trace import Ewma
 
 #: wallarm_mode precedence (weakest → strongest).  Wire values (frame
 #: mode bits 0-1) are historical — safe_blocking arrived round 4 as
@@ -53,6 +55,9 @@ class Verdict:
     rule_ids: List[int]
     score: int
     fail_open: bool = False
+    #: served under brownout (prefilter-only ladder rung or admission
+    #: shed): the verdict is best-effort — degraded verdicts never block
+    degraded: bool = False
     elapsed_us: int = 0
     #: matched points for the attack export (wallarm "points" analog):
     #: up to 8 dicts {rule_id, var, value} — var is the SecLang variable
@@ -70,6 +75,12 @@ class PipelineStats:
     truncated_rows: int = 0
     fail_open: int = 0
     batches: int = 0
+    #: requests shed fail-open at admission, keyed by reason
+    #: ("queue_full", "deadline", "brownout", "stream_overload",
+    #: "watchdog", "shutdown") — /metrics ipt_shed_total{reason=}
+    shed: Dict[str, int] = field(default_factory=dict)
+    #: verdicts served degraded (brownout ladder above full detection)
+    degraded: int = 0
     #: host prep: normalize/unpack/row build+merge, before any device
     #: dispatch (the "prep" stage of the latency-attribution histograms)
     prep_us: int = 0
@@ -92,6 +103,11 @@ class PipelineStats:
     bucket_rows: Dict[int, int] = field(default_factory=dict)
     bucket_padded_rows: Dict[int, int] = field(default_factory=dict)
 
+    def count_shed(self, reason: str) -> None:
+        """One admission shed (dict ops are GIL-atomic enough for the
+        single-writer submit path; readers snapshot with dict())."""
+        self.shed[reason] = self.shed.get(reason, 0) + 1
+
     def reset_efficiency(self) -> None:
         """Zero the resettable device-efficiency group only (the
         cumulative counters keep their Prometheus contract)."""
@@ -102,6 +118,99 @@ class PipelineStats:
         self.engine_compiles = 0
         self.bucket_rows = {}
         self.bucket_padded_rows = {}
+
+
+#: brownout ladder rungs (LoadController.level indexes this):
+#: full detection → prefilter-only (skip the confirm lane; verdicts
+#: flagged degraded, never blocking) → fail-open (no scan at all)
+BROWNOUT_LEVELS = ("full", "prefilter_only", "fail_open")
+
+
+class LoadController:
+    """Brownout degradation ladder (docs/ROBUSTNESS.md).
+
+    Input: per-cycle queue delay (the batcher feeds the oldest queued
+    request's wait each dispatch, and zero on idle drains) smoothed by
+    an EWMA.  Output: ``level`` —
+
+      0  full detection (scan + confirm)
+      1  prefilter-only: confirm lane skipped, verdicts scored from the
+         sound prefilter candidates, flagged ``degraded`` and never
+         blocking (accuracy-for-throughput, the Approximate-Reduction
+         trade from PAPERS.md: a sound approximate verdict beats none)
+      2  fail-open: requests pass unscanned (the wallarm-fallback floor)
+
+    Steps UP one rung once the EWMA has stayed above the level's
+    threshold for ``up_confirm_s`` (a short confirmation window: a
+    cold-start backlog draining for a few hundred ms must not brown
+    out the node, sustained overload still escalates within a second);
+    steps DOWN one rung only after the signal has fallen below
+    ``down_factor`` x the threshold AND ``dwell_s`` has passed since
+    the last change — the hysteresis that keeps the ladder from
+    flapping at a threshold boundary.
+
+    Single-writer (the batcher's dispatch thread calls ``observe``);
+    ``level`` reads are torn-free ints."""
+
+    def __init__(self, up_us: tuple = (62_500, 150_000),
+                 down_factor: float = 0.5, dwell_s: float = 2.0,
+                 alpha: float = 0.2, up_confirm_s: float = 0.5):
+        self.up_us = tuple(up_us)
+        self.down_factor = down_factor
+        self.dwell_s = dwell_s
+        self.up_confirm_s = up_confirm_s
+        self.ewma = Ewma(alpha)
+        self.level = 0
+        self.steps_up = 0
+        self.steps_down = 0
+        self._last_change = 0.0
+        self._over_since: Optional[float] = None
+        # per-observation cap: a SINGLE seconds-long stall (post-compile
+        # backlog, GC pause) must not catapult the EWMA over every
+        # threshold — capped, one spike moves the signal at most
+        # alpha x cap, so only SUSTAINED pressure climbs the ladder
+        self.obs_cap_us = 2.0 * self.up_us[-1]
+
+    def configure_deadline(self, hard_deadline_s: float) -> None:
+        """Derive the rung thresholds from the serve deadline: step to
+        prefilter-only at 25% of the deadline spent queueing, to
+        fail-open at 60% — admission-time shedding handles the rest."""
+        hd_us = hard_deadline_s * 1e6
+        self.up_us = (0.25 * hd_us, 0.60 * hd_us)
+        self.obs_cap_us = 2.0 * self.up_us[-1]
+
+    def observe(self, queue_delay_us: float,
+                now: Optional[float] = None) -> int:
+        now = time.monotonic() if now is None else now
+        v = self.ewma.update(min(queue_delay_us, self.obs_cap_us))
+        if self.level < len(self.up_us) and v > self.up_us[self.level]:
+            if self._over_since is None:
+                self._over_since = now
+            if now - self._over_since >= self.up_confirm_s:
+                self.level += 1
+                self.steps_up += 1
+                self._last_change = now
+                self._over_since = now   # next rung needs its own window
+        else:
+            self._over_since = None
+            if (self.level > 0
+                    and v < self.up_us[self.level - 1] * self.down_factor
+                    and now - self._last_change >= self.dwell_s):
+                self.level -= 1
+                self.steps_down += 1
+                self._last_change = now
+        return self.level
+
+    def snapshot(self) -> dict:
+        return {
+            "level": self.level,
+            "mode": BROWNOUT_LEVELS[self.level],
+            "queue_delay_ewma_us": round(self.ewma.get(), 1),
+            "up_thresholds_us": [round(u, 1) for u in self.up_us],
+            "dwell_s": self.dwell_s,
+            "steps_up": self.steps_up,
+            "steps_down": self.steps_down,
+        }
 
 
 class DetectionPipeline:
@@ -157,6 +266,11 @@ class DetectionPipeline:
             paranoia_level = getattr(ruleset, "paranoia_hint", None) or 2
         self.fail_open = fail_open
         self.stats = PipelineStats()
+        # brownout ladder (docs/ROBUSTNESS.md): the serve batcher feeds
+        # queue-delay observations and detect() consults the level; a
+        # hot-swap carries the controller over with the stats object so
+        # a reload under pressure doesn't reset the ladder
+        self.load_controller = LoadController()
         self.tenant_rule_mask = tenant_rule_mask
         # (B, L, Q_pad) engine shapes served so far — a replacement
         # pipeline warms exactly these before it is swapped in
@@ -219,6 +333,9 @@ class DetectionPipeline:
                      paranoia_level: Optional[int] = None) -> None:
         """Hot-swap (proton.db sync-node analog): atomic from the caller's
         perspective — in-flight batches finish on the old tables."""
+        # swap_fail site BEFORE any mutation: a failed swap must leave
+        # the serving generation untouched (fault-matrix invariant)
+        faults.raise_if("swap_fail")
         self.engine.swap_ruleset(ruleset)
         if paranoia_level is None:   # same precedence as __init__
             paranoia_level = getattr(ruleset, "paranoia_hint", None) or 2
@@ -268,10 +385,100 @@ class DetectionPipeline:
                 for r in requests
             ]
 
+    def detect_strict(self, requests: Sequence[Request]) -> List[Verdict]:
+        """``detect`` minus the fail-open catch: the serve batcher uses
+        this so its circuit breaker can COUNT device failures before
+        producing the fail-open verdicts itself — library callers keep
+        ``detect``'s swallow-and-flag contract."""
+        t0 = time.perf_counter()
+        requests = list(requests)
+        if not requests:
+            return []
+        return self._detect_inner(requests, t0)
+
+    def detect_cpu_only(self, requests: Sequence[Request]) -> List[Verdict]:
+        """Breaker-open fallback (docs/ROBUSTNESS.md): exact confirm
+        semantics with ZERO device dispatch — every masked (request,
+        rule) pair becomes a confirm candidate.  Sound because the
+        prefilter only ever narrows; slower because the confirm lane
+        does the narrowing work itself, which is exactly the trade a
+        dead device leaves us."""
+        t0 = time.perf_counter()
+        requests = list(requests)
+        if not requests:
+            return []
+        try:
+            self.stats.requests += len(requests)
+            self.stats.batches += 1
+            hits = np.ones((len(requests), self.ruleset.n_rules),
+                           dtype=bool)
+            # observe_rules=False: the synthetic all-ones candidate
+            # matrix would otherwise swamp the per-rule false-candidate
+            # ranking (/rules/health) for the whole breaker-open window
+            return self.finalize(requests, self.mask_hits(requests, hits),
+                                 t0, observe_rules=False)
+        except Exception:
+            if not self.fail_open:
+                raise
+            self.stats.fail_open += len(requests)
+            return [
+                Verdict(request_id=r.request_id, blocked=False, attack=False,
+                        classes=[], rule_ids=[], score=0, fail_open=True)
+                for r in requests
+            ]
+
     def _detect_inner(self, requests: List[Request], t0: float) -> List[Verdict]:
         self.stats.requests += len(requests)
         self.stats.batches += 1
-        return self.finalize(requests, self.prefilter(requests), t0)
+        level = self.load_controller.level
+        if level >= 2:
+            # brownout floor for requests already queued before the
+            # ladder reached fail-open (admission sheds new arrivals):
+            # pass + flag, no scan work at all
+            self.stats.fail_open += len(requests)
+            self.stats.degraded += len(requests)
+            return [
+                Verdict(request_id=r.request_id, blocked=False, attack=False,
+                        classes=[], rule_ids=[], score=0, fail_open=True,
+                        degraded=True)
+                for r in requests
+            ]
+        hits = self.prefilter(requests)
+        if level == 1:
+            return self._finalize_prefilter_only(requests, hits, t0)
+        return self.finalize(requests, hits, t0)
+
+    def _finalize_prefilter_only(self, requests: List[Request],
+                                 rule_hits: np.ndarray,
+                                 t0: float) -> List[Verdict]:
+        """Brownout rung 1: score straight from the sound prefilter
+        candidates — the confirm lane (the serve plane's dominant CPU
+        cost) is skipped.  Candidates over-approximate confirmed hits,
+        so degraded verdicts FLAG but never BLOCK (fail-open bias: an
+        unconfirmed candidate must not 403 a legitimate request)."""
+        rs = self.ruleset
+        verdicts: List[Verdict] = []
+        for qi, req in enumerate(requests):
+            cand = [int(r) for r in np.nonzero(rule_hits[qi])[0]
+                    if int(r) not in self._ctl_pass_idx]
+            score = int(rs.rule_score[cand].sum()) if cand else 0
+            verdicts.append(Verdict(
+                request_id=req.request_id,
+                blocked=False,
+                attack=bool(cand) and score >= self.anomaly_threshold,
+                classes=sorted({CLASSES[rs.rule_class[r]] for r in cand}),
+                rule_ids=[int(rs.rule_ids[r]) for r in cand[:32]],
+                score=score,
+                degraded=True,
+            ))
+        # candidates still feed the per-rule telemetry (nothing
+        # confirmed — an honest zero, not a gap); confirm_us untouched
+        self.rule_stats.observe_finalize(rule_hits[:len(requests)], [], [])
+        self.stats.degraded += len(requests)
+        elapsed = int((time.perf_counter() - t0) * 1e6)
+        for v in verdicts:
+            v.elapsed_us = elapsed
+        return verdicts
 
     def prefilter(self, requests: List[Request]) -> np.ndarray:
         """Scan stage: requests → masked (Q, R) prefilter rule hits.
@@ -279,6 +486,12 @@ class DetectionPipeline:
         can scan a body-less request now and OR in chunk-carried body
         hits at stream end."""
         tp0 = time.perf_counter()
+        if faults.fire("recompile_storm"):
+            # injected executable loss: forget every warm shape and drop
+            # the compiled programs — the following dispatches pay
+            # serve-time compiles (ipt_engine_recompiles_total)
+            self.seen_shapes.clear()
+            self.engine.drop_compiled()
         rows = rows_for_requests(requests, needed_sv=self.needed_sv)
         data_list, req_list, sv_list = merge_rows(rows)
         Q = len(requests)
@@ -361,11 +574,15 @@ class DetectionPipeline:
         return rule_hits & self.paranoia_mask[None, :]
 
     def finalize(self, requests: List[Request], rule_hits: np.ndarray,
-                 t0: float) -> List[Verdict]:
-        """Confirm + scoring stage on already-masked prefilter hits."""
+                 t0: float, observe_rules: bool = True) -> List[Verdict]:
+        """Confirm + scoring stage on already-masked prefilter hits.
+        ``observe_rules=False`` skips the per-rule telemetry fold —
+        the CPU-fallback path passes a synthetic full candidate matrix
+        that must not book as prefilter statistics."""
         stats = self.stats
         # CPU confirm: exact semantics, only on (request, rule) hits
         tc0 = time.perf_counter()
+        faults.sleep_if("slow_confirm")
         verdicts: List[Verdict] = []
         rs = self.ruleset
         # per-rule telemetry accumulators for this batch (folded into
@@ -468,16 +685,17 @@ class DetectionPipeline:
             ))
             all_confirmed.extend(confirmed)
             all_blocked.extend([blocked] * len(confirmed))
-        cand_hits = rule_hits[:len(requests)]
-        if excl_rows:
-            # copy only when a runtime ctl exclusion actually matched
-            # (rare); ctl-pass config rules are suppressed inside
-            # observe_finalize via the RuleStats.ignored mask
-            cand_hits = cand_hits.copy()
-            for qi, ex in excl_rows:
-                cand_hits[qi, ex] = False
-        self.rule_stats.observe_finalize(
-            cand_hits, all_confirmed, all_blocked)
+        if observe_rules:
+            cand_hits = rule_hits[:len(requests)]
+            if excl_rows:
+                # copy only when a runtime ctl exclusion actually
+                # matched (rare); ctl-pass config rules are suppressed
+                # inside observe_finalize via the RuleStats.ignored mask
+                cand_hits = cand_hits.copy()
+                for qi, ex in excl_rows:
+                    cand_hits[qi, ex] = False
+            self.rule_stats.observe_finalize(
+                cand_hits, all_confirmed, all_blocked)
         stats.confirm_us += int((time.perf_counter() - tc0) * 1e6)
         stats.confirmed_rule_hits += sum(len(v.rule_ids) for v in verdicts)
 

@@ -126,6 +126,15 @@ class MeshEngine:
         self._sharded = ShardedEngine(cr, self.mesh,
                                       scan_impl=self.scan_impl)
 
+    def drop_compiled(self) -> None:
+        """Engine-API twin of DetectionEngine.drop_compiled (the
+        recompile_storm fault site calls it on whatever engine serves):
+        forget every compiled executable."""
+        import jax
+
+        jax.clear_caches()
+        self._tables = None
+
     def rebuilt(self, cr: CompiledRuleset) -> "MeshEngine":
         """Fresh engine of the SAME kind on a new ruleset (batcher
         hot-swap contract — see DetectionEngine.rebuilt)."""

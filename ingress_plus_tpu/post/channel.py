@@ -91,7 +91,14 @@ class PostChannel:
         d["queue"] = {"depth": len(self.queue), "dropped": self.queue.dropped,
                       "total": self.queue.total}
         d["export"] = {"attacks": self.exporter.exported_attacks,
-                       "errors": self.exporter.export_errors}
+                       "errors": self.exporter.export_errors,
+                       "consecutive_failures":
+                           self.exporter.consecutive_failures,
+                       "backoff_s": round(self.exporter.backoff_s, 3),
+                       "spool_dropped_files":
+                           self.exporter.spool_dropped_files,
+                       "spool_dropped_bytes":
+                           self.exporter.spool_dropped_bytes}
         d["top_attacked"] = {
             "paths": self.top_paths.items(10),
             "tenants": self.top_tenants.items(10),

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 import urllib.request
@@ -29,6 +30,7 @@ from typing import Callable, List, Optional
 from ingress_plus_tpu.post.aggregate import aggregate_attacks
 from ingress_plus_tpu.post.brute import BruteDetector
 from ingress_plus_tpu.post.queue import HitQueue
+from ingress_plus_tpu.utils import faults
 
 
 class Exporter:
@@ -42,6 +44,9 @@ class Exporter:
         brute: Optional[BruteDetector] = None,
         max_drain: int = 100_000,
         on_export: Optional[Callable[[List[dict]], None]] = None,
+        backoff_max_s: float = 300.0,
+        max_spool_bytes: int = 256 << 20,
+        jitter_seed: int = 0,
     ):
         self.queue = queue
         self.spool_dir = Path(spool_dir) if spool_dir else None
@@ -55,6 +60,20 @@ class Exporter:
         self.on_export = on_export
         self.exported_attacks = 0
         self.export_errors = 0
+        # failure backoff (docs/ROBUSTNESS.md): a down collector used to
+        # be re-hit on the fixed interval forever — retries now back off
+        # exponentially with jitter up to a ceiling, and delivery
+        # success snaps back to the base interval
+        self.backoff_max_s = backoff_max_s
+        self.consecutive_failures = 0
+        self.backoff_s = 0.0   # the currently applied backoff (status)
+        self._rng = random.Random(jitter_seed)
+        # spool bound: a long collector outage must not fill the disk —
+        # oldest spool files are dropped (and counted) to fit the cap
+        self.max_spool_bytes = max_spool_bytes
+        self.spool_dropped_files = 0
+        self.spool_dropped_bytes = 0
+        self.spool_dropped_records = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if self.spool_dir:
@@ -75,6 +94,7 @@ class Exporter:
         records = [a.to_dict() for a in attacks]
         ok = self._deliver(records)
         if ok:
+            self.consecutive_failures = 0
             self.exported_attacks += len(records)
             if self.on_export is not None:
                 try:
@@ -83,7 +103,54 @@ class Exporter:
                     pass   # counters are best-effort, never break export
             return len(records)
         self.export_errors += 1
+        self.consecutive_failures += 1
         return 0
+
+    def next_wait_s(self) -> float:
+        """Sleep until the next export attempt: the base interval while
+        healthy; exponential backoff with jitter (x[1.0, 1.5)) and a
+        hard ceiling after consecutive delivery failures — a down
+        collector is probed ever more gently, and the jitter keeps a
+        fleet of nodes from re-hitting it in lockstep."""
+        if not self.consecutive_failures:
+            return self.interval_s
+        base = min(self.interval_s * (2 ** (self.consecutive_failures - 1)),
+                   self.backoff_max_s)
+        return min(base * (1.0 + 0.5 * self._rng.random()),
+                   self.backoff_max_s)
+
+    def _enforce_spool_bound(self, incoming: int, keep: Path) -> bool:
+        """Drop-oldest spool files until ``incoming`` more bytes fit
+        under ``max_spool_bytes`` (the current writer's own file is
+        dropped last).  False = the batch cannot fit even after
+        dropping everything else — the caller skips the write and
+        counts the records."""
+        if self.max_spool_bytes <= 0 or self.spool_dir is None:
+            return True
+        try:
+            files = []
+            for f in self.spool_dir.glob("attacks*"):
+                if f.is_file():
+                    st = f.stat()
+                    files.append((st.st_mtime, st.st_size, f))
+        except OSError:
+            return True
+        total = sum(sz for _, sz, _ in files)
+        if total + incoming <= self.max_spool_bytes:
+            return True
+        # oldest first; the live file we are about to append to goes last
+        files.sort(key=lambda t: (t[2] == keep, t[0]))
+        for _, sz, f in files:
+            if total + incoming <= self.max_spool_bytes:
+                break
+            try:
+                f.unlink()
+            except OSError:
+                continue
+            total -= sz
+            self.spool_dropped_files += 1
+            self.spool_dropped_bytes += sz
+        return total + incoming <= self.max_spool_bytes
 
     def _deliver(self, records: List[dict]) -> bool:
         delivered = False
@@ -95,14 +162,22 @@ class Exporter:
                 # buffered appends and tear lines.  Keyed by pid there is
                 # exactly one writer per file.
                 path = self.spool_dir / ("attacks.%d.jsonl" % os.getpid())
-                with path.open("a") as f:
-                    for r in records:
-                        f.write(json.dumps(r) + "\n")
-                delivered = True
+                payload = "".join(json.dumps(r) + "\n" for r in records)
+                if self._enforce_spool_bound(len(payload), path):
+                    with path.open("a") as f:
+                        f.write(payload)
+                    delivered = True
+                else:
+                    # the batch alone exceeds the bound: counted loss,
+                    # never unbounded disk
+                    self.spool_dropped_records += len(records)
             except OSError:
                 pass
         if self.http_url:
             try:
+                # export_5xx fault site (utils/faults.py): a collector
+                # answering 5xx raises exactly like a dead one
+                faults.raise_if("export_5xx")
                 req = urllib.request.Request(
                     self.http_url, data=json.dumps(records).encode(),
                     headers={"Content-Type": "application/json"})
@@ -124,11 +199,15 @@ class Exporter:
         self._thread.start()
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        wait = self.interval_s
+        while not self._stop.wait(wait):
             try:
                 self.flush_once()
             except Exception:
                 self.export_errors += 1
+                self.consecutive_failures += 1
+            wait = self.next_wait_s()
+            self.backoff_s = wait if self.consecutive_failures else 0.0
 
     def close(self) -> None:
         self._stop.set()

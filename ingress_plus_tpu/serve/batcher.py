@@ -12,6 +12,15 @@ thread never sleeps while work is pending.
 
 Fail-open (wallarm-fallback): pipeline errors or a dispatch deadline
 overrun produce pass-and-flag verdicts, never dropped requests.
+
+Fail-safe plane (docs/ROBUSTNESS.md): admission is BOUNDED — the main
+queue has a cap and requests that queue math says would miss
+``hard_deadline_s`` are shed fail-open at enqueue, before any device
+time is spent on them; the device dispatch runs on a watchdogged lane
+with a hang budget backed by a circuit breaker (open = CPU confirm-only
+fallback, half-open = single canary batches); and a monitor thread
+backstops the dispatch thread itself.  Every path keeps the one
+invariant: an admitted request resolves to exactly one verdict.
 """
 
 from __future__ import annotations
@@ -21,15 +30,17 @@ import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field, replace
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from ingress_plus_tpu.models.pipeline import DetectionPipeline, Verdict
 from ingress_plus_tpu.serve.normalize import Request
 from ingress_plus_tpu.serve.stream import StreamEngine, StreamState
 from ingress_plus_tpu.serve.unpack import GZIP_MAGIC, unpack_body
+from ingress_plus_tpu.utils import faults
 from ingress_plus_tpu.utils.trace import (
     STAGES,
     BatchTrace,
+    Ewma,
     Histogram,
     SlowRing,
     TraceRing,
@@ -51,6 +62,153 @@ def _safe_set(fut: "Future", value) -> None:
         pass
 
 
+def _fail_open_verdict(request_id: str) -> Verdict:
+    return Verdict(request_id=request_id, blocked=False, attack=False,
+                   classes=[], rule_ids=[], score=0, fail_open=True)
+
+
+class DeviceHang(Exception):
+    """A device-lane call exceeded the hang budget."""
+
+
+class _DeviceLane:
+    """Single-worker executor for the device dispatch, so the dispatch
+    thread can bound its wait (``call(fn, timeout)``): a wedged XLA
+    dispatch times out instead of head-of-line-blocking every tenant.
+
+    On timeout the lane is ABANDONED — Python cannot kill a thread
+    stuck in native code, so the batcher replaces the lane and the
+    zombie worker (at most one per hang) exits when/if the stuck call
+    returns.  A zombie that un-sticks may still mutate pipeline
+    telemetry counters concurrently with live traffic — bounded noise
+    in observability, never in verdicts (its batch's futures were
+    already resolved fail-open, and ``_safe_set`` tolerates the late
+    duplicate set)."""
+
+    def __init__(self, seq: int = 0):
+        self.seq = seq
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ipt-device-%d" % seq)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, box, ev = item
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — relayed to the caller
+                box["error"] = e
+            ev.set()
+
+    def call(self, fn: Callable, timeout: float):
+        box: dict = {}
+        ev = threading.Event()
+        self._q.put((fn, box, ev))
+        if not ev.wait(timeout):
+            self._q.put(None)   # the worker exits if it ever un-sticks
+            raise DeviceHang("device dispatch exceeded %.3fs" % timeout)
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def close(self, timeout: float = 2.0) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=timeout)
+
+
+class CircuitBreaker:
+    """Device-path circuit breaker (docs/ROBUSTNESS.md).
+
+    closed → open on a dispatch HANG (immediate: a wedged device does
+    not get ``failure_threshold`` more batches to wedge) or on
+    ``failure_threshold`` consecutive dispatch errors; open → half_open
+    once ``cooldown_s`` has passed; half_open routes a SINGLE canary
+    batch to the device — success closes the breaker, another
+    failure/hang re-opens it and restarts the cooldown.  While open,
+    the batcher serves through the CPU confirm-only fallback."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failure_threshold: int = 3,
+                 cooldown_s: float = 5.0):
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self.state = self.CLOSED
+        self.failures = 0           # consecutive, reset on success
+        self.trips = 0
+        self.closes = 0
+        self.probes = 0
+        self.last_trip_reason: Optional[str] = None
+        self._opened_at = 0.0
+        self._lock = threading.Lock()
+
+    def route(self) -> str:
+        """Where this batch goes: "device" | "canary" | "fallback"."""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return "device"
+            if self.state == self.OPEN:
+                if time.monotonic() - self._opened_at < self.cooldown_s:
+                    return "fallback"
+                self.state = self.HALF_OPEN
+                self.probes += 1
+            return "canary"
+
+    def trip(self, reason: str) -> None:
+        with self._lock:
+            self._trip_locked(reason)
+
+    def _trip_locked(self, reason: str) -> None:
+        self.state = self.OPEN
+        self._opened_at = time.monotonic()
+        self.trips += 1
+        self.failures = 0
+        self.last_trip_reason = reason
+
+    def record_failure(self, reason: str = "dispatch_error") -> None:
+        with self._lock:
+            if self.state == self.HALF_OPEN:
+                self._trip_locked("canary_" + reason)
+                return
+            self.failures += 1
+            if self.state == self.CLOSED \
+                    and self.failures >= self.failure_threshold:
+                self._trip_locked(reason)
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.failures = 0
+            if self.state == self.HALF_OPEN:
+                self.state = self.CLOSED
+                self.closes += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "consecutive_failures": self.failures,
+                "failure_threshold": self.failure_threshold,
+                "cooldown_s": self.cooldown_s,
+                "trips": self.trips,
+                "closes": self.closes,
+                "probes": self.probes,
+                "last_trip_reason": self.last_trip_reason,
+                # the OPEN->HALF_OPEN transition only happens on the
+                # next batch (route()); probe_due tells traffic-less
+                # consumers (/readyz) that the cooldown has elapsed and
+                # the breaker WANTS a canary — readiness must come back
+                # so the canary can arrive, or an out-of-rotation pod
+                # would stay unready forever
+                "probe_due": (self.state == self.OPEN
+                              and time.monotonic() - self._opened_at
+                              >= self.cooldown_s),
+            }
+
+
 @dataclass
 class BatcherStats:
     submitted: int = 0
@@ -70,6 +228,10 @@ class BatcherStats:
     # non-streamed requests whose body exceeded the batched L tiers and
     # was auto-routed through the stream engine
     oversized_rerouted: int = 0
+    # fail-safe plane (docs/ROBUSTNESS.md)
+    hangs: int = 0                 # device-lane hang-budget overruns
+    cpu_fallback_batches: int = 0  # batches served breaker-open (CPU)
+    watchdog_released: int = 0     # futures force-released by the monitor
 
     def snapshot(self) -> dict:
         d = self.__dict__.copy()
@@ -96,12 +258,22 @@ class Batcher:
         max_batch: int = 256,
         max_delay_s: float = 0.0005,
         hard_deadline_s: float = 0.25,
+        queue_cap: int = 8192,
+        hang_budget_s: float = 30.0,
+        breaker_failures: int = 3,
+        breaker_cooldown_s: float = 5.0,
     ):
         self.pipeline = pipeline
         self.stream_engine = StreamEngine(pipeline)
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
         self.hard_deadline_s = hard_deadline_s
+        self.queue_cap = queue_cap
+        # hang budget: generous by default — a cold first dispatch pays
+        # a multi-second XLA compile on an unwarmed pipeline, and a
+        # false hang would trip the breaker on startup.  Serving with
+        # warmup can afford a much tighter budget (--hang-budget-ms).
+        self.hang_budget_s = hang_budget_s
         self.stats = BatcherStats()
         # per-batch span records for /traces (SURVEY.md §5 tracing)
         self.traces = TraceRing()
@@ -111,9 +283,29 @@ class Batcher:
         self.hist: dict = {s: Histogram() for s in STAGES}
         self.batch_size_hist = Histogram(bounds=BATCH_SIZE_BUCKETS)
         self.slow = SlowRing(capacity=32)
-        self._q: "queue.Queue" = queue.Queue()
+        # fail-safe plane (docs/ROBUSTNESS.md): BOUNDED admission queue,
+        # per-cycle service-time EWMA (the queue math deadline shedding
+        # divides by), brownout ladder thresholds derived from the serve
+        # deadline, watchdogged device lane + circuit breaker, and a
+        # monitor thread backstopping the dispatch thread itself
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_cap)
+        self._batch_ewma = Ewma(alpha=0.2)
+        self._batch_ewma_n = 0   # samples seen; shedding needs a floor
+        self.pipeline.load_controller.configure_deadline(hard_deadline_s)
+        self.breaker = CircuitBreaker(failure_threshold=breaker_failures,
+                                      cooldown_s=breaker_cooldown_s)
+        self._lane = _DeviceLane()
+        # (release_deadline, [(request_id, future), ...]) of the cycle
+        # the dispatch thread is currently running, or None between
+        # cycles — the monitor releases it fail-open when the dispatch
+        # thread itself wedges (grace >> the lane's own hang budget)
+        self._cycle_guard: Optional[tuple] = None
+        self._watch_grace = 2.0 * hang_budget_s + hard_deadline_s + 1.0
         self._stop = threading.Event()
         self._swap_lock = threading.Lock()
+        self._watchdog = threading.Thread(target=self._watch, daemon=True,
+                                          name="ipt-watchdog")
+        self._watchdog.start()
         # oversized-body side lane (round-2 advisor: a 16MB inflate+scan
         # inline under the swap lock head-of-line-blocked every queued
         # request in that batch cycle).  Bounded: a flood of oversized
@@ -141,10 +333,57 @@ class Batcher:
         self.slow.reset()
         self.pipeline.reset_detection_observations()
 
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def _est_wait_s(self, depth: int) -> float:
+        """Queue math for admission-time deadline shedding: batches
+        ahead of a new arrival x the EWMA cycle time, plus one cycle
+        for the dispatch already in flight.  Zero until the estimator
+        has a sample floor — never shed on a cold (or nearly cold,
+        first-cycle-seeded) estimator."""
+        if self._batch_ewma_n < 8:
+            return 0.0
+        per_batch = self._batch_ewma.get(0.0)
+        if per_batch <= 0.0:
+            return 0.0
+        batches_ahead = (depth + self.max_batch - 1) // self.max_batch
+        return (batches_ahead + 1) * per_batch
+
+    def _shed(self, request: Request, fut: "Future[Verdict]",
+              reason: str) -> "Future[Verdict]":
+        """Fail a request open AT ADMISSION (no queue slot, no device
+        time): the wallarm-fallback answer to overload — detection
+        degrades, traffic does not.  Shed verdicts carry
+        ``degraded=True`` and count in stats.degraded alongside the
+        ladder's verdicts (Verdict.degraded contract)."""
+        st = self.pipeline.stats
+        st.fail_open += 1
+        st.degraded += 1
+        st.count_shed(reason)
+        v = _fail_open_verdict(request.request_id)
+        v.degraded = True
+        _safe_set(fut, v)
+        return fut
+
     def submit(self, request: Request) -> "Future[Verdict]":
         fut: "Future[Verdict]" = Future()
         self.stats.submitted += 1
-        self._q.put(("req", time.perf_counter(), request, fut))
+        lc = self.pipeline.load_controller
+        if lc.level >= 2:
+            # brownout floor: the ladder already decided no scan work
+            # is affordable — don't even take a queue slot
+            return self._shed(request, fut, "brownout")
+        depth = self._q.qsize()
+        if depth and self._est_wait_s(depth) > self.hard_deadline_s:
+            # would miss the deadline by queue math: shed NOW, not
+            # after wasting a dispatch slot on a verdict nobody waits
+            # for (the client side has long since failed open)
+            return self._shed(request, fut, "deadline")
+        try:
+            self._q.put_nowait(("req", time.perf_counter(), request, fut))
+        except queue.Full:
+            return self._shed(request, fut, "queue_full")
         return fut
 
     # ------------------------------------------- oversized-body reroute
@@ -215,6 +454,12 @@ class Batcher:
         in-flight wire streams."""
         kind, body, headers = plan
         self.stats.oversized_rerouted += 1
+        if self.breaker.state != CircuitBreaker.CLOSED:
+            # the scan plane is dead/suspect: an oversized inflate+scan
+            # against it would wedge THIS worker too — fail open now
+            self.pipeline.stats.fail_open += 1
+            _safe_set(fut, _fail_open_verdict(request.request_id))
+            return
         try:
             if kind == "unpack":
                 # full DoS-bounded inflate + extraction, OFF the lock;
@@ -256,17 +501,43 @@ class Batcher:
         now (prefilter), body arrives via feed_chunk."""
         handle = self.stream_engine.begin(request)
         self.stats.streams += 1
-        self._q.put(("begin", time.perf_counter(), handle, None))
+        try:
+            self._q.put_nowait(("begin", time.perf_counter(), handle, None))
+        except queue.Full:
+            # bounded admission for streams too: a lost begin means the
+            # prefilter never ran — poison the handle so finish resolves
+            # fail-open (exactly-one-verdict invariant, no blocking put
+            # on the event-loop thread)
+            handle.error = True
+            self.pipeline.stats.count_shed("stream_overload")
         return handle
 
     def feed_chunk(self, handle: StreamState, data: bytes) -> None:
         self.stats.stream_chunks += 1
         self.stats.stream_bytes += len(data)
-        self._q.put(("chunk", time.perf_counter(), (handle, data), None))
+        if handle.error:
+            return
+        try:
+            self._q.put_nowait(("chunk", time.perf_counter(),
+                                (handle, data), None))
+        except queue.Full:
+            # a dropped chunk would silently unscan part of the body:
+            # poison instead, surface as fail-open at finish
+            handle.error = True
+            self.pipeline.stats.count_shed("stream_overload")
 
     def finish_stream(self, handle: StreamState) -> "Future[Verdict]":
         fut: "Future[Verdict]" = Future()
-        self._q.put(("finish", time.perf_counter(), handle, fut))
+        try:
+            self._q.put_nowait(("finish", time.perf_counter(), handle, fut))
+        except queue.Full:
+            st = self.pipeline.stats
+            st.fail_open += 1
+            st.degraded += 1
+            st.count_shed("stream_overload")
+            v = _fail_open_verdict(handle.request.request_id)
+            v.degraded = True
+            _safe_set(fut, v)
         return fut
 
     def abort_stream(self, handle: StreamState) -> None:
@@ -285,6 +556,9 @@ class Batcher:
            ``detect``): install the new pipeline after the in-flight
            batch finishes, re-deriving tenant masks against the new rule
            axis so EP routing survives the swap."""
+        # swap_fail site BEFORE any build/mutation (fault-matrix
+        # invariant: a failed swap leaves the serving generation intact)
+        faults.raise_if("swap_fail")
         old = self.pipeline
         # rebuilt(): same engine KIND on the new ruleset, so a
         # mesh-backed engine (parallel/serve_mesh) survives the swap
@@ -296,6 +570,9 @@ class Batcher:
         for shape in sorted(getattr(old, "seen_shapes", ())):
             new.warm_shape(*shape)
         new.stats = old.stats  # counters span swaps (Prometheus contract)
+        # the brownout ladder's pressure signal also spans swaps — a
+        # reload under load must not reset the ladder to full detection
+        new.load_controller = old.load_controller
         with self._swap_lock:
             # reload-drift snapshot (ISSUE 3): freeze the outgoing
             # version's per-rule counters at the instant it stops
@@ -323,10 +600,42 @@ class Batcher:
         self.pipeline.tenant_rule_mask = (
             tenant_masks(self.pipeline.ruleset, tags) if tags else None)
 
+    def _drain_failopen(self, reason: str) -> int:
+        """Empty the MAIN queue, resolving every stranded future
+        fail-open (begin/chunk items carry no future: their handles are
+        poisoned so a later finish resolves fail-open too).  Used at
+        shutdown and by the watchdog monitor when the dispatch thread
+        is wedged — either way, nobody is going to dispatch these."""
+        n = 0
+        st = self.pipeline.stats
+        while True:
+            try:
+                kind, _ts, obj, fut = self._q.get_nowait()
+            except queue.Empty:
+                return n
+            if kind == "begin":
+                obj.error = True
+                continue
+            if kind == "chunk":
+                obj[0].error = True
+                continue
+            rid = (obj.request_id if kind == "req"
+                   else obj.request.request_id)
+            st.fail_open += 1
+            st.count_shed(reason)
+            _safe_set(fut, _fail_open_verdict(rid))
+            n += 1
+
     def close(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
         self._oversized_thread.join(timeout=5)
+        self._watchdog.join(timeout=5)
+        self._lane.close()
+        # requests still queued at shutdown would strand their
+        # connection handlers until the client times out — resolve them
+        # fail-open, the same contract the oversized side lane had
+        self._drain_failopen("shutdown")
         # items still queued on the side lane would strand their futures
         # (connection handlers block forever) — resolve them fail-open
         # (round-3 review)
@@ -336,9 +645,7 @@ class Batcher:
             except queue.Empty:
                 break
             self.pipeline.stats.fail_open += 1
-            _safe_set(fut, Verdict(
-                request_id=request.request_id, blocked=False, attack=False,
-                classes=[], rule_ids=[], score=0, fail_open=True))
+            _safe_set(fut, _fail_open_verdict(request.request_id))
 
     # ------------------------------------------------------------ loop
 
@@ -368,10 +675,82 @@ class Batcher:
                 break
         return batch
 
+    def _stream_step_guarded(self, begins, chunks, finishes,
+                             route: str) -> List:
+        """Stream scan work rides the SAME watchdogged lane as the
+        batch dispatch: a device wedge first hitting a stream cycle
+        must not hang the dispatch thread past the hang budget (the
+        monitor's much larger grace is the backstop, not the budget).
+        On a hang: this cycle's stream handles are poisoned, finishes
+        resolve fail-open here, and the breaker trips like any other
+        device hang."""
+        if not (begins or chunks or finishes):
+            return []
+        try:
+            return self._lane.call(
+                lambda: self._stream_step(begins, chunks, finishes,
+                                          device_ok=(route != "fallback")),
+                self.hang_budget_s)
+        except DeviceHang:
+            self.stats.hangs += 1
+            self.breaker.trip("hang")
+            self._lane = _DeviceLane(self._lane.seq + 1)
+            for h in begins:
+                h.error = True
+            for h, _ in chunks:
+                h.error = True
+            out = []
+            st = self.pipeline.stats
+            for h, fut in finishes:
+                h.error = True
+                st.fail_open += 1
+                v = _fail_open_verdict(h.request.request_id)
+                _safe_set(fut, v)
+                out.append((h, v))
+            return out
+
+    def _detect_guarded(self, requests: List[Request],
+                        route: str) -> List[Verdict]:
+        """One batch through the breaker-routed device path.
+
+        "device"/"canary" → the watchdogged lane runs detect_strict
+        with the hang budget; a hang fails the batch open, trips the
+        breaker and abandons the lane; an error fails the batch open
+        and counts toward the breaker.  "fallback" (breaker open) →
+        the CPU confirm-only path, no device touched."""
+        p = self.pipeline
+        if route == "fallback":
+            self.stats.cpu_fallback_batches += 1
+            return p.detect_cpu_only(requests)
+        try:
+            verdicts = self._lane.call(
+                lambda: p.detect_strict(requests), self.hang_budget_s)
+            self.breaker.record_success()
+            return verdicts
+        except DeviceHang:
+            # the stuck batch fails open NOW (the client-side budget is
+            # long blown); the zombie lane is abandoned and the breaker
+            # opens so the next batches go to the CPU fallback
+            self.stats.hangs += 1
+            self.breaker.trip("hang")
+            self._lane = _DeviceLane(self._lane.seq + 1)
+        except Exception:
+            # batcher-level fail-open regardless of the pipeline's own
+            # fail_open flag (the serve plane's contract) — but the
+            # breaker gets to COUNT the failure first, which is why this
+            # path calls detect_strict rather than detect
+            self.breaker.record_failure()
+        p.stats.fail_open += len(requests)
+        return [_fail_open_verdict(r.request_id) for r in requests]
+
     def _run(self) -> None:
         while not self._stop.is_set():
             batch = self._drain()
             if not batch:
+                # idle drain: feed the brownout ladder a zero so the
+                # queue-delay EWMA decays and the ladder can step back
+                # down once pressure is gone
+                self.pipeline.load_controller.observe(0.0)
                 continue
             t0 = time.perf_counter()
             self.stats.batches += 1
@@ -383,6 +762,14 @@ class Batcher:
                                             len(reqs))
             for ts, _, _ in reqs:
                 self.stats.queue_delay_us_sum += int((t0 - ts) * 1e6)
+            # arm the monitor: if THIS cycle wedges past every budget,
+            # the watchdog releases its futures fail-open
+            guard = [(r.request_id, fut) for _ts, r, fut in reqs]
+            guard += [(h.request.request_id, fut) for h, fut in finishes]
+            self._cycle_guard = (t0 + self._watch_grace, guard)
+            # one breaker decision per cycle: requests AND stream scan
+            # work follow it (a wedged device must not be probed twice)
+            route = self.breaker.route()
             done: List = []   # (submit_ts, request, verdict) this cycle
             with self._swap_lock:
                 # stage-delta capture INSIDE the lock: the oversized
@@ -392,8 +779,9 @@ class Batcher:
                 ps = self.pipeline.stats
                 engine_us0, confirm_us0 = ps.engine_us, ps.confirm_us
                 prep_us0 = ps.prep_us
-                finish_verdicts = self._stream_step(begins, chunks,
-                                                    finishes)
+                compiles0 = ps.engine_compiles
+                finish_verdicts = self._stream_step_guarded(
+                    begins, chunks, finishes, route)
                 # partition: oversized bodies go through the stream
                 # engine inline; everything else batches as usual
                 normal = []
@@ -410,14 +798,10 @@ class Batcher:
                 requests = [r for _, r, _ in normal]
                 if requests:
                     try:
-                        verdicts = self.pipeline.detect(requests)
+                        verdicts = self._detect_guarded(requests, route)
                     except Exception:
-                        verdicts = [
-                            Verdict(request_id=r.request_id, blocked=False,
-                                    attack=False, classes=[], rule_ids=[],
-                                    score=0, fail_open=True)
-                            for r in requests
-                        ]
+                        verdicts = [_fail_open_verdict(r.request_id)
+                                    for r in requests]
                     for (ts, r, fut), v in zip(normal, verdicts):
                         _safe_set(fut, v)
                         done.append((ts, r, v))
@@ -427,8 +811,30 @@ class Batcher:
                 d_engine = ps.engine_us - engine_us0
                 d_confirm = ps.confirm_us - confirm_us0
                 d_prep = ps.prep_us - prep_us0
+                d_compiles = ps.engine_compiles - compiles0
+            self._cycle_guard = None
             t_end = time.perf_counter()
             took = t_end - t0
+            # fail-safe plane signals: cycle-time EWMA feeds the
+            # admission queue math; the oldest request's queue delay
+            # feeds the brownout ladder.  Cycles that paid a serve-time
+            # XLA compile are EXCLUDED from both — a cold-start compile
+            # is warmup, not load, and folding its seconds-long stall
+            # into the service-rate estimate made admission shed (and
+            # the ladder brown out) every request behind a first
+            # dispatch (the --no-warmup e2e showed exactly this)
+            if d_compiles == 0:
+                # clamp the service-time sample too: a cycle that blew
+                # past 2x the deadline is a stall (stream-shape compile,
+                # CPU pause), not the steady-state service rate — a
+                # genuinely slow plane still converges well above the
+                # shed horizon
+                self._batch_ewma.update(
+                    min(took, 2.0 * self.hard_deadline_s))
+                self._batch_ewma_n += 1
+                self.pipeline.load_controller.observe(
+                    max(((t0 - ts) * 1e6 for _, ts, _, _ in batch),
+                        default=0.0))
             self.stats.batch_us_sum += int(took * 1e6)
             if took > self.hard_deadline_s:
                 self.stats.deadline_overruns += len(reqs) + len(finishes)
@@ -453,6 +859,47 @@ class Batcher:
                 + [h.request.request_id for h, _ in finish_verdicts])
             self.traces.record(trace)
             self._observe(trace, done, finish_verdicts, t0, t_end)
+
+    def _watch(self) -> None:
+        """Monitor thread: last-resort backstop for a wedged DISPATCH
+        THREAD (the device lane already bounds the device call; this
+        covers everything else a cycle can hang in).  When the current
+        cycle blows past ``_watch_grace``, its futures are released
+        fail-open so no connection handler strands; while the dispatch
+        thread still makes no progress, newly queued work is drained
+        fail-open each tick — the one-verdict invariant outlives even
+        a dead dispatcher."""
+        period = min(max(self.hang_budget_s / 4.0, 0.05), 1.0)
+        stuck_at_batches: Optional[int] = None
+        fired_guard: Optional[tuple] = None
+        while not self._stop.wait(period):
+            guard = self._cycle_guard
+            # NEVER write _cycle_guard from here: the dispatch thread
+            # is its only writer — a monitor-side clear could race the
+            # dispatcher un-sticking and clobber the NEXT cycle's
+            # freshly armed guard, leaving that cycle unprotected.
+            # Identity-tracking the fired guard gives the same
+            # fire-once behavior without the write.
+            if (guard is not None and guard is not fired_guard
+                    and time.perf_counter() > guard[0]):
+                fired_guard = guard
+                released = 0
+                st = self.pipeline.stats
+                for rid, fut in guard[1]:
+                    if not fut.done():
+                        st.fail_open += 1
+                        _safe_set(fut, _fail_open_verdict(rid))
+                        released += 1
+                if released:
+                    self.stats.watchdog_released += released
+                    self.breaker.trip("watchdog")
+                    stuck_at_batches = self.stats.batches
+            if stuck_at_batches is not None:
+                if self.stats.batches != stuck_at_batches:
+                    stuck_at_batches = None   # dispatcher moved again
+                else:
+                    n = self._drain_failopen("watchdog")
+                    self.stats.watchdog_released += n
 
     @staticmethod
     def _exemplar(request, verdict, ts: float, queue_us: int,
@@ -514,15 +961,26 @@ class Batcher:
                         "body_len": handle.body_len,
                         "truncated": handle.truncated}))
 
-    def _stream_step(self, begins, chunks, finishes) -> List:
+    def _stream_step(self, begins, chunks, finishes,
+                     device_ok: bool = True) -> List:
         """Streaming work for one dispatch cycle (called under the swap
         lock, on the dispatch thread — sole owner of stream state).
         Returns the (handle, verdict) pairs resolved at finish, so the
-        caller can attribute their latency."""
+        caller can attribute their latency.  ``device_ok=False``
+        (breaker open): the scan plane is presumed dead — poison this
+        cycle's stream work instead of hanging the dispatch thread on
+        a wedged device; every finish resolves fail-open."""
         if not (begins or chunks or finishes):
             return []
+        if not device_ok:
+            for h in begins:
+                h.error = True
+            for h, _ in chunks:
+                h.error = True
+            for h, _ in finishes:
+                h.error = True
         try:
-            live = [h for h in begins if not h.aborted]
+            live = [h for h in begins if not (h.aborted or h.error)]
             if live:
                 base = self.pipeline.prefilter([h.request for h in live])
                 for i, h in enumerate(live):

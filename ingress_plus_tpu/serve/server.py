@@ -4,8 +4,14 @@ The dispatcher process of SURVEY.md §7: accepts framed requests from the
 native sidecar over a unix socket, batches them (batcher.py), and fans
 verdicts back (out-of-order, correlated by req_id).  A small HTTP listener
 exposes ``/metrics`` (Prometheus text format — the SocketCollector /
-collectd analog) and ``/healthz`` (the k8s probe / fail-open watchdog
-analog, SURVEY.md §5).
+collectd analog), ``/healthz`` (LIVENESS: the k8s probe / fail-open
+watchdog analog, SURVEY.md §5 — 200 while the process serves at all,
+now carrying the fail-safe plane's state), and ``/readyz`` (READINESS:
+503 while the dispatch breaker is open or the brownout ladder sits
+above full detection, so the k8s service pulls the pod from rotation
+instead of routing traffic into a brownout — docs/ROBUSTNESS.md).
+``/faults`` inspects/installs the deterministic fault-injection plan
+(utils/faults.py; ``dbg faults`` renders it).
 
 Run:  python -m ingress_plus_tpu.serve --socket /tmp/ipt.sock \
           [--http-port 9901] [--mode block] [--rules-dir ...]
@@ -407,6 +413,31 @@ class ServeLoop:
             "# TYPE ipt_engine_recompiles_total counter",
             "ipt_engine_recompiles_total %d" % p.engine_compiles,
         ]
+        # --- fail-safe serve plane (docs/ROBUSTNESS.md): bounded
+        # admission, brownout ladder, dispatch breaker/watchdog
+        brk = self.batcher.breaker
+        lc = pipeline.load_controller
+        brk_state = {"closed": 0, "half_open": 1, "open": 2}.get(
+            brk.state, 2)
+        lines += [
+            "# TYPE ipt_queue_depth gauge",
+            "ipt_queue_depth %d" % self.batcher.queue_depth(),
+            "# TYPE ipt_degraded_mode gauge",
+            "ipt_degraded_mode %d" % lc.level,
+            "# TYPE ipt_degraded_verdicts_total counter",
+            "ipt_degraded_verdicts_total %d" % p.degraded,
+            "# TYPE ipt_breaker_state gauge",
+            "ipt_breaker_state %d" % brk_state,
+            "# TYPE ipt_breaker_trips_total counter",
+            "ipt_breaker_trips_total %d" % brk.trips,
+            "# TYPE ipt_watchdog_hangs_total counter",
+            "ipt_watchdog_hangs_total %d" % s.hangs,
+            "# TYPE ipt_cpu_fallback_batches_total counter",
+            "ipt_cpu_fallback_batches_total %d" % s.cpu_fallback_batches,
+        ]
+        lines.append("# TYPE ipt_shed_total counter")
+        lines += bounded_counter_series(
+            "ipt_shed_total", "reason", dict(p.shed))
         lines.append("# TYPE ipt_bucket_rows_total counter")
         # dict() first: atomic copy vs the dispatch thread inserting a
         # new L tier mid-scrape (see rule_stats.device_efficiency)
@@ -442,6 +473,15 @@ class ServeLoop:
                 "# TYPE ipt_post_export_errors_total counter",
                 "ipt_post_export_errors_total %d"
                 % self.post.exporter.export_errors,
+                "# TYPE ipt_post_backoff_s gauge",
+                "ipt_post_backoff_s %s"
+                % round(self.post.exporter.backoff_s, 3),
+                "# TYPE ipt_post_spool_dropped_files_total counter",
+                "ipt_post_spool_dropped_files_total %d"
+                % self.post.exporter.spool_dropped_files,
+                "# TYPE ipt_post_spool_dropped_bytes_total counter",
+                "ipt_post_spool_dropped_bytes_total %d"
+                % self.post.exporter.spool_dropped_bytes,
             ]
         return "\n".join(lines) + "\n"
 
@@ -506,11 +546,77 @@ class ServeLoop:
         pipeline = self.batcher.pipeline
         loop = asyncio.get_running_loop()
         if path.startswith("/healthz"):
+            # LIVENESS only: 200 while the process can answer at all —
+            # a browned-out pod must be left alive to recover, not
+            # restarted into a cold-compile storm.  Readiness (pull
+            # from rotation) is /readyz below.
+            s = self.batcher.stats
             return "200 OK", "application/json", json.dumps({
                 "status": "ok",
                 "uptime_s": round(time.time() - self.started, 1),
                 "ruleset": pipeline.ruleset.version,
+                "robustness": {
+                    "breaker": self.batcher.breaker.snapshot(),
+                    "ladder": pipeline.load_controller.snapshot(),
+                    "queue_depth": self.batcher.queue_depth(),
+                    "queue_cap": self.batcher.queue_cap,
+                    "shed": dict(pipeline.stats.shed),
+                    "degraded_verdicts": pipeline.stats.degraded,
+                    "hangs": s.hangs,
+                    "cpu_fallback_batches": s.cpu_fallback_batches,
+                    "watchdog_released": s.watchdog_released,
+                },
             }).encode()
+        if path.startswith("/readyz"):
+            # READINESS (docs/ROBUSTNESS.md): unready while the breaker
+            # is open/probing or the brownout ladder is above full
+            # detection — the k8s service stops routing NEW traffic
+            # here while in-flight verdicts still drain (fail-open)
+            brk = self.batcher.breaker.snapshot()
+            lc = pipeline.load_controller
+            reasons = []
+            # an OPEN breaker whose cooldown has elapsed (probe_due) or
+            # a HALF_OPEN one counts as ready: the canary that would
+            # close it can only arrive if traffic routes here again —
+            # staying unready would deadlock an out-of-rotation pod
+            if brk["state"] == "open" and not brk["probe_due"]:
+                reasons.append("breaker_open")
+            if lc.level > 0:
+                reasons.append("degraded_%s" % lc.snapshot()["mode"])
+            body = json.dumps({
+                "ready": not reasons,
+                "reasons": reasons,
+                "breaker": brk["state"],
+                "degraded_mode": lc.level,
+            }).encode()
+            return (("200 OK" if not reasons
+                     else "503 Service Unavailable"),
+                    "application/json", body)
+        if path.startswith("/faults"):
+            # deterministic fault-injection plane (utils/faults.py):
+            # GET = the active plan + firing counters; POST {"spec":
+            # "...", "seed": N} installs a plan, POST {} clears it
+            from ingress_plus_tpu.utils import faults as faults_mod
+            if method == "POST":
+                try:
+                    spec = json.loads(payload or b"{}")
+                    if not isinstance(spec, dict):
+                        raise ValueError("payload must be a JSON object")
+                    if spec.get("spec"):
+                        faults_mod.install(faults_mod.FaultPlan.from_spec(
+                            str(spec["spec"]),
+                            seed=int(spec.get("seed", 0))))
+                    else:
+                        faults_mod.clear()
+                except (ValueError, TypeError,
+                        json.JSONDecodeError) as e:
+                    return ("400 Bad Request", "application/json",
+                            json.dumps({"error": str(e)}).encode())
+            plan = faults_mod.active()
+            return ("200 OK", "application/json", json.dumps({
+                "active": plan is not None,
+                "plan": plan.snapshot() if plan is not None else None,
+            }).encode())
         if path.startswith("/metrics"):
             return ("200 OK", "text/plain; version=0.0.4",
                     self._metrics_text().encode())
@@ -754,7 +860,12 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
                           max_delay_s: float = 0.0005,
                           warmup: bool = True,
                           scan_impl: str = "auto",
-                          mesh_spec: Optional[str] = None) -> Batcher:
+                          mesh_spec: Optional[str] = None,
+                          queue_cap: int = 8192,
+                          hard_deadline_s: float = 0.25,
+                          hang_budget_s: float = 30.0,
+                          breaker_failures: int = 3,
+                          breaker_cooldown_s: float = 5.0) -> Batcher:
     from ingress_plus_tpu.compiler.ruleset import compile_ruleset
     from ingress_plus_tpu.compiler.seclang import load_seclang_dir
     from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
@@ -798,7 +909,11 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
         # the detection-plane telemetry so /rules/* and the efficiency
         # gauges describe real traffic from request one
         pipeline.reset_detection_observations()
-    return Batcher(pipeline, max_batch=max_batch, max_delay_s=max_delay_s)
+    return Batcher(pipeline, max_batch=max_batch, max_delay_s=max_delay_s,
+                   hard_deadline_s=hard_deadline_s, queue_cap=queue_cap,
+                   hang_budget_s=hang_budget_s,
+                   breaker_failures=breaker_failures,
+                   breaker_cooldown_s=breaker_cooldown_s)
 
 
 def warmup_pipeline(pipeline, max_batch: int) -> None:
@@ -874,7 +989,41 @@ def main(argv=None) -> None:
                     help="host:port of the native sidecar's --status-port"
                          " listener; /traces/request then includes the "
                          "sidecar hop's per-upstream EWMA timing")
+    # fail-safe serve plane (docs/ROBUSTNESS.md)
+    ap.add_argument("--queue-cap", type=int, default=8192,
+                    help="bounded admission: max queued items; beyond "
+                         "it requests shed fail-open at enqueue")
+    ap.add_argument("--hard-deadline-ms", type=int, default=250,
+                    help="serve deadline: requests whose queue math "
+                         "predicts a miss are shed fail-open at "
+                         "enqueue; also derives the brownout ladder "
+                         "thresholds")
+    ap.add_argument("--hang-budget-ms", type=int, default=30000,
+                    help="dispatch watchdog: a device dispatch "
+                         "exceeding this fails its batch open and "
+                         "trips the circuit breaker (keep generous "
+                         "with --no-warmup: cold XLA compiles count)")
+    ap.add_argument("--breaker-failures", type=int, default=3,
+                    help="consecutive dispatch errors that open the "
+                         "breaker (hangs open it immediately)")
+    ap.add_argument("--breaker-cooldown-s", type=float, default=5.0,
+                    help="seconds the breaker stays open before a "
+                         "half-open canary batch probes the device")
+    ap.add_argument("--faults", default=None,
+                    help="deterministic fault plan, e.g. "
+                         "'dispatch_hang:after=100,times=1,delay_s=5'; "
+                         "also honored from $IPT_FAULTS "
+                         "(utils/faults.py, docs/ROBUSTNESS.md)")
+    ap.add_argument("--faults-seed", type=int, default=0)
     args = ap.parse_args(argv)
+
+    from ingress_plus_tpu.utils import faults as faults_mod
+    if args.faults:
+        faults_mod.install(
+            faults_mod.FaultPlan.from_spec(args.faults,
+                                           seed=args.faults_seed))
+    else:
+        faults_mod.install_from_env()
 
     if args.platform:
         import jax
@@ -884,7 +1033,12 @@ def main(argv=None) -> None:
     batcher = build_default_batcher(
         mode=args.mode, rules_dir=args.rules_dir, max_batch=args.max_batch,
         max_delay_s=args.max_delay_us / 1e6, warmup=not args.no_warmup,
-        scan_impl=args.scan_impl, mesh_spec=args.mesh)
+        scan_impl=args.scan_impl, mesh_spec=args.mesh,
+        queue_cap=args.queue_cap,
+        hard_deadline_s=args.hard_deadline_ms / 1e3,
+        hang_budget_s=args.hang_budget_ms / 1e3,
+        breaker_failures=args.breaker_failures,
+        breaker_cooldown_s=args.breaker_cooldown_s)
 
     post = None
     if args.spool_dir or args.export_url:

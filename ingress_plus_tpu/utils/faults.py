@@ -1,0 +1,573 @@
+"""Deterministic fault injection for the fail-safe serve plane.
+
+The reference node's contract is that detection degrades before traffic
+does (wallarm-fallback, SURVEY.md §5): overload and breakage produce
+fail-open verdicts, never queues or 5xx.  That contract is only worth
+anything if the failure paths can be *exercised* — a fallback nobody can
+trigger in CI is a fallback that breaks silently.  This module is the
+trigger: a seeded, fully deterministic ``FaultPlan`` with named
+injection sites threaded through the planes that can actually break in
+production:
+
+========================  ====================================================
+site                      injected where / what it does when it fires
+========================  ====================================================
+``dispatch_hang``         engine device dispatch sleeps ``delay_s`` (a wedged
+                          device / stuck XLA dispatch) — exercises the
+                          batcher's dispatch watchdog + circuit breaker
+``dispatch_raise``        engine device dispatch raises ``FaultError`` (a
+                          crashed device / poisoned executable) — exercises
+                          fail-open verdicts + breaker failure counting
+``recompile_storm``       pipeline prefilter drops every compiled executable
+                          (jit cache cleared, warm shapes forgotten) — the
+                          next dispatches pay serve-time compiles, visible in
+                          ``ipt_engine_recompiles_total``
+``swap_fail``             ruleset hot-swap raises mid-swap — the outgoing
+                          pipeline must keep serving untouched
+``export_5xx``            the post exporter's HTTP delivery raises (collector
+                          returning 5xx) — exercises exponential backoff +
+                          spool bounding
+``slow_confirm``          pipeline confirm stage sleeps ``delay_s`` per batch
+                          (pathological regex / CPU contention) — exercises
+                          deadline shedding and the brownout ladder
+========================  ====================================================
+
+A plan is a set of per-site rules ``site:after=N,times=M,delay_s=X,
+prob=P`` joined by ``;`` — e.g. ``dispatch_hang:after=4,times=1,
+delay_s=2`` fires exactly once, on the 5th arrival at the dispatch
+site, and sleeps 2s.  ``prob`` draws from a seeded RNG, so even
+probabilistic plans replay identically.  Configure via the serve CLI
+(``--faults``), the environment (``IPT_FAULTS`` / ``IPT_FAULTS_SEED``),
+or at runtime through the serve loop's ``/faults`` endpoint (``dbg
+faults`` renders it).
+
+``run_fault_matrix()`` is the CI harness (``tools/lint.py --ci``
+``faultmatrix`` gate, ``tests/test_robustness.py``): it drives a real
+CPU batcher under every scenario plus a synthetic overload burst and
+asserts the serve-plane invariant — every admitted request resolves to
+exactly one verdict, and no fault becomes an unhandled exception or a
+block.  See docs/ROBUSTNESS.md.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+#: the known injection sites (a spec naming anything else is rejected —
+#: a typo'd site would otherwise silently never fire)
+SITES = ("dispatch_hang", "dispatch_raise", "recompile_storm",
+         "swap_fail", "export_5xx", "slow_confirm")
+
+
+class FaultError(RuntimeError):
+    """The injected failure raised at raise-type sites."""
+
+
+@dataclass
+class FaultRule:
+    """Firing schedule for one site.
+
+    ``after``: skip the first N arrivals; ``times``: fire at most N
+    times (None = unlimited); ``delay_s``: sleep duration for
+    hang/slow sites; ``prob``: per-arrival firing probability drawn
+    from the plan's seeded RNG (1.0 = always)."""
+
+    site: str
+    after: int = 0
+    times: Optional[int] = None
+    delay_s: float = 1.0
+    prob: float = 1.0
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultRule":
+        site, _, argstr = text.strip().partition(":")
+        site = site.strip()
+        if site not in SITES:
+            raise ValueError("unknown fault site %r (known: %s)"
+                             % (site, ", ".join(SITES)))
+        kw: Dict[str, float] = {}
+        for part in filter(None, (p.strip() for p in argstr.split(","))):
+            k, _, v = part.partition("=")
+            k = k.strip()
+            if k not in ("after", "times", "delay_s", "prob"):
+                raise ValueError("unknown fault arg %r in %r" % (k, text))
+            kw[k] = float(v)
+        return cls(site=site,
+                   after=int(kw.get("after", 0)),
+                   times=int(kw["times"]) if "times" in kw else None,
+                   delay_s=float(kw.get("delay_s", 1.0)),
+                   prob=float(kw.get("prob", 1.0)))
+
+
+class FaultPlan:
+    """A seeded, replayable set of fault rules.
+
+    Thread-safe: arrival/fired counters and the RNG advance under one
+    lock, so a plan replays identically regardless of which serve
+    thread reaches a site (determinism holds per-site — ``after`` and
+    ``times`` count arrivals at that site in program order)."""
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0):
+        self.rules: Dict[str, FaultRule] = {r.site: r for r in rules}
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.arrivals: Dict[str, int] = {s: 0 for s in self.rules}
+        self.fired: Dict[str, int] = {s: 0 for s in self.rules}
+
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        rules = [FaultRule.parse(p)
+                 for p in filter(None, (s.strip() for s in spec.split(";")))]
+        if not rules:
+            raise ValueError("empty fault spec")
+        return cls(rules, seed=seed)
+
+    def fire(self, site: str) -> Optional[FaultRule]:
+        """One arrival at ``site``; returns the rule when it fires."""
+        rule = self.rules.get(site)
+        if rule is None:
+            return None
+        with self._lock:
+            n = self.arrivals[site]
+            self.arrivals[site] = n + 1
+            if n < rule.after:
+                return None
+            if rule.times is not None and self.fired[site] >= rule.times:
+                return None
+            if rule.prob < 1.0 and self._rng.random() >= rule.prob:
+                return None
+            self.fired[site] += 1
+            return rule
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "rules": [
+                    {"site": r.site, "after": r.after, "times": r.times,
+                     "delay_s": r.delay_s, "prob": r.prob,
+                     "arrivals": self.arrivals[r.site],
+                     "fired": self.fired[r.site]}
+                    for r in self.rules.values()
+                ],
+            }
+
+
+# ------------------------------------------------------- active plan
+# One process-global plan (serve loop + its worker threads share it).
+# The no-plan fast path is a single global read — the injection sites
+# sit on hot paths and must cost nothing in production.
+
+_active: Optional[FaultPlan] = None
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    global _active
+    _active = plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> Optional[FaultPlan]:
+    return _active
+
+
+def install_from_env(environ=os.environ) -> Optional[FaultPlan]:
+    """``IPT_FAULTS``/``IPT_FAULTS_SEED`` → installed plan (or None)."""
+    spec = environ.get("IPT_FAULTS")
+    if not spec:
+        return None
+    plan = FaultPlan.from_spec(spec,
+                               seed=int(environ.get("IPT_FAULTS_SEED", "0")))
+    install(plan)
+    return plan
+
+
+def fire(site: str) -> bool:
+    """True when the fault at ``site`` fires this arrival (the caller
+    applies the site's semantics itself)."""
+    p = _active
+    if p is None:
+        return False
+    return p.fire(site) is not None
+
+
+def sleep_if(site: str) -> bool:
+    """Hang-type site: sleep the rule's ``delay_s`` when it fires."""
+    p = _active
+    if p is None:
+        return False
+    r = p.fire(site)
+    if r is None:
+        return False
+    time.sleep(r.delay_s)
+    return True
+
+
+def raise_if(site: str) -> None:
+    """Raise-type site: raise ``FaultError`` when it fires."""
+    p = _active
+    if p is None:
+        return
+    if p.fire(site) is not None:
+        raise FaultError("injected fault: %s" % site)
+
+
+# ===================================================== fault matrix
+# The CI harness.  Imports are deliberately inside the function: this
+# module sits in utils/ below the serve plane, and the matrix drives
+# the real Batcher/DetectionPipeline on CPU.
+
+_MATRIX_RULES = """
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)union\\s+select" \
+    "id:942100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-sqli'"
+SecRule REQUEST_URI|ARGS "@rx (?i)<script" \
+    "id:941100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-xss'"
+"""
+
+ATTACK_URI = "/q?a=1+union+select+2"
+
+
+def _matrix_ruleset():
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+
+    return compile_ruleset(parse_seclang(_MATRIX_RULES))
+
+
+def _mk_batcher(cr=None, **kw):
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.batcher import Batcher
+
+    pipeline = DetectionPipeline(cr if cr is not None else _matrix_ruleset(),
+                                 mode="block")
+    kw.setdefault("max_batch", 16)
+    kw.setdefault("max_delay_s", 0.001)
+    b = Batcher(pipeline, **kw)
+    # compile the serve shapes BEFORE any plan is active: a first-dispatch
+    # XLA compile inside a scenario would read as a hang
+    from ingress_plus_tpu.serve.normalize import Request
+
+    warm = [Request(uri="/warm?i=%d" % i, request_id="warm%d" % i)
+            for i in range(kw["max_batch"])]
+    for size in (1, 4, kw["max_batch"]):
+        pipeline.detect(warm[:size])
+    return b
+
+
+def _requests(n: int, attack_every: int = 0, tag: str = "r"):
+    from ingress_plus_tpu.serve.normalize import Request
+
+    out = []
+    for i in range(n):
+        uri = (ATTACK_URI if attack_every and i % attack_every == 0
+               else "/benign?i=%d" % i)
+        out.append(Request(uri=uri, request_id="%s%d" % (tag, i)))
+    return out
+
+
+def _collect(futs, timeout_s: float) -> tuple:
+    """Resolve every future → (verdicts, violations).  A future that
+    never resolves or raises IS the invariant violation."""
+    verdicts, violations = [], []
+    deadline = time.monotonic() + timeout_s
+    for i, f in enumerate(futs):
+        try:
+            v = f.result(timeout=max(deadline - time.monotonic(), 0.1))
+        except Exception as e:  # noqa: BLE001 — the harness must report, not die
+            violations.append("request %d: no verdict (%s: %s)"
+                              % (i, type(e).__name__, e))
+            continue
+        verdicts.append(v)
+    return verdicts, violations
+
+
+def _check_verdicts(verdicts, violations, n_admitted: int,
+                    allow_blocked_attacks: bool = True) -> None:
+    if len(verdicts) != n_admitted - len(violations):
+        violations.append("verdict count mismatch: %d of %d"
+                          % (len(verdicts), n_admitted))
+    for v in verdicts:
+        if v.blocked and not v.attack:
+            violations.append("request %s blocked without an attack "
+                              "verdict (fault became a block)"
+                              % v.request_id)
+        if v.blocked and not allow_blocked_attacks:
+            violations.append("request %s blocked under degradation"
+                              % v.request_id)
+
+
+def _scenario_overload(install_plan) -> dict:
+    """Synthetic 10× burst against a slowed confirm stage: bounded
+    admission must shed fail-open at enqueue, and every admitted
+    request still resolves."""
+    install_plan(FaultPlan.from_spec("slow_confirm:times=100,delay_s=0.05"))
+    b = _mk_batcher(queue_cap=32, hard_deadline_s=0.15, hang_budget_s=30.0)
+    try:
+        reqs = _requests(320, tag="ov")
+        futs = [b.submit(r) for r in reqs]
+        verdicts, violations = _collect(futs, timeout_s=60)
+        _check_verdicts(verdicts, violations, len(reqs))
+        shed = dict(b.pipeline.stats.shed)
+        if not shed:
+            violations.append("10x burst shed nothing — admission "
+                              "is not bounded")
+        return {"ok": not violations, "violations": violations,
+                "verdicts": len(verdicts), "shed": shed,
+                "degraded": b.pipeline.stats.degraded,
+                "ladder_steps_up": b.pipeline.load_controller.steps_up}
+    finally:
+        b.close()
+
+
+def _scenario_dispatch_hang(install_plan) -> dict:
+    """A wedged device dispatch: the watchdog fails the stuck batch
+    open within the hang budget, the breaker trips to the CPU fallback,
+    and a half-open canary closes it once the device recovers."""
+    b = _mk_batcher(hang_budget_s=0.3, breaker_cooldown_s=0.4)
+    install_plan(FaultPlan.from_spec("dispatch_hang:times=1,delay_s=1.2"))
+    try:
+        futs = [b.submit(r) for r in _requests(8, tag="h0")]
+        verdicts, violations = _collect(futs, timeout_s=30)
+        _check_verdicts(verdicts, violations, 8)
+        if not any(v.fail_open for v in verdicts):
+            violations.append("hung batch did not fail open")
+        if b.breaker.trips < 1:
+            violations.append("breaker never tripped on the hang")
+        # while open: the CPU fallback must still produce REAL verdicts
+        futs = [b.submit(r) for r in _requests(8, attack_every=4, tag="h1")]
+        verdicts, v2 = _collect(futs, timeout_s=30)
+        violations += v2
+        _check_verdicts(verdicts, v2, 8)
+        if not any(v.attack for v in verdicts):
+            violations.append("CPU fallback lost detection while "
+                              "breaker open")
+        # recovery: hang exhausted, cooldown passes, canary closes
+        deadline = time.monotonic() + 15
+        while b.breaker.state != "closed" and time.monotonic() < deadline:
+            fs = [b.submit(r) for r in _requests(4, tag="h2")]
+            _collect(fs, timeout_s=10)
+            time.sleep(0.1)
+        if b.breaker.state != "closed":
+            violations.append("breaker never recovered through "
+                              "half-open (state=%s)" % b.breaker.state)
+        return {"ok": not violations, "violations": violations,
+                "breaker": b.breaker.snapshot(),
+                "hangs": b.stats.hangs}
+    finally:
+        b.close()
+
+
+def _scenario_dispatch_raise(install_plan) -> dict:
+    """Raising device dispatches: fail-open verdicts, breaker opens on
+    consecutive failures, CPU fallback serves, then recovery."""
+    b = _mk_batcher(hang_budget_s=30.0, breaker_failures=2,
+                    breaker_cooldown_s=0.3)
+    install_plan(FaultPlan.from_spec("dispatch_raise:times=3"))
+    try:
+        all_violations: List[str] = []
+        for wave in range(3):
+            futs = [b.submit(r) for r in _requests(4, tag="r%d" % wave)]
+            verdicts, violations = _collect(futs, timeout_s=30)
+            _check_verdicts(verdicts, violations, 4)
+            all_violations += violations
+            time.sleep(0.05)
+        if b.breaker.trips < 1:
+            all_violations.append("breaker never opened on consecutive "
+                                  "dispatch failures")
+        deadline = time.monotonic() + 15
+        while b.breaker.state != "closed" and time.monotonic() < deadline:
+            _collect([b.submit(r) for r in _requests(4, tag="rr")], 10)
+            time.sleep(0.1)
+        if b.breaker.state != "closed":
+            all_violations.append("breaker stuck %s" % b.breaker.state)
+        # closed again: detection works end to end
+        vs, viol = _collect([b.submit(r) for r in
+                             _requests(4, attack_every=2, tag="rf")], 30)
+        all_violations += viol
+        if not any(v.attack and not v.fail_open for v in vs):
+            all_violations.append("no clean attack verdict after recovery")
+        return {"ok": not all_violations, "violations": all_violations,
+                "breaker": b.breaker.snapshot()}
+    finally:
+        b.close()
+
+
+def _scenario_recompile_storm(install_plan) -> dict:
+    """Compiled-executable loss mid-serve: dispatches pay fresh
+    compiles but every verdict still lands."""
+    b = _mk_batcher(hang_budget_s=60.0)
+    install_plan(FaultPlan.from_spec("recompile_storm:times=2"))
+    try:
+        futs = [b.submit(r) for r in _requests(48, attack_every=8, tag="c")]
+        verdicts, violations = _collect(futs, timeout_s=120)
+        _check_verdicts(verdicts, violations, 48)
+        if not any(v.attack for v in verdicts):
+            violations.append("detection lost across the recompile storm")
+        return {"ok": not violations, "violations": violations,
+                "recompiles": b.pipeline.stats.engine_compiles}
+    finally:
+        b.close()
+
+
+def _scenario_swap_fail(install_plan) -> dict:
+    """A hot-swap that dies mid-swap must leave the outgoing ruleset
+    serving; the next (clean) swap must succeed."""
+    b = _mk_batcher()
+    install_plan(FaultPlan.from_spec("swap_fail:times=1"))
+    try:
+        violations: List[str] = []
+        v0 = b.pipeline.ruleset.version
+        from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+        from ingress_plus_tpu.compiler.seclang import parse_seclang
+
+        cr2 = compile_ruleset(parse_seclang(
+            'SecRule ARGS "@rx (?i)drop\\s+table" '
+            '"id:955000,phase:2,block,severity:CRITICAL,'
+            "tag:'attack-sqli'\""))
+        try:
+            b.swap_ruleset(cr2)
+            violations.append("swap_fail fault never raised")
+        except FaultError:
+            pass
+        if b.pipeline.ruleset.version != v0:
+            violations.append("failed swap mutated the serving pipeline")
+        vs, viol = _collect([b.submit(r) for r in
+                             _requests(8, attack_every=4, tag="s0")], 30)
+        violations += viol
+        _check_verdicts(vs, viol, 8)
+        if not any(v.attack for v in vs):
+            violations.append("old ruleset stopped detecting after the "
+                              "failed swap")
+        b.swap_ruleset(cr2)   # fault exhausted: clean swap
+        if b.pipeline.ruleset.version == v0:
+            violations.append("clean swap after the failed one did not "
+                              "install")
+        return {"ok": not violations, "violations": violations}
+    finally:
+        b.close()
+
+
+def _scenario_export_5xx(install_plan) -> dict:
+    """Collector 5xx streak: export errors count, the retry interval
+    backs off exponentially (with jitter, capped), and recovery resets
+    it.  Off the verdict path by construction — also asserted."""
+    import http.server
+    import json as _json
+
+    from ingress_plus_tpu.post.export import Exporter
+    from ingress_plus_tpu.post.queue import Hit, HitQueue
+
+    class _OK(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), _OK)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    install_plan(FaultPlan.from_spec("export_5xx:times=3"))
+    q = HitQueue(maxlen=1024)
+    exp = Exporter(q, http_url="http://127.0.0.1:%d/collect"
+                   % httpd.server_address[1], interval_s=0.2)
+    violations: List[str] = []
+    try:
+        waits = []
+        for _ in range(3):
+            q.put(Hit(ts=time.time(), request_id="e", tenant=0, client="c",
+                      method="GET", uri=ATTACK_URI, classes=("sqli",),
+                      rule_ids=(942100,), score=5, blocked=True,
+                      attack=True, fail_open=False, mode=2))
+            exp.flush_once()
+            waits.append(exp.next_wait_s())
+        if exp.export_errors < 3 or exp.consecutive_failures != 3:
+            violations.append("export failures not counted: errors=%d "
+                              "consecutive=%d" % (exp.export_errors,
+                                                  exp.consecutive_failures))
+        if not (waits[0] > exp.interval_s and waits[2] > waits[0]):
+            violations.append("backoff did not grow: %s"
+                              % _json.dumps(waits))
+        if any(w > exp.backoff_max_s for w in waits):
+            violations.append("backoff exceeded its ceiling")
+        q.put(Hit(ts=time.time(), request_id="e2", tenant=0, client="c",
+                  method="GET", uri=ATTACK_URI, classes=("sqli",),
+                  rule_ids=(942100,), score=5, blocked=True,
+                  attack=True, fail_open=False, mode=2))
+        n = exp.flush_once()   # fault exhausted: delivery succeeds
+        if n < 1 or exp.consecutive_failures != 0 \
+                or exp.next_wait_s() != exp.interval_s:
+            violations.append("recovery did not reset the backoff")
+        return {"ok": not violations, "violations": violations,
+                "waits_s": [round(w, 3) for w in waits]}
+    finally:
+        exp.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def _scenario_slow_confirm(install_plan) -> dict:
+    """Pathological confirm latency: verdicts all land (late, not
+    lost) and the brownout ladder has pressure signal to act on."""
+    install_plan(FaultPlan.from_spec("slow_confirm:times=6,delay_s=0.05"))
+    b = _mk_batcher(hang_budget_s=30.0)
+    try:
+        futs = [b.submit(r) for r in _requests(32, attack_every=8, tag="sc")]
+        verdicts, violations = _collect(futs, timeout_s=60)
+        _check_verdicts(verdicts, violations, 32)
+        return {"ok": not violations, "violations": violations,
+                "verdicts": len(verdicts)}
+    finally:
+        b.close()
+
+
+SCENARIOS = {
+    "overload_burst": _scenario_overload,
+    "dispatch_hang": _scenario_dispatch_hang,
+    "dispatch_raise": _scenario_dispatch_raise,
+    "recompile_storm": _scenario_recompile_storm,
+    "swap_fail": _scenario_swap_fail,
+    "export_5xx": _scenario_export_5xx,
+    "slow_confirm": _scenario_slow_confirm,
+}
+
+
+def run_fault_matrix(only: Optional[List[str]] = None) -> dict:
+    """Run every fault scenario on a CPU batcher; returns a report
+    with per-scenario ok/violations.  The caller gates on ``passed``.
+
+    The previously active plan is restored afterwards — the matrix is
+    safe to run inside a process that also serves (tests do)."""
+    saved = active()
+    report: Dict[str, dict] = {}
+    try:
+        for name, fn in SCENARIOS.items():
+            if only and name not in only:
+                continue
+            clear()
+            t0 = time.monotonic()
+            try:
+                res = fn(install)
+            except Exception as e:  # noqa: BLE001 — a scenario crash IS a finding
+                res = {"ok": False,
+                       "violations": ["scenario raised %s: %s"
+                                      % (type(e).__name__, e)]}
+            res["seconds"] = round(time.monotonic() - t0, 2)
+            report[name] = res
+    finally:
+        install(saved)
+    return {"passed": all(r["ok"] for r in report.values()),
+            "scenarios": report}

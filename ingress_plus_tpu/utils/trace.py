@@ -269,6 +269,33 @@ class SlowRing:
         return None
 
 
+class Ewma:
+    """Exponentially weighted moving average — the load signal of the
+    brownout ladder (models/pipeline.py LoadController) and the
+    batcher's queue-wait estimator (admission-time deadline shedding).
+    Single-writer (the dispatch thread); readers see a torn-free float
+    via the GIL."""
+
+    __slots__ = ("alpha", "value")
+
+    def __init__(self, alpha: float = 0.2):
+        self.alpha = alpha
+        self.value: Optional[float] = None
+
+    def update(self, x: float) -> float:
+        v = self.value
+        self.value = x if v is None else self.alpha * x \
+            + (1.0 - self.alpha) * v
+        return self.value
+
+    def get(self, default: float = 0.0) -> float:
+        v = self.value
+        return default if v is None else v
+
+    def reset(self) -> None:
+        self.value = None
+
+
 def bounded_counter_series(name: str, label: str,
                            counts: Dict[str, int], cap: int = 30,
                            extra: Optional[Dict[str, str]] = None,

@@ -19,6 +19,13 @@ Four gates, one verdict:
              a CPU pipeline and any runtime-dead rule (confirm regex
              the runtime cannot evaluate) not suppressed in
              rulecheck-baseline.json fails the gate
+  faultmatrix the fail-safe serve plane (docs/ROBUSTNESS.md): a real
+             CPU batcher runs under every deterministic FaultPlan
+             scenario (dispatch_hang/raise, recompile_storm, swap_fail,
+             export_5xx, slow_confirm) plus a synthetic overload burst;
+             the invariant "every admitted request gets exactly one
+             verdict, and no fault becomes an unhandled exception or a
+             block" must hold, the breaker must trip and recover
 
 The container policy is "no new installs": when ruff or mypy are not
 present, those gates report SKIPPED (recorded in the CI report so the
@@ -156,12 +163,44 @@ def run_dead_rules() -> dict:
     }
 
 
+def run_faultmatrix(write_report: bool) -> dict:
+    """Fail-safe serve-plane gate (docs/ROBUSTNESS.md): every fault
+    scenario + the overload burst against a real CPU batcher; any
+    invariant violation fails CI."""
+    t0 = time.time()
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+    from ingress_plus_tpu.utils.faults import run_fault_matrix
+
+    report = run_fault_matrix()
+    failed = {name: r["violations"]
+              for name, r in report["scenarios"].items() if not r["ok"]}
+    result = {
+        "status": "OK" if report["passed"] else "FAIL",
+        "seconds": round(time.time() - t0, 2),
+        "scenarios": {name: r["ok"]
+                      for name, r in report["scenarios"].items()},
+        "detail": "; ".join("%s: %s" % (n, "; ".join(v))
+                            for n, v in failed.items()) or
+                  "%d scenarios, invariant held under every fault"
+                  % len(report["scenarios"]),
+    }
+    if write_report:
+        out = REPO / "reports" / "FAULTMATRIX.json"
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(report, indent=2, default=str) + "\n")
+        result["report"] = str(out.relative_to(REPO))
+    return result
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tools/lint.py")
     ap.add_argument("--ci", action="store_true",
                     help="CI mode: also write reports/RULECHECK.json")
     ap.add_argument("--only",
-                    choices=["ruff", "mypy", "rulecheck", "deadrules"],
+                    choices=["ruff", "mypy", "rulecheck", "deadrules",
+                             "faultmatrix"],
                     default=None)
     args = ap.parse_args(argv)
 
@@ -174,6 +213,8 @@ def main(argv=None) -> int:
         gates["rulecheck"] = run_rulecheck(write_report=args.ci)
     if args.only in (None, "deadrules"):
         gates["deadrules"] = run_dead_rules()
+    if args.only in (None, "faultmatrix"):
+        gates["faultmatrix"] = run_faultmatrix(write_report=args.ci)
 
     failed = False
     for name, r in gates.items():
