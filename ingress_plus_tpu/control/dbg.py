@@ -47,6 +47,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import urllib.error
 import urllib.request
 
 
@@ -56,8 +57,18 @@ def _call(server: str, path: str, payload=None, timeout: float = 10) -> str:
     req = urllib.request.Request(
         url, data=data, method="POST" if data else "GET",
         headers={"Content-Type": "application/json"} if data else {})
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return resp.read().decode()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode()
+    except urllib.error.HTTPError as e:
+        # 4xx bodies are STRUCTURED reports (e.g. the rollout admission
+        # gate's {stage, reason, findings}) — the operator needs them,
+        # not just "HTTP Error 422"
+        try:
+            body = e.read().decode()
+        except Exception:
+            body = ""
+        raise OSError("%s%s" % (e, ("\n" + body) if body.strip() else ""))
 
 
 def render_latency(metrics_text: str, slow: dict,
@@ -212,6 +223,48 @@ def render_faults(state: dict) -> str:
     return "\n".join(lines)
 
 
+def render_rollout(st: dict) -> str:
+    """Terminal view for `dbg rollout`: the guarded-rollout state
+    machine out of /rollout (docs/ROBUSTNESS.md)."""
+    if not st.get("enabled", True):
+        return "no rollout controller attached (library batcher?)"
+    sh = st.get("shadow") or {}
+    diff = st.get("diff") or {}
+    lines = [
+        "rollout: %s  candidate=%s  incumbent=%s"
+        % (st.get("state", "?"), st.get("candidate") or "-",
+           st.get("incumbent") or "-"),
+        "ramp:    step %s/%s  fraction=%s  served=%s/%s this step"
+        % (st.get("step"), max(len(st.get("steps") or []) - 1, 0),
+           st.get("fraction"), st.get("step_served"),
+           st.get("step_min_requests")),
+        "shadow:  %s  mirrored=%s compared=%s dropped=%s (sample=%s)"
+        % ("on" if sh.get("active") else "off", sh.get("mirrored"),
+           sh.get("compared"), sh.get("dropped"), sh.get("sample")),
+        "diff:    %s"
+        % (", ".join("%s=%d" % kv for kv in sorted(diff.items())) or "-"),
+        "canary:  requests=%s failures=%s fail_open=%s"
+        % (st.get("candidate_requests"), st.get("candidate_failures"),
+           st.get("candidate_fail_open")),
+        "history: promotions=%s rollbacks=%s%s"
+        % (st.get("promotions"), st.get("rollbacks"),
+           ("  last_rollback=%s" % st["rollback_reason"])
+           if st.get("rollback_reason") else ""),
+    ]
+    rej = st.get("swap_rejected") or {}
+    lines.append("rejected: %s"
+                 % (", ".join("%s=%d" % kv for kv in sorted(rej.items()))
+                    or "-"))
+    if st.get("lkg_dir"):
+        lines.append("lkg:     %s" % st["lkg_dir"])
+    for ev in (st.get("history") or [])[-6:]:
+        extras = {k: v for k, v in ev.items() if k not in ("ts", "event")}
+        lines.append("  event: %-14s %s"
+                     % (ev.get("event"),
+                        " ".join("%s=%s" % kv for kv in extras.items())))
+    return "\n".join(lines)
+
+
 def render_drift(drift: dict, top: int = 20) -> str:
     """Terminal table for `dbg drift`: per-rule hit-rate deltas across
     the most recent hot reload, went-quiet rules first."""
@@ -247,7 +300,8 @@ def main(argv=None) -> int:
     ap.add_argument("cmd",
                     choices=["conf", "health", "metrics", "latency",
                              "tenants", "ruleset", "acl", "rulecheck",
-                             "rules", "drift", "breaker", "faults"])
+                             "rules", "drift", "breaker", "faults",
+                             "rollout"])
     ap.add_argument("--server", default="127.0.0.1:9901")
     ap.add_argument("--rules", default=None,
                     help="rulecheck: rules tree to analyze (default: "
@@ -259,6 +313,12 @@ def main(argv=None) -> int:
                     help="tenants: JSON tenant→tags table to push")
     ap.add_argument("--swap", default=None,
                     help="ruleset: checkpoint artifact path to hot-swap")
+    ap.add_argument("--force", action="store_true",
+                    help="ruleset: break-glass one-shot swap (skip the "
+                         "guarded staged rollout)")
+    ap.add_argument("--abort", action="store_true",
+                    help="rollout: abort an in-flight staged rollout "
+                         "(rolls back to the incumbent)")
     ap.add_argument("--paranoia", type=int, default=2)
     ap.add_argument("--sidecar", default=None,
                     help="latency: also scrape the native sidecar's "
@@ -286,6 +346,13 @@ def main(argv=None) -> int:
         elif args.cmd == "breaker":
             out = render_breaker(json.loads(_call(args.server,
                                                   "/healthz")))
+        elif args.cmd == "rollout":
+            if args.abort:
+                out = render_rollout(json.loads(_call(
+                    args.server, "/rollout", {"action": "abort"})))
+            else:
+                out = render_rollout(json.loads(_call(args.server,
+                                                      "/rollout")))
         elif args.cmd == "faults":
             if args.set_json is not None:
                 # --set 'dispatch_hang:times=1' installs; --set '' clears
@@ -326,9 +393,11 @@ def main(argv=None) -> int:
                 print("ruleset requires --swap <artifact path>",
                       file=sys.stderr)
                 return 2
-            # the swap responds only after the new pipeline is compiled
-            # and warm (zero serve gap) — minutes-grade, not 10s
-            out = _call(args.server, "/configuration/ruleset",
+            # the push responds only after the admission gate (staged)
+            # or the full compile+swap (force) — minutes-grade, not 10s
+            out = _call(args.server,
+                        "/configuration/ruleset"
+                        + ("?mode=force" if args.force else ""),
                         {"path": args.swap,
                          "paranoia_level": args.paranoia}, timeout=300)
     except (OSError, ValueError) as e:  # ValueError covers bad --set JSON

@@ -55,6 +55,7 @@ class DeployValues:
     batch_window_us: int = 500
     max_batch: int = 256
     spool_dir: str = "/var/spool/ipt"
+    lkg_dir: str = "/var/lib/ipt/lkg"    # last-known-good pack store
     export_url: str = ""                 # postanalytics collector
     export_interval_s: float = 5.0
     tenants: Dict[int, List[str]] = field(default_factory=dict)
@@ -169,6 +170,15 @@ def render_deployment(v: DeployValues) -> str:
         "          configMap: {name: %s}" % v.rules_configmap,
         "        - name: ipt-spool",
         "          emptyDir: {}",
+        "        # last-known-good ruleset store (docs/ROBUSTNESS.md "
+        "\"Guarded",
+        "        # rollout\"): packs that reach LIVE persist here; a "
+        "serve",
+        "        # container restarting mid-rollout prefers this "
+        "artifact over",
+        "        # the ConfigMap rules tree",
+        "        - name: ipt-lkg",
+        "          emptyDir: {}",
         "      containers:",
         "        - name: controller",
         "          image: %s" % v.image,
@@ -214,6 +224,8 @@ def render_deployment(v: DeployValues) -> str:
             "            - \"%d\"" % (v.http_port + i),
             "            - --spool-dir",
             "            - %s" % v.spool_dir,
+            "            - --lkg-dir",
+            "            - %s" % v.lkg_dir,
             "          env:",
             "            - {name: TPU_VISIBLE_CHIPS, value: \"%d\"}" % i,
             "          resources:",
@@ -240,6 +252,7 @@ def render_deployment(v: DeployValues) -> str:
             "            - {name: ipt-run, mountPath: /run/ipt}",
             "            - {name: ipt-rules, mountPath: /etc/ipt/rules}",
             "            - {name: ipt-spool, mountPath: %s}" % v.spool_dir,
+            "            - {name: ipt-lkg, mountPath: %s}" % v.lkg_dir,
         ]
     # postanalytics consolidator — shares the pod's spool emptyDir (a
     # separate Deployment could never see it; emptyDir is pod-local)

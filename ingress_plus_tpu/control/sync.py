@@ -25,6 +25,7 @@ per-tenant recompilation).
 from __future__ import annotations
 
 import json
+import time
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -70,6 +71,40 @@ class SyncResult:
     errors: List[str] = field(default_factory=list)
 
 
+#: dynamic-push retry policy: bounded exponential backoff per channel
+RETRY_BASE_S = 1.0
+RETRY_MAX_S = 60.0
+
+
+@dataclass
+class _PushChannel:
+    """Dirty-state tracking for one dynamic-push channel.
+
+    A failed push used to leave ``last_*`` stale and hope a later sync
+    re-diffed it — a push that kept failing was retried on EVERY tick
+    (no backoff against a struggling serve loop), and a push=False tick
+    in between silently marked it clean (the update was dropped until
+    the next unrelated diff).  Now the desired payload is pinned here
+    until it lands: every sync tick retries dirty channels whose backoff
+    has elapsed, with the LATEST payload, converging regardless of what
+    else changed in between."""
+
+    path: str
+    payload: object = None
+    dirty: bool = False
+    attempts: int = 0
+    next_retry: float = 0.0    # monotonic deadline for the next attempt
+
+    def mark(self, payload) -> None:
+        if self.dirty and payload != self.payload:
+            # intent changed mid-retry: push the NEW payload promptly —
+            # the old backoff was earned by a stale body
+            self.attempts = 0
+            self.next_retry = 0.0
+        self.payload = payload
+        self.dirty = True
+
+
 class SyncController:
     def __init__(self, global_config: Optional[GlobalConfig] = None,
                  serve_http: Optional[str] = None):
@@ -78,6 +113,11 @@ class SyncController:
         self.last_rendered: Optional[str] = None
         self.last_tenants: Optional[Dict[int, Tuple[str, ...]]] = None
         self.last_acls: Optional[dict] = None
+        self._channels: Dict[str, _PushChannel] = {
+            "tenants": _PushChannel("/configuration/tenants"),
+            "acl": _PushChannel("/configuration/acl"),
+        }
+        self._now = time.monotonic   # injectable clock (tests)
 
     def _post(self, path: str, obj) -> bool:
         url = "http://%s%s" % (self.serve_http, path)
@@ -89,10 +129,6 @@ class SyncController:
                 return 200 <= resp.status < 300
         except OSError:
             return False
-
-    def _push_tenants(self, tags: Dict[int, Tuple[str, ...]]) -> bool:
-        return self._post("/configuration/tenants",
-                          {str(t): list(v) for t, v in tags.items()})
 
     def _acl_payload(self, cfg: Configuration) -> dict:
         """wallarm-acl push body: ACL content from the ConfigMap tier
@@ -116,6 +152,35 @@ class SyncController:
                     "tenant %d: wallarm-acl %r has no list content" % (t, name))
         return {"acls": specs, "tenant_acl": binding}
 
+    def flush_pending(self) -> Dict[str, bool]:
+        """Attempt every dirty channel whose backoff has elapsed; the
+        retry half of the sync tick (also callable from a bare timer).
+        Returns {channel: landed} for the channels actually attempted."""
+        out: Dict[str, bool] = {}
+        now = self._now()
+        for name, ch in self._channels.items():
+            if not ch.dirty or now < ch.next_retry:
+                continue
+            ok = self._post(ch.path, ch.payload)
+            out[name] = ok
+            if ok:
+                ch.dirty = False
+                ch.attempts = 0
+                ch.next_retry = 0.0
+            else:
+                ch.attempts += 1
+                ch.next_retry = now + min(
+                    RETRY_BASE_S * (2 ** (ch.attempts - 1)), RETRY_MAX_S)
+        return out
+
+    def retry_state(self) -> Dict[str, dict]:
+        """Dirty/backoff snapshot per channel (status & tests)."""
+        now = self._now()
+        return {name: {"dirty": ch.dirty, "attempts": ch.attempts,
+                       "retry_in_s": round(max(ch.next_retry - now, 0.0), 3)
+                       if ch.dirty else 0.0}
+                for name, ch in self._channels.items()}
+
     def sync(self, ingresses: List[Ingress],
              configmap: Optional[ConfigMap] = None,
              push: bool = True) -> SyncResult:
@@ -134,27 +199,33 @@ class SyncController:
         else:
             action = "noop"
 
+        # diff → dirty channel (the desired payload is pinned on the
+        # channel until it LANDS, so a failed push keeps converging on
+        # subsequent ticks with bounded exponential backoff instead of
+        # waiting for the next unrelated diff)
+        if push:
+            if tags != self.last_tenants:
+                self._channels["tenants"].mark(
+                    {str(t): list(v) for t, v in tags.items()})
+            if acls != self.last_acls:
+                self._channels["acl"].mark(acls)
+        self.last_rendered = text
+        self.last_tenants = tags
+        self.last_acls = acls
+
         pushed = pushed_acls = False
         errors = []
-        if push and tags != self.last_tenants:
-            pushed = self._push_tenants(tags)
-            if not pushed:
-                # leave last_tenants stale so the next sync retries the
-                # push (a restarting serve loop must not be skipped as
-                # "noop" forever)
-                errors.append("tenant push to %s failed" % self.serve_http)
-        if push and acls != self.last_acls:
-            pushed_acls = self._post("/configuration/acl", acls)
-            if not pushed_acls:
-                errors.append("acl push to %s failed" % self.serve_http)
-        self.last_rendered = text
-        if push and not errors or not push:
-            self.last_tenants = tags
-            self.last_acls = acls
-        elif pushed:           # tenants landed, acls did not
-            self.last_tenants = tags
-        elif pushed_acls:      # acls landed, tenants did not
-            self.last_acls = acls
+        if push:
+            attempted = self.flush_pending()
+            pushed = attempted.get("tenants", False)
+            pushed_acls = attempted.get("acl", False)
+            for name, ok in attempted.items():
+                if not ok:
+                    ch = self._channels[name]
+                    errors.append(
+                        "%s push to %s failed (attempt %d, retry in %.0fs)"
+                        % (name, self.serve_http, ch.attempts,
+                           max(ch.next_retry - self._now(), 0.0)))
         return SyncResult(action=action, rendered=text, configuration=cfg,
                           pushed_tenants=pushed, pushed_acls=pushed_acls,
                           errors=list(cfg.errors)

@@ -58,6 +58,13 @@ class Verdict:
     #: served under brownout (prefilter-only ladder rung or admission
     #: shed): the verdict is best-effort — degraded verdicts never block
     degraded: bool = False
+    #: ruleset version that produced this verdict (dual-generation
+    #: accounting for the guarded rollout: during a canary ramp each
+    #: request is served by EXACTLY ONE generation, and the stamp is how
+    #: that invariant is asserted and how the shadow lane skips diffing
+    #: candidate-served verdicts against the candidate itself).  Empty on
+    #: fail-open/shed verdicts no generation ever scanned.
+    generation: str = ""
     elapsed_us: int = 0
     #: matched points for the attack export (wallarm "points" analog):
     #: up to 8 dicts {rule_id, var, value} — var is the SecLang variable
@@ -478,6 +485,7 @@ class DetectionPipeline:
         elapsed = int((time.perf_counter() - t0) * 1e6)
         for v in verdicts:
             v.elapsed_us = elapsed
+            v.generation = rs.version
         return verdicts
 
     def prefilter(self, requests: List[Request]) -> np.ndarray:
@@ -702,4 +710,5 @@ class DetectionPipeline:
         elapsed = int((time.perf_counter() - t0) * 1e6)
         for v in verdicts:
             v.elapsed_us = elapsed
+            v.generation = rs.version
         return verdicts

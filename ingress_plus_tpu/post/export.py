@@ -23,6 +23,7 @@ import os
 import random
 import threading
 import time
+import urllib.error
 import urllib.request
 from pathlib import Path
 from typing import Callable, List, Optional
@@ -240,6 +241,12 @@ class RulesetWatcher:
         self.current_version: Optional[str] = None
         self.swaps = 0
         self.errors = 0
+        # versions the serve loop REJECTED (guarded-rollout admission
+        # gate 4xx, control/rollout.py): re-pushing one would re-run the
+        # whole gate — compile smoke + corpus replay — every poll tick
+        # forever; a rejected pack stays skipped until a NEW artifact
+        # version appears
+        self.rejected_versions: set = set()
         self._poster = poster or self._http_post
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -273,14 +280,35 @@ class RulesetWatcher:
         except (OSError, json.JSONDecodeError):
             self.errors += 1
             return False
-        if version is None or version == self.current_version:
+        if version is None or version == self.current_version \
+                or version in self.rejected_versions:
             return False
         try:
             out = self._poster("/configuration/ruleset", {"path": str(art)})
+        except urllib.error.HTTPError as e:
+            self.errors += 1
+            if 400 <= e.code < 500 and e.code != 409:
+                # DETERMINISTIC rejection (admission gate / unloadable
+                # artifact): retrying every tick would re-run the whole
+                # gate forever — remember the version until a new
+                # artifact lands.  Transient refusals must stay
+                # retryable: 409 (no controller / conflict) and the
+                # 422 whose body says another rollout is in progress.
+                try:
+                    reason = json.loads(e.read() or b"{}").get("reason")
+                except Exception:
+                    reason = None
+                if reason != "rollout_in_progress":
+                    self.rejected_versions.add(version)
+            return False
         except Exception:
             self.errors += 1
             return False
-        self.current_version = out.get("ruleset", version)
+        # staged responses carry the CANDIDATE version ("candidate"/
+        # "staged"); force responses carry "ruleset".  Either way the
+        # push landed — don't re-push the same artifact next tick.
+        self.current_version = out.get("ruleset") \
+            or out.get("candidate") or version
         self.swaps += 1
         return True
 

@@ -303,6 +303,10 @@ class Batcher:
         self._watch_grace = 2.0 * hang_budget_s + hard_deadline_s + 1.0
         self._stop = threading.Event()
         self._swap_lock = threading.Lock()
+        # guarded-rollout controller (control/rollout.py), attached by
+        # the serve layer; None keeps the clean path at two attribute
+        # reads per cycle (docs/ROBUSTNESS.md "Guarded rollout")
+        self.rollout = None
         self._watchdog = threading.Thread(target=self._watch, daemon=True,
                                           name="ipt-watchdog")
         self._watchdog.start()
@@ -573,6 +577,12 @@ class Batcher:
         # the brownout ladder's pressure signal also spans swaps — a
         # reload under load must not reset the ladder to full detection
         new.load_controller = old.load_controller
+        # break-glass force swap during a staged rollout: the candidate
+        # generation is aborted (quarantined, reason exported) BEFORE the
+        # new pack installs — after the fault site and the build, so a
+        # swap that fails changes neither plane
+        if self.rollout is not None:
+            self.rollout.abort("force_swap")
         with self._swap_lock:
             # reload-drift snapshot (ISSUE 3): freeze the outgoing
             # version's per-rule counters at the instant it stops
@@ -628,6 +638,8 @@ class Batcher:
 
     def close(self) -> None:
         self._stop.set()
+        if self.rollout is not None:
+            self.rollout.close()
         self._thread.join(timeout=5)
         self._oversized_thread.join(timeout=5)
         self._watchdog.join(timeout=5)
@@ -743,6 +755,35 @@ class Batcher:
         p.stats.fail_open += len(requests)
         return [_fail_open_verdict(r.request_id) for r in requests]
 
+    def _detect_candidate(self, requests: List[Request], ro,
+                          route: str) -> List[Verdict]:
+        """Candidate-generation dispatch for the canary ramp
+        (control/rollout.py).  Rides the SAME watchdogged lane and
+        follows the cycle's breaker route (breaker open → the candidate
+        scans CPU-only too: a suspect device must not be probed by the
+        canary either) — but failures are attributed to the CANDIDATE:
+        they count toward the rollout's rollback triggers and NEVER
+        toward the shared breaker, so a bad candidate pack cannot push
+        the incumbent path onto its CPU fallback."""
+        cand = ro.candidate
+        if cand is None:
+            # rolled back between split and dispatch: serve these
+            # through the incumbent — the generation they now belong to
+            return self._detect_guarded(requests, route)
+        if route == "fallback":
+            return cand.detect_cpu_only(requests)
+        try:
+            return self._lane.call(
+                lambda: cand.detect_strict(requests), self.hang_budget_s)
+        except DeviceHang:
+            self.stats.hangs += 1
+            self._lane = _DeviceLane(self._lane.seq + 1)
+            ro.record_candidate_failure("hang")
+        except Exception:
+            ro.record_candidate_failure("error")
+        self.pipeline.stats.fail_open += len(requests)
+        return [_fail_open_verdict(r.request_id) for r in requests]
+
     def _run(self) -> None:
         while not self._stop.is_set():
             batch = self._drain()
@@ -795,6 +836,15 @@ class Batcher:
                         self._submit_oversized(ts, r, plan, fut)
                     else:
                         normal.append(item)
+                # canary generation split (control/rollout.py): during a
+                # ramp, the deterministic request-id hash sends this
+                # cycle's share of requests through the CANDIDATE
+                # pipeline instead — each request is served by exactly
+                # one generation; idle rollout = one attribute read
+                ro = self.rollout
+                cand_items: List = []
+                if ro is not None and ro.canary_active:
+                    normal, cand_items = ro.split(normal)
                 requests = [r for _, r, _ in normal]
                 if requests:
                     try:
@@ -805,6 +855,18 @@ class Batcher:
                     for (ts, r, fut), v in zip(normal, verdicts):
                         _safe_set(fut, v)
                         done.append((ts, r, v))
+                cand_verdicts: List[Verdict] = []
+                if cand_items:
+                    creqs = [r for _, r, _ in cand_items]
+                    try:
+                        cand_verdicts = self._detect_candidate(
+                            creqs, ro, route)
+                    except Exception:
+                        cand_verdicts = [_fail_open_verdict(r.request_id)
+                                         for r in creqs]
+                    for (ts, r, fut), v in zip(cand_items, cand_verdicts):
+                        _safe_set(fut, v)
+                        done.append((ts, r, v))
                 # end-delta sample, still under the lock (stats object
                 # survives hot-swaps; the side lane can't interleave)
                 ps = self.pipeline.stats
@@ -812,6 +874,17 @@ class Batcher:
                 d_confirm = ps.confirm_us - confirm_us0
                 d_prep = ps.prep_us - prep_us0
                 d_compiles = ps.engine_compiles - compiles0
+            # rollout hooks OFF the swap lock: shadow mirroring (never
+            # on the verdict path — the futures above already resolved),
+            # canary accounting, and the deferred-promotion pump (tick
+            # needs the swap lock the dispatch thread just released)
+            if ro is not None:
+                if ro.shadow_active:
+                    for _ts, r, v in done:
+                        ro.mirror(r, v)
+                if cand_items:
+                    ro.observe_canary(len(cand_items), cand_verdicts)
+                ro.tick()
             self._cycle_guard = None
             t_end = time.perf_counter()
             took = t_end - t0
@@ -856,6 +929,7 @@ class Batcher:
                 # this batch's spans — those ids resolve via their
                 # /debug/slow exemplar instead
                 request_ids=[r.request_id for _, r, _ in normal]
+                + [r.request_id for _, r, _ in cand_items]
                 + [h.request.request_id for h, _ in finish_verdicts])
             self.traces.record(trace)
             self._observe(trace, done, finish_verdicts, t0, t_end)

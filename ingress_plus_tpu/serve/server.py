@@ -444,6 +444,40 @@ class ServeLoop:
         lines += bounded_counter_series(
             "ipt_bucket_rows_total", "bucket",
             {str(k): v for k, v in dict(p.bucket_rows).items()})
+        # --- guarded rollout (control/rollout.py, docs/ROBUSTNESS.md):
+        # state machine gauge + per-phase counters.  Absent entirely
+        # when no controller is attached (library batchers).
+        ro = self.batcher.rollout
+        if ro is not None:
+            from ingress_plus_tpu.control.rollout import STATES
+            st = ro.status()
+            lines += [
+                "# TYPE ipt_rollout_state gauge",
+                "ipt_rollout_state %d" % STATES.index(st["state"]),
+                "# TYPE ipt_rollout_step gauge",
+                "ipt_rollout_step %d" % st["step"],
+                "# TYPE ipt_rollout_fraction gauge",
+                "ipt_rollout_fraction %s" % st["fraction"],
+                "# TYPE ipt_rollout_candidate_requests_total counter",
+                "ipt_rollout_candidate_requests_total %d"
+                % st["candidate_requests"],
+                "# TYPE ipt_rollout_shadow_mirrored_total counter",
+                "ipt_rollout_shadow_mirrored_total %d"
+                % st["shadow"]["mirrored"],
+                "# TYPE ipt_rollout_shadow_dropped_total counter",
+                "ipt_rollout_shadow_dropped_total %d"
+                % st["shadow"]["dropped"],
+                "# TYPE ipt_rollout_rollbacks_total counter",
+                "ipt_rollout_rollbacks_total %d" % st["rollbacks"],
+                "# TYPE ipt_rollout_promotions_total counter",
+                "ipt_rollout_promotions_total %d" % st["promotions"],
+            ]
+            lines.append("# TYPE ipt_rollout_diff_total counter")
+            lines += bounded_counter_series(
+                "ipt_rollout_diff_total", "kind", st["diff"])
+            lines.append("# TYPE ipt_swap_rejected_total counter")
+            lines += bounded_counter_series(
+                "ipt_swap_rejected_total", "reason", st["swap_rejected"])
         # stage-level latency attribution (ISSUE 1): one Prometheus
         # histogram per pipeline stage, so p50/p99 per stage are
         # scrapeable without external tooling (the reference gets this
@@ -753,28 +787,125 @@ class ServeLoop:
             tm = self.batcher.pipeline.tenant_rule_mask
             return "200 OK", "application/json", json.dumps(
                 {"tenants": 1 if tm is None else int(tm.shape[0])}).encode()
-        if path == "/configuration/ruleset" and method == "POST":
-            # hot-swap from a checkpoint artifact (sync-node† analog)
+        if path.startswith("/configuration/ruleset") and method == "POST":
+            # ruleset delivery (sync-node† analog).  With a rollout
+            # controller attached (production default) the pack goes
+            # through the GUARDED staged rollout — admission gate →
+            # shadow → canary ramp → LIVE (docs/ROBUSTNESS.md);
+            # ?mode=force keeps the one-shot swap for break-glass (and
+            # is the only semantics when no controller is attached).
+            from urllib.parse import parse_qs, urlsplit
             from ingress_plus_tpu.compiler.ruleset import CompiledRuleset
+            from ingress_plus_tpu.control.rollout import RolloutRejected
 
-            def _load_and_swap():
+            ro = self.batcher.rollout
+            q = parse_qs(urlsplit(path).query, keep_blank_values=True)
+            swap_mode = (q.get("mode")
+                         or ["staged" if ro is not None else "force"])[0]
+            if swap_mode not in ("staged", "force"):
+                return ("400 Bad Request", "application/json",
+                        json.dumps({"error": "mode must be staged|force"}
+                                   ).encode())
+            try:
                 spec = json.loads(payload or b"{}")
                 if not isinstance(spec, dict):
                     raise ValueError("payload must be a JSON object")
-                cr = CompiledRuleset.load(spec["path"])
+                art = str(spec["path"])
                 pl = spec.get("paranoia_level")
-                self.batcher.swap_ruleset(
-                    cr, paranoia_level=int(pl) if pl is not None else None)
+                pl = int(pl) if pl is not None else None
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                return ("400 Bad Request", "application/json",
+                        json.dumps({"error": str(e)}).encode())
+            if swap_mode == "staged" and ro is None:
+                # an EXPLICIT staged request must never silently get the
+                # ungated one-shot swap it asked to avoid
+                return ("409 Conflict", "application/json",
+                        json.dumps({"error": "staged rollout unavailable:"
+                                    " no rollout controller attached "
+                                    "(use ?mode=force)"}).encode())
+            if swap_mode == "staged":
+                # per-rollout knob overrides ride the push payload (the
+                # drill and cautious operators tighten/loosen per pack);
+                # validated inside admit() AFTER the in-progress check —
+                # a rejected concurrent push must not touch the active
+                # rollout's config
+                overrides = {k: spec[k]
+                             for k in ("steps", "step_min_requests",
+                                       "shadow_min_requests",
+                                       "shadow_sample") if k in spec}
+
+                def _admit():
+                    return ro.admit(artifact_path=art, paranoia_level=pl,
+                                    overrides=overrides)
+
+                try:
+                    report = await loop.run_in_executor(None, _admit)
+                except RolloutRejected as e:
+                    # a rejected pack changed NOTHING: structured 4xx
+                    # (stage, reason, artifact) + ipt_swap_rejected_total
+                    return ("422 Unprocessable Entity", "application/json",
+                            json.dumps({"rejected": True,
+                                        **e.report}).encode())
+                except (OSError, ValueError, TypeError) as e:
+                    return ("400 Bad Request", "application/json",
+                            json.dumps({"error": str(e)}).encode())
+                return "200 OK", "application/json", json.dumps(
+                    {"staged": True, **report}).encode()
+
+            # force / break-glass: today's one-shot swap.  A corrupt or
+            # unloadable checkpoint is a structured 4xx rejection (stage
+            # "load"), not a generic executor 500, and counts in
+            # ipt_swap_rejected_total{reason="load"}
+            def _load_and_swap():
+                try:
+                    cr = CompiledRuleset.load(art)
+                except Exception as e:
+                    raise RolloutRejected(
+                        "load", "load", art,
+                        {"error": "%s: %s" % (type(e).__name__, e)})
+                self.batcher.swap_ruleset(cr, paranoia_level=pl)
                 return cr
 
             try:
                 cr = await loop.run_in_executor(None, _load_and_swap)
-            except (KeyError, OSError, ValueError, TypeError,
-                    json.JSONDecodeError) as e:
+            except RolloutRejected as e:
+                if ro is not None:
+                    ro.count_rejected("load")
                 return ("400 Bad Request", "application/json",
-                        json.dumps({"error": str(e)}).encode())
+                        json.dumps({"rejected": True,
+                                    **e.report}).encode())
+            except (OSError, ValueError, TypeError) as e:
+                return ("400 Bad Request", "application/json",
+                        json.dumps({"error": str(e),
+                                    "stage": "swap"}).encode())
             return "200 OK", "application/json", json.dumps(
-                {"ruleset": cr.version, "rules": cr.n_rules}).encode()
+                {"ruleset": cr.version, "rules": cr.n_rules,
+                 "mode": "force"}).encode()
+        if path.startswith("/rollout"):
+            # guarded-rollout status / control (docs/ROBUSTNESS.md):
+            # GET = full state-machine status; POST {"action":"abort"}
+            # rolls an in-flight rollout back to the incumbent
+            ro = self.batcher.rollout
+            if ro is None:
+                return ("200 OK", "application/json",
+                        json.dumps({"enabled": False}).encode())
+            if method == "POST":
+                try:
+                    spec = json.loads(payload or b"{}")
+                    action = spec.get("action")
+                    if action != "abort":
+                        raise ValueError("action must be 'abort'")
+                except (ValueError, TypeError, AttributeError,
+                        json.JSONDecodeError) as e:
+                    return ("400 Bad Request", "application/json",
+                            json.dumps({"error": str(e)}).encode())
+                aborted = await loop.run_in_executor(
+                    None, lambda: ro.abort("manual"))
+                return ("200 OK", "application/json", json.dumps(
+                    {"aborted": aborted, **ro.status()}).encode())
+            return ("200 OK", "application/json", json.dumps(
+                {"enabled": True, **ro.status()}).encode())
         if path == "/configuration/acl" and method == "POST":
             # wallarm-acl push (no-reload lane): {"acls": {name: {allow:
             # [cidr], deny: [...], greylist: [...]}}, "tenant_acl":
@@ -865,15 +996,35 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
                           hard_deadline_s: float = 0.25,
                           hang_budget_s: float = 30.0,
                           breaker_failures: int = 3,
-                          breaker_cooldown_s: float = 5.0) -> Batcher:
+                          breaker_cooldown_s: float = 5.0,
+                          lkg_dir: Optional[str] = None,
+                          rollout_steps=None,
+                          rollout_fail_on: str = "error") -> Batcher:
     from ingress_plus_tpu.compiler.ruleset import compile_ruleset
     from ingress_plus_tpu.compiler.seclang import load_seclang_dir
     from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
     from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.control.rollout import (
+        RolloutConfig,
+        RolloutController,
+        load_lkg,
+    )
 
-    rules = (load_seclang_dir(rules_dir) if rules_dir
-             else load_bundled_rules())
-    cr = compile_ruleset(rules)
+    # crash recovery (docs/ROBUSTNESS.md "Guarded rollout"): prefer the
+    # last-known-good artifact — the last pack that actually SURVIVED
+    # traffic — over a possibly mid-rollout rules source.  A missing or
+    # corrupt LKG falls back to the configured source; serving starts
+    # either way.
+    cr = None
+    if lkg_dir:
+        cr = load_lkg(lkg_dir)
+        if cr is not None:
+            print("startup: serving last-known-good pack %s from %s"
+                  % (cr.version, lkg_dir), file=sys.stderr)
+    if cr is None:
+        rules = (load_seclang_dir(rules_dir) if rules_dir
+                 else load_bundled_rules())
+        cr = compile_ruleset(rules)
     engine = None
     if mesh_spec:
         # multi-chip serving: same batcher/pipeline/confirm, the scan
@@ -909,11 +1060,18 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
         # the detection-plane telemetry so /rules/* and the efficiency
         # gauges describe real traffic from request one
         pipeline.reset_detection_observations()
-    return Batcher(pipeline, max_batch=max_batch, max_delay_s=max_delay_s,
-                   hard_deadline_s=hard_deadline_s, queue_cap=queue_cap,
-                   hang_budget_s=hang_budget_s,
-                   breaker_failures=breaker_failures,
-                   breaker_cooldown_s=breaker_cooldown_s)
+    batcher = Batcher(pipeline, max_batch=max_batch, max_delay_s=max_delay_s,
+                      hard_deadline_s=hard_deadline_s, queue_cap=queue_cap,
+                      hang_budget_s=hang_budget_s,
+                      breaker_failures=breaker_failures,
+                      breaker_cooldown_s=breaker_cooldown_s)
+    # guarded-rollout controller: idle until an admit; makes STAGED the
+    # default semantics of /configuration/ruleset on this server
+    cfg = RolloutConfig(fail_on=rollout_fail_on, lkg_dir=lkg_dir)
+    if rollout_steps:
+        cfg.steps = tuple(rollout_steps)
+    batcher.rollout = RolloutController(batcher, cfg)
+    return batcher
 
 
 def warmup_pipeline(pipeline, max_batch: int) -> None:
@@ -1009,6 +1167,21 @@ def main(argv=None) -> None:
     ap.add_argument("--breaker-cooldown-s", type=float, default=5.0,
                     help="seconds the breaker stays open before a "
                          "half-open canary batch probes the device")
+    # guarded ruleset rollout (docs/ROBUSTNESS.md "Guarded rollout")
+    ap.add_argument("--lkg-dir", default=None,
+                    help="last-known-good pack directory: packs that "
+                         "reach LIVE are persisted here atomically, and "
+                         "startup prefers this artifact over "
+                         "--rules-dir (crash-during-rollout recovery)")
+    ap.add_argument("--rollout-steps", default="0.01,0.1,0.5,1.0",
+                    help="canary ramp fractions for staged ruleset "
+                         "rollouts (comma-separated, ending at 1.0)")
+    ap.add_argument("--rollout-fail-on", default="error",
+                    choices=["error", "warning", "notice", "info"],
+                    help="admission static-gate severity: a candidate "
+                         "pack with unsuppressed findings at or above "
+                         "this level is rejected before touching "
+                         "traffic")
     ap.add_argument("--faults", default=None,
                     help="deterministic fault plan, e.g. "
                          "'dispatch_hang:after=100,times=1,delay_s=5'; "
@@ -1038,7 +1211,11 @@ def main(argv=None) -> None:
         hard_deadline_s=args.hard_deadline_ms / 1e3,
         hang_budget_s=args.hang_budget_ms / 1e3,
         breaker_failures=args.breaker_failures,
-        breaker_cooldown_s=args.breaker_cooldown_s)
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        lkg_dir=args.lkg_dir,
+        rollout_steps=[float(s) for s in
+                       args.rollout_steps.split(",") if s.strip()],
+        rollout_fail_on=args.rollout_fail_on)
 
     post = None
     if args.spool_dir or args.export_url:

@@ -23,7 +23,18 @@ site                      injected where / what it does when it fires
                           next dispatches pay serve-time compiles, visible in
                           ``ipt_engine_recompiles_total``
 ``swap_fail``             ruleset hot-swap raises mid-swap — the outgoing
-                          pipeline must keep serving untouched
+                          pipeline must keep serving untouched.  Also
+                          armed at the guarded rollout's PROMOTE boundary
+                          (control/rollout.py): a promotion that dies must
+                          auto-roll back to the incumbent
+``shadow_diverge``        the rollout shadow lane books a synthetic
+                          new-block diff for the mirrored request — drives
+                          the verdict-diff rollback trigger without
+                          needing a genuinely divergent pack
+``lkg_corrupt``           ``load_lkg`` raises while reading the
+                          last-known-good pointer (torn/corrupt artifact)
+                          — startup must fall back to the configured
+                          rules source, never crash-loop
 ``export_5xx``            the post exporter's HTTP delivery raises (collector
                           returning 5xx) — exercises exponential backoff +
                           spool bounding
@@ -61,7 +72,8 @@ from typing import Dict, List, Optional
 #: the known injection sites (a spec naming anything else is rejected —
 #: a typo'd site would otherwise silently never fire)
 SITES = ("dispatch_hang", "dispatch_raise", "recompile_storm",
-         "swap_fail", "export_5xx", "slow_confirm")
+         "swap_fail", "export_5xx", "slow_confirm",
+         "shadow_diverge", "lkg_corrupt")
 
 
 class FaultError(RuntimeError):
@@ -442,8 +454,8 @@ def _scenario_swap_fail(install_plan) -> dict:
             violations.append("failed swap mutated the serving pipeline")
         vs, viol = _collect([b.submit(r) for r in
                              _requests(8, attack_every=4, tag="s0")], 30)
+        _check_verdicts(vs, viol, 8)   # appends into viol: fold after
         violations += viol
-        _check_verdicts(vs, viol, 8)
         if not any(v.attack for v in vs):
             violations.append("old ruleset stopped detecting after the "
                               "failed swap")
@@ -534,6 +546,160 @@ def _scenario_slow_confirm(install_plan) -> dict:
         b.close()
 
 
+# ------------------------------------------- guarded-rollout scenarios
+# (control/rollout.py, docs/ROBUSTNESS.md "Guarded rollout").  The
+# shared invariant: a fault in ANY rollout phase leaves the INCUMBENT
+# generation serving and every admitted request still resolves to
+# exactly one verdict.
+
+
+def _rollout_fixtures():
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.control.rollout import (
+        _DRILL_CANDIDATE,
+        _DRILL_INCUMBENT,
+        _drill_config,
+        RolloutController,
+    )
+
+    cr_inc = compile_ruleset(parse_seclang(_DRILL_INCUMBENT))
+    cr_cand = compile_ruleset(parse_seclang(_DRILL_CANDIDATE))
+    b = _mk_batcher(cr=cr_inc)
+    ro = RolloutController(b, _drill_config())
+    b.rollout = ro
+    return b, ro, cr_inc, cr_cand
+
+
+def _drive_rollout(b, ro, terminal, violations, waves: int = 40):
+    """Push traffic until the rollout reaches a terminal state; every
+    future must resolve (the exactly-one-verdict leg rides here)."""
+    deadline = time.monotonic() + 60
+    wave = 0
+    while ro.state not in terminal and time.monotonic() < deadline:
+        futs = [b.submit(r) for r in _requests(24, attack_every=4,
+                                               tag="ro%d" % wave)]
+        _verdicts, viol = _collect(futs, timeout_s=30)
+        violations.extend(viol)
+        wave += 1
+        if wave > waves:
+            break
+
+
+def _check_incumbent_serving(b, cr_inc, violations, tag: str) -> None:
+    if b.pipeline.ruleset.version != cr_inc.version:
+        violations.append("incumbent generation not serving (%s)"
+                          % b.pipeline.ruleset.version)
+    vs, viol = _collect([b.submit(r) for r in
+                         _requests(8, attack_every=4, tag=tag)], 30)
+    # _check_verdicts appends into viol: it must run BEFORE viol is
+    # folded into the scenario's violations, or its findings are lost
+    _check_verdicts(vs, viol, 8)
+    violations.extend(viol)
+    if not any(v.attack and not v.fail_open for v in vs):
+        violations.append("incumbent lost detection after the fault")
+
+
+def _scenario_rollout_promote_fail(install_plan) -> dict:
+    """swap_fail armed at the PROMOTE phase boundary: the candidate
+    clears shadow + canary, then the final install raises — the rollout
+    must auto-roll back, the incumbent keeps serving, nothing strands."""
+    b, ro, cr_inc, cr_cand = _rollout_fixtures()
+    violations: List[str] = []
+    try:
+        ro.admit(ruleset=cr_cand)
+        install_plan(FaultPlan.from_spec("swap_fail:times=1"))
+        from ingress_plus_tpu.control.rollout import LIVE, ROLLED_BACK
+        _drive_rollout(b, ro, (LIVE, ROLLED_BACK), violations)
+        if ro.state != ROLLED_BACK:
+            violations.append("promote-boundary fault did not roll back "
+                              "(state=%s)" % ro.state)
+        if not ro.rollback_reason.startswith("promote_failed"):
+            violations.append("rollback reason %r does not attribute the "
+                              "promote fault" % ro.rollback_reason)
+        _check_incumbent_serving(b, cr_inc, violations, "rpf")
+        return {"ok": not violations, "violations": violations,
+                "state": ro.state, "reason": ro.rollback_reason}
+    finally:
+        b.close()
+
+
+def _scenario_rollout_shadow_diverge(install_plan) -> dict:
+    """Injected shadow divergence: the candidate 'blocks' mirrored
+    requests the incumbent passed — the verdict-diff trigger must kill
+    the rollout while the incumbent never stops serving."""
+    b, ro, cr_inc, cr_cand = _rollout_fixtures()
+    violations: List[str] = []
+    try:
+        ro.admit(ruleset=cr_cand)
+        install_plan(FaultPlan.from_spec("shadow_diverge:times=100"))
+        from ingress_plus_tpu.control.rollout import (
+            LIVE,
+            ROLLED_BACK,
+        )
+        _drive_rollout(b, ro, (LIVE, ROLLED_BACK), violations)
+        if ro.state != ROLLED_BACK:
+            violations.append("shadow divergence did not roll back "
+                              "(state=%s)" % ro.state)
+        if ro.rollback_reason != "verdict_diff":
+            violations.append("expected verdict_diff trigger, got %r"
+                              % ro.rollback_reason)
+        if ro.diff.get("new_block", 0) < 1:
+            violations.append("diff counters never accumulated")
+        _check_incumbent_serving(b, cr_inc, violations, "rsd")
+        return {"ok": not violations, "violations": violations,
+                "diff": dict(ro.diff)}
+    finally:
+        b.close()
+
+
+def _scenario_lkg_corrupt(install_plan) -> dict:
+    """Corrupt last-known-good store at startup: load_lkg must return
+    None (fall back to the configured rules source), never raise — and
+    once the fault clears, the persisted pack loads intact."""
+    import tempfile
+
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.control.rollout import (
+        _DRILL_INCUMBENT,
+        load_lkg,
+        persist_lkg,
+    )
+
+    violations: List[str] = []
+    with tempfile.TemporaryDirectory(prefix="ipt-lkg-") as d:
+        cr = compile_ruleset(parse_seclang(_DRILL_INCUMBENT))
+        persist_lkg(cr, d)
+        install_plan(FaultPlan.from_spec("lkg_corrupt:times=1"))
+        try:
+            got = load_lkg(d)
+        except Exception as e:  # noqa: BLE001 — the violation we test for
+            violations.append("corrupt LKG raised %s instead of falling "
+                              "back" % type(e).__name__)
+            got = None
+        if got is not None:
+            violations.append("lkg_corrupt fault never fired")
+        # fallback serving: the configured pack still serves verdicts
+        b = _mk_batcher(cr=cr)
+        try:
+            vs, viol = _collect([b.submit(r) for r in
+                                 _requests(8, attack_every=4, tag="lk")], 30)
+            _check_verdicts(vs, viol, 8)   # before folding: it appends
+            violations.extend(viol)
+            if not any(v.attack for v in vs):
+                violations.append("fallback pack lost detection")
+        finally:
+            b.close()
+        # fault exhausted: the LKG store is intact and loads
+        again = load_lkg(d)
+        if again is None or again.version != cr.version:
+            violations.append("LKG store did not survive the corrupt "
+                              "read (loaded %s)"
+                              % (again.version if again else None))
+    return {"ok": not violations, "violations": violations}
+
+
 SCENARIOS = {
     "overload_burst": _scenario_overload,
     "dispatch_hang": _scenario_dispatch_hang,
@@ -542,6 +708,9 @@ SCENARIOS = {
     "swap_fail": _scenario_swap_fail,
     "export_5xx": _scenario_export_5xx,
     "slow_confirm": _scenario_slow_confirm,
+    "rollout_promote_fail": _scenario_rollout_promote_fail,
+    "rollout_shadow_diverge": _scenario_rollout_shadow_diverge,
+    "lkg_corrupt": _scenario_lkg_corrupt,
 }
 
 

@@ -230,6 +230,92 @@ def test_sync_reload_dynamic_noop_transitions():
     assert r4.action == "dynamic"
 
 
+def test_sync_failed_push_retries_with_bounded_backoff():
+    """ISSUE 5 satellite: a failed dynamic push must not wait for the
+    next unrelated diff — the dirty channel retries on subsequent sync
+    ticks with bounded exponential backoff until it converges."""
+    sc = SyncController()
+    clock = [1000.0]
+    sc._now = lambda: clock[0]
+    posts = []
+    fail_first = [3]   # endpoint down for the first 3 attempts
+
+    def flaky(path, obj):
+        posts.append((clock[0], path, obj))
+        if fail_first[0] > 0:
+            fail_first[0] -= 1
+            return False
+        return True
+
+    sc._post = flaky
+    # pin the acl channel clean: this test isolates the tenants channel
+    # (the acl payload of these ingresses is the empty default)
+    sc.last_acls = {"acls": {}, "tenant_acl": {}}
+    ings = [ing(annotations={"wallarm-mode": "block",
+                             "detection-backend": "tpu",
+                             "detection-rule-tags": "attack-sqli"})]
+    r1 = sc.sync(ings)
+    assert not r1.pushed_tenants
+    assert any("retry in" in e for e in r1.errors)
+    st = sc.retry_state()
+    assert st["tenants"]["dirty"] and st["tenants"]["attempts"] == 1
+    # same inputs, backoff NOT elapsed: no wire attempt (bounded retry,
+    # not a hammer), and the action honestly reads noop
+    n_posts = len(posts)
+    r2 = sc.sync(ings)
+    assert r2.action == "noop" and len(posts) == n_posts
+
+    # ticks across elapsing backoffs: attempts 2 and 3 fail and the
+    # wait grows exponentially; attempt 4 lands and clears the channel
+    waits = []
+    for _ in range(3):
+        before = sc._channels["tenants"].next_retry
+        clock[0] = before + 0.01
+        r = sc.sync(ings)
+        waits.append(sc._channels["tenants"].next_retry - clock[0])
+        if r.pushed_tenants:
+            break
+    assert sc.retry_state()["tenants"]["dirty"] is False
+    assert sc.retry_state()["tenants"]["attempts"] == 0
+    # backoff grew while it was failing (1s, 2s, 4s ladder)
+    assert waits[0] > 1.9 and waits[1] > 3.9
+    # the payload that finally landed is the tenant table
+    assert posts[-1][1] == "/configuration/tenants"
+    assert any("attack-sqli" in str(v) for v in posts[-1][2].values())
+
+    # a NEW diff while dirty resets the backoff and pushes the LATEST
+    # payload promptly
+    fail_first[0] = 1
+    ings2 = [ing(annotations={"wallarm-mode": "block",
+                              "detection-backend": "tpu",
+                              "detection-rule-tags": "attack-xss"})]
+    sc.sync(ings2)              # fails, channel dirty again
+    assert sc.retry_state()["tenants"]["dirty"]
+    ings3 = [ing(annotations={"wallarm-mode": "block",
+                              "detection-backend": "tpu",
+                              "detection-rule-tags": "attack-lfi"})]
+    clock[0] += 0.1             # well inside the pending backoff
+    r = sc.sync(ings3)          # intent changed -> immediate retry
+    assert r.pushed_tenants
+    assert any("attack-lfi" in str(v) for v in posts[-1][2].values())
+
+
+def test_sync_backoff_is_bounded():
+    from ingress_plus_tpu.control.sync import RETRY_MAX_S
+
+    sc = SyncController()
+    clock = [0.0]
+    sc._now = lambda: clock[0]
+    sc._post = lambda path, obj: False
+    ch = sc._channels["tenants"]
+    ch.mark({"1": ["x"]})
+    for _ in range(12):
+        clock[0] = ch.next_retry
+        sc.flush_pending()
+    assert ch.next_retry - clock[0] <= RETRY_MAX_S
+    assert ch.dirty and ch.attempts == 12
+
+
 def test_ruleset_checkpoint_roundtrips_tags(tmp_path):
     cr = compile_ruleset(parse_seclang(RULES))
     cr.save(tmp_path / "art")

@@ -1,0 +1,689 @@
+"""Guarded ruleset rollout (control/rollout.py, docs/ROBUSTNESS.md).
+
+Covers the ISSUE 5 acceptance criteria: the admission gate rejects bad
+packs with zero traffic impact, a good pack reaches LIVE through
+shadow + canary while concurrent batch AND streaming traffic observes
+exactly one verdict from exactly one generation, a mid-canary failure
+auto-rolls back to the untouched incumbent, LIVE packs persist to the
+last-known-good store and startup prefers (and survives corruption of)
+that store, and ``force`` mode keeps the one-shot break-glass swap.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+
+from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.control.rollout import (
+    _DRILL_BROKEN,
+    _DRILL_CANDIDATE,
+    _DRILL_INCUMBENT,
+    CANARY,
+    LIVE,
+    REJECTED,
+    ROLLED_BACK,
+    SHADOW,
+    RolloutConfig,
+    RolloutController,
+    RolloutRejected,
+    _hash_frac,
+    load_lkg,
+    persist_lkg,
+    run_swap_drill,
+)
+from ingress_plus_tpu.serve.normalize import Request
+from ingress_plus_tpu.utils.faults import _collect, _mk_batcher, _requests
+
+
+@pytest.fixture(scope="module")
+def packs():
+    return {
+        "inc": compile_ruleset(parse_seclang(_DRILL_INCUMBENT)),
+        "cand": compile_ruleset(parse_seclang(_DRILL_CANDIDATE)),
+        "broken": compile_ruleset(parse_seclang(_DRILL_BROKEN)),
+        "overblock": compile_ruleset(parse_seclang(_DRILL_INCUMBENT + """
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)drop\\s+table" \
+    "id:955200,phase:2,block,severity:CRITICAL,tag:'attack-sqli'"
+""")),
+        # candidate MISSING the sqli rule: golden attacks the incumbent
+        # catches become false negatives -> new_fns gate
+        "lossy": compile_ruleset(parse_seclang("""
+SecRule REQUEST_URI|ARGS "@rx (?i)<script" \
+    "id:941100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-xss'"
+""")),
+    }
+
+
+def _fast_config(lkg_dir=None, **kw):
+    cfg = RolloutConfig(steps=(0.25, 1.0), step_min_requests=8,
+                        shadow_min_requests=4, shadow_sample=1.0,
+                        corpus_n=32, diff_min_compared=4, lkg_dir=lkg_dir)
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _rollout_batcher(packs, lkg_dir=None, **cfg_kw):
+    b = _mk_batcher(cr=packs["inc"])
+    ro = RolloutController(b, _fast_config(lkg_dir, **cfg_kw))
+    b.rollout = ro
+    return b, ro
+
+
+def _drive(b, ro, terminal, tag="d", timeout_s=60.0):
+    verdicts, violations = [], []
+    deadline = time.monotonic() + timeout_s
+    wave = 0
+    while ro.state not in terminal and time.monotonic() < deadline:
+        futs = [b.submit(r) for r in _requests(24, attack_every=4,
+                                               tag="%s%d" % (tag, wave))]
+        vs, viol = _collect(futs, timeout_s=30)
+        verdicts += vs
+        violations += viol
+        wave += 1
+    assert not violations, violations
+    return verdicts
+
+
+# ---------------------------------------------------------- unit layer
+
+def test_hash_frac_deterministic_and_bounded():
+    vals = [_hash_frac("req-%d" % i) for i in range(500)]
+    assert vals == [_hash_frac("req-%d" % i) for i in range(500)]
+    assert all(0.0 <= v < 1.0 for v in vals)
+    # roughly uniform: a 25% step should take a nontrivial share
+    frac = sum(1 for v in vals if v < 0.25) / len(vals)
+    assert 0.1 < frac < 0.4
+
+
+def test_admission_rejects_broken_pack_zero_traffic_impact(packs):
+    b, ro = _rollout_batcher(packs)
+    try:
+        v0 = b.pipeline.ruleset.version
+        with pytest.raises(RolloutRejected) as ei:
+            ro.admit(ruleset=packs["broken"])
+        assert ei.value.report["stage"] == "static"
+        assert ei.value.report["reason"] == "rulecheck"
+        # the dead-regex finding is named in the structured report
+        checks = {f["check"] for f in ei.value.report["detail"]["findings"]}
+        assert "regex.confirm-unparsable" in checks
+        assert ro.state == REJECTED
+        assert ro.swap_rejected.get("rulecheck") == 1
+        # zero traffic impact: incumbent untouched and still detecting
+        assert b.pipeline.ruleset.version == v0
+        vs, viol = _collect(
+            [b.submit(r) for r in _requests(8, attack_every=4, tag="z")], 30)
+        assert not viol and any(v.attack for v in vs)
+    finally:
+        b.close()
+
+
+def test_admission_rejects_overblocking_pack_on_benign_fixtures(packs):
+    """A candidate that blocks benign traffic the incumbent passes (the
+    SQL-in-prose fixtures) must die in the replay gate."""
+    b, ro = _rollout_batcher(packs)
+    try:
+        with pytest.raises(RolloutRejected) as ei:
+            ro.admit(ruleset=packs["overblock"])
+        assert ei.value.report["stage"] == "replay"
+        assert ei.value.report["reason"] == "benign_blocks"
+        assert ei.value.report["detail"]["benign_new_blocks"] > 0
+    finally:
+        b.close()
+
+
+def test_admission_rejects_detection_loss(packs):
+    # a larger replay corpus: the loss gate needs golden attacks the
+    # incumbent actually catches (union-select templates) in the sample
+    b, ro = _rollout_batcher(packs, corpus_n=256)
+    try:
+        with pytest.raises(RolloutRejected) as ei:
+            ro.admit(ruleset=packs["lossy"])
+        assert ei.value.report["stage"] == "replay"
+        assert ei.value.report["reason"] == "new_fns"
+        assert ei.value.report["detail"]["new_fns"] > 0
+    finally:
+        b.close()
+
+
+def test_admission_rejects_already_live_and_concurrent(packs):
+    b, ro = _rollout_batcher(packs)
+    try:
+        with pytest.raises(RolloutRejected) as ei:
+            ro.admit(ruleset=packs["inc"])
+        assert ei.value.report["reason"] == "already_live"
+        ro.admit(ruleset=packs["cand"])
+        assert ro.state == SHADOW
+        with pytest.raises(RolloutRejected) as ei:
+            ro.admit(ruleset=packs["cand"])
+        assert ei.value.report["reason"] == "rollout_in_progress"
+    finally:
+        b.close()
+
+
+# ----------------------------------------------------- staged rollout
+
+def test_staged_rollout_reaches_live_under_concurrent_load(packs):
+    """The tentpole e2e: staged rollout driven while concurrent batch
+    AND streaming-body traffic is in flight — every admitted request
+    resolves to exactly one verdict from exactly one generation, stream
+    bodies pin their generation across the promote, and the incumbent's
+    counters freeze into the drift snapshot."""
+    b, ro = _rollout_batcher(packs)
+    inc_v = packs["inc"].version
+    cand_v = packs["cand"].version
+    stop = threading.Event()
+    results, errors = [], []
+    lock = threading.Lock()
+
+    def worker(wid):
+        wave = 0
+        while not stop.is_set():
+            futs = [b.submit(r) for r in
+                    _requests(16, attack_every=4,
+                              tag="w%d.%d." % (wid, wave))]
+            vs, viol = _collect(futs, timeout_s=30)
+            with lock:
+                results.extend(vs)
+                errors.extend(viol)
+            wave += 1
+
+    try:
+        ro.admit(ruleset=packs["cand"])
+        # a stream begun on the incumbent, fed across the whole rollout
+        h = b.begin_stream(Request(uri="/post", request_id="pinned-stream"))
+        b.feed_chunk(h, b"1 uni")
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 60
+        while ro.state not in (LIVE, REJECTED, ROLLED_BACK) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert ro.state == LIVE, (ro.state, ro.rollback_reason)
+        assert b.pipeline.ruleset.version == cand_v
+        assert not errors, errors[:5]
+        # exactly one generation per verdict; scanned verdicts only ever
+        # name the two known generations
+        gens = {v.generation for v in results if v.generation}
+        assert gens <= {inc_v, cand_v}, gens
+        assert any(v.generation == cand_v for v in results)
+        # the stream pinned its generation: fed across the promote, it
+        # must NOT mix tables — finish fails open on the version check
+        b.feed_chunk(h, b"on select 2")
+        sv = b.finish_stream(h).result(timeout=30)
+        assert sv.fail_open and not sv.blocked
+        # drift freeze: the incumbent's stats froze at promote
+        assert b.pipeline.frozen_rule_stats is not None
+        assert b.pipeline.frozen_rule_stats.version == inc_v
+        # post-promote detection serves from the candidate pack
+        vs, viol = _collect(
+            [b.submit(r) for r in _requests(8, attack_every=4, tag="p")], 30)
+        assert not viol
+        hits = [v for v in vs if v.attack]
+        assert hits and all(v.generation == cand_v for v in hits)
+    finally:
+        stop.set()
+        b.close()
+
+
+def test_midcanary_rollback_restores_incumbent(packs, tmp_path):
+    b, ro = _rollout_batcher(packs, lkg_dir=str(tmp_path))
+    inc_v = packs["inc"].version
+    try:
+        ro.admit(ruleset=packs["cand"])
+        _drive(b, ro, (CANARY, LIVE, REJECTED, ROLLED_BACK), tag="c")
+        assert ro.state == CANARY, ro.state
+        # forced mid-canary failure (the rollback trigger the drill and
+        # the batcher's guarded candidate dispatch both feed)
+        ro.record_candidate_failure("test_forced")
+        assert ro.state == ROLLED_BACK
+        assert ro.rollback_reason == "candidate_dispatch_failures"
+        assert ro.rollbacks == 1
+        # incumbent serving, counters/drift state untouched (no swap
+        # ever happened, so there is no frozen generation)
+        assert b.pipeline.ruleset.version == inc_v
+        assert b.pipeline.frozen_rule_stats is None
+        vs, viol = _collect(
+            [b.submit(r) for r in _requests(12, attack_every=4, tag="rb")],
+            30)
+        assert not viol
+        hits = [v for v in vs if v.attack]
+        assert hits and all(v.generation == inc_v for v in hits)
+        # the failed pack is quarantined with the reason
+        qfiles = list((tmp_path / "quarantine").glob("*.json"))
+        assert qfiles
+        q = json.loads(qfiles[0].read_text())
+        assert q["version"] == packs["cand"].version
+        assert "candidate_dispatch_failures" in q["reason"]
+        # canary routing is off: new traffic is incumbent-only
+        assert not ro.canary_active and not ro.shadow_active
+    finally:
+        b.close()
+
+
+def test_rollback_triggers_confirm_errors_and_diff(packs):
+    """The trigger matrix: candidate confirm-error spike and live
+    verdict-diff each independently force a rollback."""
+    b, ro = _rollout_batcher(packs)
+    try:
+        ro.admit(ruleset=packs["cand"])
+        # synthetic confirm-error spike on the candidate generation
+        ro.candidate.rule_stats.confirm_errors[0] = 3
+        ro._evaluate()
+        assert ro.state == ROLLED_BACK
+        assert ro.rollback_reason == "confirm_error_spike"
+    finally:
+        b.close()
+    b, ro = _rollout_batcher(packs)
+    try:
+        ro.admit(ruleset=packs["cand"])
+        ro.shadow_compared = 100
+        ro.diff["new_block"] = 50
+        ro._evaluate()
+        assert ro.state == ROLLED_BACK
+        assert ro.rollback_reason == "verdict_diff"
+    finally:
+        b.close()
+
+
+def test_candidate_carries_acl_and_tenant_state(packs):
+    """A canary must enforce the SAME ACLs and tenant rule subsets as
+    the incumbent — a rollout must never un-deny a blocked source or
+    widen a tenant's rule set mid-ramp."""
+    b, ro = _rollout_batcher(packs)
+    try:
+        b.set_tenant_tags({1: ("attack-xss",)})
+        live = b.pipeline
+        live.acl_store.swap({"edge": {"deny": ["203.0.113.0/24"]}})
+        live.tenant_acl = {0: "edge"}
+        live.default_acl = "edge"
+        ro.admit(ruleset=packs["cand"])
+        cand = ro.candidate
+        assert cand.acl_store is live.acl_store      # live pushes apply
+        assert cand.tenant_acl == live.tenant_acl
+        assert cand.default_acl == "edge"
+        # tenant masks re-derived against the CANDIDATE rule axis
+        assert cand.tenant_rule_mask is not None
+        assert cand.tenant_rule_mask.shape == (2, packs["cand"].n_rules)
+        assert cand.tenant_rule_mask[1].sum() == 1   # xss-only tenant
+    finally:
+        b.close()
+
+
+def test_override_validation_and_no_mutation_on_concurrent_admit(packs):
+    from ingress_plus_tpu.control.rollout import validate_overrides
+
+    with pytest.raises(ValueError):
+        validate_overrides({"steps": [0.5, 0.2]})      # not ascending
+    with pytest.raises(ValueError):
+        validate_overrides({"steps": [0.5]})           # doesn't end at 1
+    with pytest.raises(ValueError):
+        validate_overrides({"steps": ["x"]})
+    with pytest.raises(ValueError):
+        validate_overrides({"step_min_requests": 0})
+    with pytest.raises(ValueError):
+        validate_overrides({"nope": 1})
+    assert validate_overrides({"steps": [0.5, 1.0]}) == \
+        {"steps": (0.5, 1.0)}
+
+    b, ro = _rollout_batcher(packs)
+    try:
+        ro.admit(ruleset=packs["cand"])
+        steps0 = ro.config.steps
+        # a concurrent admit is rejected BEFORE its overrides touch the
+        # active rollout's config (a shorter steps list reaching
+        # split() would kill the dispatch thread)
+        with pytest.raises(RolloutRejected) as ei:
+            ro.admit(ruleset=packs["broken"], overrides={"steps": [1.0]})
+        assert ei.value.report["reason"] == "rollout_in_progress"
+        assert ro.config.steps == steps0
+        assert ro.state == SHADOW
+    finally:
+        b.close()
+
+
+def test_mirror_skips_unscanned_and_degraded_verdicts(packs):
+    """An incumbent fail-open/degraded verdict was never fully scanned:
+    diffing it against the candidate would book the candidate's CORRECT
+    blocks as divergence and roll back a good pack because the
+    INCUMBENT lane faulted."""
+    from ingress_plus_tpu.models.pipeline import Verdict
+
+    b, ro = _rollout_batcher(packs)
+    try:
+        ro.admit(ruleset=packs["cand"])
+        req = Request(uri="/x", request_id="m1")
+        fo = Verdict(request_id="m1", blocked=False, attack=False,
+                     classes=[], rule_ids=[], score=0, fail_open=True)
+        ro.mirror(req, fo)
+        deg = Verdict(request_id="m1", blocked=False, attack=False,
+                      classes=[], rule_ids=[], score=0, degraded=True,
+                      generation=packs["inc"].version)
+        ro.mirror(req, deg)
+        assert ro.shadow_mirrored == 0 and ro._shadow_q.qsize() == 0
+        full = Verdict(request_id="m1", blocked=False, attack=False,
+                       classes=[], rule_ids=[], score=0,
+                       generation=packs["inc"].version)
+        ro.mirror(req, full)
+        assert ro.shadow_mirrored == 1
+    finally:
+        b.close()
+
+
+def test_overrides_do_not_leak_into_next_rollout(packs):
+    b, ro = _rollout_batcher(packs)
+    try:
+        base_steps = ro.config.steps
+        ro.admit(ruleset=packs["cand"],
+                 overrides={"steps": [1.0], "step_min_requests": 2})
+        assert ro.config.steps == (1.0,)
+        ro.abort("test")
+        # next rollout (no overrides): back to the attached defaults
+        ro.admit(ruleset=packs["cand"])
+        assert ro.config.steps == base_steps
+        assert ro.config.step_min_requests == 8
+    finally:
+        b.close()
+
+
+def test_shadow_lane_is_budget_capped(packs):
+    """Acceptance: shadow work can never starve the CPU plane — a zero
+    CPU budget means every mirrored request is DROPPED (counted), never
+    queued unboundedly or scanned; the verdict path is untouched."""
+    b, ro = _rollout_batcher(packs, shadow_cpu_budget=0.0)
+    try:
+        ro.admit(ruleset=packs["cand"])
+        vs, viol = _collect(
+            [b.submit(r) for r in _requests(48, attack_every=4, tag="bg")],
+            30)
+        assert not viol and len(vs) == 48   # verdict path unaffected
+        deadline = time.monotonic() + 10
+        while ro.shadow_dropped == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ro.shadow_dropped > 0
+        assert ro.shadow_compared == 0      # nothing scanned over budget
+        assert ro.state == SHADOW           # and the rollout just waits
+        # the mirror queue itself is bounded: flooding it synchronously
+        # can never block the caller or grow past the cap
+        for i in range(2 * ro.config.shadow_queue_cap):
+            ro.mirror(Request(uri="/x", request_id="flood-%d" % i), vs[0])
+        assert ro._shadow_q.qsize() <= ro.config.shadow_queue_cap
+    finally:
+        b.close()
+
+
+# ------------------------------------------------- last-known-good
+
+def test_lkg_persist_load_roundtrip_and_corruption(packs, tmp_path):
+    persist_lkg(packs["inc"], tmp_path)
+    got = load_lkg(tmp_path)
+    assert got is not None and got.version == packs["inc"].version
+    # newer pack replaces the pointer atomically; old pack retired
+    persist_lkg(packs["cand"], tmp_path)
+    assert load_lkg(tmp_path).version == packs["cand"].version
+    # corrupt pointer → None (startup falls back, never raises)
+    (tmp_path / "LKG").write_text("{not json")
+    assert load_lkg(tmp_path) is None
+    # pointer naming a missing artifact (crash mid-persist) → None
+    (tmp_path / "LKG").write_text(json.dumps({"artifact": "pack-gone"}))
+    assert load_lkg(tmp_path) is None
+    assert load_lkg(tmp_path / "never-created") is None
+
+
+def test_promote_persists_lkg_and_restart_prefers_it(packs, tmp_path):
+    """Crash-recovery acceptance: a pack that reaches LIVE lands in the
+    LKG store, and a 'restarted server' (the build-time preference
+    logic) serves it over the configured rules source."""
+    b, ro = _rollout_batcher(packs, lkg_dir=str(tmp_path))
+    try:
+        ro.admit(ruleset=packs["cand"])
+        _drive(b, ro, (LIVE, REJECTED, ROLLED_BACK), tag="lk")
+        assert ro.state == LIVE
+    finally:
+        b.close()
+    # "restart": startup prefers the LKG artifact (the pack that
+    # survived traffic) over the mid-rollout rules source
+    recovered = load_lkg(tmp_path)
+    assert recovered is not None
+    assert recovered.version == packs["cand"].version
+    nb = _mk_batcher(cr=recovered)
+    try:
+        vs, viol = _collect(
+            [nb.submit(r) for r in _requests(8, attack_every=4, tag="rs")],
+            30)
+        assert not viol and any(v.attack for v in vs)
+        assert nb.pipeline.ruleset.version == packs["cand"].version
+    finally:
+        nb.close()
+
+
+# ------------------------------------------------- serve-plane layer
+
+@pytest.fixture()
+def serve_stack(packs, tmp_path):
+    from ingress_plus_tpu.serve.server import ServeLoop
+
+    b, ro = _rollout_batcher(packs, lkg_dir=str(tmp_path / "lkg"))
+    serve = ServeLoop(b, str(tmp_path / "ipt.sock"))
+    yield serve, b, ro, tmp_path
+    b.close()
+
+
+def _route(serve, method, path, payload=b""):
+    status, _ctype, body = asyncio.run(
+        serve._route_http(method, path, payload))
+    return status, json.loads(body)
+
+
+def test_endpoint_staged_default_and_rejection(serve_stack, packs):
+    serve, b, ro, tmp_path = serve_stack
+    art = tmp_path / "broken"
+    packs["broken"].save(art)
+    v0 = b.pipeline.ruleset.version
+    status, body = _route(serve, "POST", "/configuration/ruleset",
+                          json.dumps({"path": str(art)}).encode())
+    assert status.startswith("422"), (status, body)
+    assert body["rejected"] and body["stage"] == "static"
+    assert body["artifact"] == str(art)
+    assert b.pipeline.ruleset.version == v0
+    # the rejection is a metric
+    metrics = serve._metrics_text()
+    assert 'ipt_swap_rejected_total{reason="rulecheck"} 1' in metrics
+    assert "ipt_rollout_state" in metrics
+
+
+def test_endpoint_corrupt_artifact_structured_load_rejection(serve_stack):
+    serve, _b, ro, tmp_path = serve_stack
+    art = tmp_path / "garbage"
+    art.with_suffix(".npz").write_bytes(b"not an npz")
+    art.with_suffix(".json").write_text("{}")
+    # force mode: previously a generic executor error — now a structured
+    # 4xx naming the stage and artifact, counted by reason="load"
+    status, body = _route(
+        serve, "POST", "/configuration/ruleset?mode=force",
+        json.dumps({"path": str(art)}).encode())
+    assert status.startswith("400"), (status, body)
+    assert body["stage"] == "load" and body["reason"] == "load"
+    assert body["artifact"] == str(art)
+    assert ro.swap_rejected.get("load") == 1
+    assert 'ipt_swap_rejected_total{reason="load"} 1' \
+        in serve._metrics_text()
+
+
+def test_endpoint_force_mode_keeps_oneshot_swap(serve_stack, packs):
+    serve, b, _ro, tmp_path = serve_stack
+    art = tmp_path / "cand"
+    packs["cand"].save(art)
+    status, body = _route(
+        serve, "POST", "/configuration/ruleset?mode=force",
+        json.dumps({"path": str(art)}).encode())
+    assert status.startswith("200"), body
+    assert body["ruleset"] == packs["cand"].version
+    assert body["mode"] == "force"
+    # one-shot: the pack is serving IMMEDIATELY, no ramp
+    assert b.pipeline.ruleset.version == packs["cand"].version
+
+
+def test_endpoint_rollout_status_and_abort(serve_stack, packs):
+    serve, b, ro, tmp_path = serve_stack
+    status, body = _route(serve, "GET", "/rollout")
+    assert status.startswith("200") and body["enabled"]
+    assert body["state"] == "idle"
+    art = tmp_path / "cand"
+    packs["cand"].save(art)
+    status, body = _route(
+        serve, "POST", "/configuration/ruleset",
+        json.dumps({"path": str(art), "step_min_requests": 4,
+                    "shadow_min_requests": 2}).encode())
+    assert status.startswith("200"), body
+    assert body["staged"] and body["state"] == "shadow"
+    assert body["replay"]["new_fns"] == 0
+    status, body = _route(serve, "GET", "/rollout")
+    assert body["state"] == "shadow" and body["candidate"]
+    # operator abort rolls back to the incumbent
+    status, body = _route(serve, "POST", "/rollout",
+                          json.dumps({"action": "abort"}).encode())
+    assert status.startswith("200") and body["aborted"]
+    assert body["state"] == "rolled_back"
+    assert b.pipeline.ruleset.version == packs["inc"].version
+    # bad action → 400
+    status, _body = _route(serve, "POST", "/rollout",
+                           json.dumps({"action": "nope"}).encode())
+    assert status.startswith("400")
+
+
+def test_force_swap_aborts_active_rollout(serve_stack, packs):
+    serve, b, ro, tmp_path = serve_stack
+    ro.admit(ruleset=packs["cand"])
+    assert ro.state == SHADOW
+    art = tmp_path / "cand2"
+    packs["cand"].save(art)
+    status, body = _route(
+        serve, "POST", "/configuration/ruleset?mode=force",
+        json.dumps({"path": str(art)}).encode())
+    assert status.startswith("200"), body
+    assert ro.state == ROLLED_BACK
+    assert ro.rollback_reason == "force_swap"
+    assert b.pipeline.ruleset.version == packs["cand"].version
+
+
+def test_dbg_rollout_renderer(serve_stack, packs):
+    from ingress_plus_tpu.control.dbg import render_rollout
+
+    serve, _b, ro, _tmp = serve_stack
+    ro.admit(ruleset=packs["cand"])
+    _status, body = _route(serve, "GET", "/rollout")
+    out = render_rollout(body)
+    assert "rollout: shadow" in out
+    assert packs["cand"].version in out
+    assert render_rollout({"enabled": False}).startswith("no rollout")
+
+
+def test_endpoint_staged_without_controller_is_409(packs, tmp_path):
+    """An EXPLICIT ?mode=staged against a batcher with no rollout
+    controller must refuse — never silently fall through to the
+    ungated one-shot swap the caller asked to avoid."""
+    from ingress_plus_tpu.serve.server import ServeLoop
+
+    b = _mk_batcher(cr=packs["inc"])        # rollout stays None
+    try:
+        serve = ServeLoop(b, str(tmp_path / "ipt.sock"))
+        art = tmp_path / "cand"
+        packs["cand"].save(art)
+        status, body = _route(
+            serve, "POST", "/configuration/ruleset?mode=staged",
+            json.dumps({"path": str(art)}).encode())
+        assert status.startswith("409"), (status, body)
+        assert b.pipeline.ruleset.version == packs["inc"].version
+        # bad override values are a 400, not a dead dispatch thread
+        b.rollout = RolloutController(b, _fast_config())
+        status, body = _route(
+            serve, "POST", "/configuration/ruleset",
+            json.dumps({"path": str(art), "steps": [0.5]}).encode())
+        assert status.startswith("400"), (status, body)
+        assert "steps" in body["error"]
+    finally:
+        b.close()
+
+
+def test_watcher_remembers_rejected_versions(packs, tmp_path):
+    """RulesetWatcher satellite: a pack the admission gate rejected
+    (deterministic 4xx) is not re-pushed — and so not re-gated, corpus
+    replay and all — every poll tick forever."""
+    import urllib.error
+
+    from ingress_plus_tpu.post.export import RulesetWatcher
+
+    art = tmp_path / "pack"
+    packs["cand"].save(art)
+    calls = []
+
+    def rejecting_poster(path, payload):
+        calls.append(path)
+        raise urllib.error.HTTPError(path, 422, "rejected", {}, None)
+
+    w = RulesetWatcher(str(tmp_path), "127.0.0.1:1", poster=rejecting_poster)
+    assert w.check_once() is False
+    assert len(calls) == 1
+    assert packs["cand"].version in w.rejected_versions
+    # same artifact, next tick: skipped without a wire attempt
+    assert w.check_once() is False
+    assert len(calls) == 1
+    # a NEW artifact version is still tried
+    art2 = tmp_path / "pack2"
+    packs["inc"].save(art2)
+    import os
+    os.utime(art2.with_suffix(".json"),
+             (time.time() + 5, time.time() + 5))
+    w.check_once()
+    assert len(calls) == 2
+
+
+def test_watcher_retries_transient_rejections(packs, tmp_path):
+    """A 422 whose body says another rollout is in progress (and any
+    409) is TRANSIENT — the artifact must stay retryable, or a pack
+    published mid-rollout would silently never ship."""
+    import io
+    import urllib.error
+
+    from ingress_plus_tpu.post.export import RulesetWatcher
+
+    art = tmp_path / "pack"
+    packs["cand"].save(art)
+    calls = []
+
+    def busy_poster(path, payload):
+        calls.append(path)
+        body = json.dumps({"rejected": True, "stage": "admission",
+                           "reason": "rollout_in_progress"}).encode()
+        raise urllib.error.HTTPError(path, 422, "busy", {},
+                                     io.BytesIO(body))
+
+    w = RulesetWatcher(str(tmp_path), "127.0.0.1:1", poster=busy_poster)
+    assert w.check_once() is False
+    assert not w.rejected_versions       # transient: not blacklisted
+    assert w.check_once() is False       # ... and re-attempted next tick
+    assert len(calls) == 2
+
+
+# ------------------------------------------------------ the CI drill
+
+def test_swap_drill_gate(tmp_path):
+    """The swapdrill CI gate end to end: good pack → LIVE, dirty pack →
+    REJECTED with zero traffic impact, forced mid-canary failure →
+    ROLLED_BACK — exactly-one-verdict throughout."""
+    rep = run_swap_drill(lkg_dir=str(tmp_path))
+    assert rep["passed"], json.dumps(rep, indent=2, default=str)
+    drills = rep["drills"]
+    assert drills["good_pack_to_live"]["state"] == "live"
+    assert drills["broken_pack_rejected"]["state"] == "rejected"
+    assert drills["mid_canary_rollback"]["state"] == "rolled_back"

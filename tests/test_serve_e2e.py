@@ -349,8 +349,13 @@ def test_configuration_endpoints_and_dbg(server, tmp_path):
         'SecRule ARGS "@rx (?i)drop\\s+table" '
         '"id:955000,phase:2,block,severity:CRITICAL,tag:\'attack-sqli\'"'))
     cr.save(art)
+    # --force: this asserts the ONE-SHOT swap lane (break-glass).  The
+    # default is now the guarded staged rollout (control/rollout.py) —
+    # and it would correctly REJECT this pack: a bare "drop table" rule
+    # blocks the benign SQL-in-prose fixtures (tests/test_rollout.py
+    # covers the staged path end to end).
     rc = dbg.main(["ruleset", "--server", "127.0.0.1:19901",
-                   "--swap", str(art)])
+                   "--swap", str(art), "--force"])
     assert rc == 0
     conf = json.loads(urllib.request.urlopen(
         "http://127.0.0.1:19901/configuration", timeout=10).read())

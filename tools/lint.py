@@ -22,10 +22,18 @@ Four gates, one verdict:
   faultmatrix the fail-safe serve plane (docs/ROBUSTNESS.md): a real
              CPU batcher runs under every deterministic FaultPlan
              scenario (dispatch_hang/raise, recompile_storm, swap_fail,
-             export_5xx, slow_confirm) plus a synthetic overload burst;
-             the invariant "every admitted request gets exactly one
-             verdict, and no fault becomes an unhandled exception or a
-             block" must hold, the breaker must trip and recover
+             export_5xx, slow_confirm, plus the rollout-phase faults
+             shadow_diverge/lkg_corrupt/promote-boundary swap_fail) and
+             a synthetic overload burst; the invariant "every admitted
+             request gets exactly one verdict, and no fault becomes an
+             unhandled exception or a block" must hold, the breaker
+             must trip and recover
+  swapdrill  the guarded-rollout state machine (docs/ROBUSTNESS.md
+             "Guarded rollout"): a known-good pack is driven through
+             the full staged rollout to LIVE, a rulecheck-dirty pack
+             (dead-regex fixture) to REJECTED with zero traffic
+             impact, and a forced mid-canary failure auto-rolls back
+             to the incumbent — exactly-one-verdict throughout
 
 The container policy is "no new installs": when ruff or mypy are not
 present, those gates report SKIPPED (recorded in the CI report so the
@@ -52,7 +60,8 @@ if str(REPO) not in sys.path:  # script execution puts tools/ first
 MYPY_SCOPE = ["ingress_plus_tpu/compiler", "ingress_plus_tpu/analysis",
               "ingress_plus_tpu/serve",
               "ingress_plus_tpu/models/rule_stats.py",
-              "ingress_plus_tpu/post/topk.py"]
+              "ingress_plus_tpu/post/topk.py",
+              "ingress_plus_tpu/control/rollout.py"]
 
 
 def _tool_available(module: str, binary: str) -> bool:
@@ -194,13 +203,46 @@ def run_faultmatrix(write_report: bool) -> dict:
     return result
 
 
+def run_swapdrill(write_report: bool) -> dict:
+    """Guarded-rollout gate (ISSUE 5): the rollout state machine proven
+    on a real CPU batcher — good pack to LIVE, dirty pack REJECTED with
+    zero traffic impact, forced mid-canary failure ROLLED_BACK — with
+    the exactly-one-verdict invariant held throughout."""
+    t0 = time.time()
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+    from ingress_plus_tpu.control.rollout import run_swap_drill
+
+    report = run_swap_drill()
+    failed = {name: r["violations"]
+              for name, r in report["drills"].items()
+              if "ok" in r and not r["ok"]}
+    result = {
+        "status": "OK" if report["passed"] else "FAIL",
+        "seconds": round(time.time() - t0, 2),
+        "drills": {name: r["ok"] for name, r in report["drills"].items()
+                   if "ok" in r},
+        "detail": "; ".join("%s: %s" % (n, "; ".join(v))
+                            for n, v in failed.items()) or
+                  "good pack LIVE, dirty pack REJECTED, mid-canary "
+                  "fault ROLLED_BACK — one verdict per request held",
+    }
+    if write_report:
+        out = REPO / "reports" / "SWAPDRILL.json"
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(report, indent=2, default=str) + "\n")
+        result["report"] = str(out.relative_to(REPO))
+    return result
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tools/lint.py")
     ap.add_argument("--ci", action="store_true",
                     help="CI mode: also write reports/RULECHECK.json")
     ap.add_argument("--only",
                     choices=["ruff", "mypy", "rulecheck", "deadrules",
-                             "faultmatrix"],
+                             "faultmatrix", "swapdrill"],
                     default=None)
     args = ap.parse_args(argv)
 
@@ -215,6 +257,8 @@ def main(argv=None) -> int:
         gates["deadrules"] = run_dead_rules()
     if args.only in (None, "faultmatrix"):
         gates["faultmatrix"] = run_faultmatrix(write_report=args.ci)
+    if args.only in (None, "swapdrill"):
+        gates["swapdrill"] = run_swapdrill(write_report=args.ci)
 
     failed = False
     for name, r in gates.items():
