@@ -40,7 +40,16 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def probe_backend(timeouts=(60, 90, 120, 120), waits=(30, 45, 60)):
+#: process-level probe verdict cache: r03–r05 each burned 120–180s PER
+#: RETRY on a hung backend init, and a CPU-retry bench run (or the
+#: pack-scale leg) re-probed the same dead tunnel.  One verdict per
+#: process is enough — a tunnel that comes back mid-run helps nobody
+#: once the executables are compiled for CPU.
+_PROBE_CACHE: "tuple[str, str | None] | None" = None
+
+
+def probe_backend(timeouts=(60, 90, 120), waits=(20, 40),
+                  total_budget_s: float = 210.0):
     """Decide which backend to use WITHOUT risking the parent process.
 
     Round-1 failure modes of the axon (remote-TPU-tunnel) backend, both
@@ -50,39 +59,55 @@ def probe_backend(timeouts=(60, 90, 120, 120), waits=(30, 45, 60)):
     runs ``jax.devices()`` in a THROWAWAY SUBPROCESS under a hard timeout;
     the parent only initializes a backend after the verdict is known.
 
+    The ladder is CAPPED at ``total_budget_s`` wall-clock (an attempt
+    only starts if it can finish inside the cap) and the verdict is
+    cached for the process: a dead tunnel costs its timeout once, not
+    once per leg/retry (r03–r05 burned 120–180s per retry re-probing
+    the same outage).
+
     Returns (platform, error_string_or_None) and, on TPU failure, forces
     the parent's platform to CPU so the bench still produces a number.
     """
+    global _PROBE_CACHE
+    if _PROBE_CACHE is not None:
+        log("TPU probe verdict cached: %s" % (_PROBE_CACHE,))
+        return _PROBE_CACHE
     from ingress_plus_tpu.utils.platform import probe_backend_once
 
-    # the ladder's worst case (525s) nearly fills the 540s budget, and
+    # the ladder's worst case nearly fills the watchdog budget, and
     # jax + module imports already ran inside the armed window — re-arm
     # here so the final probe attempt cannot be killed by the watchdog
     _arm_watchdog()
+    t_start = time.time()
     last_err = "unknown"
     for attempt, tmo in enumerate(timeouts):
         if attempt:
-            # spread retries across the full watchdog budget (VERDICT
-            # round-3 item 1a): the r01-r03 hangs were transient tunnel
-            # states lasting minutes — probes land at t≈0/90/225/405s of
-            # the 540s budget (worst case 525s), so an outage that
-            # clears mid-bench still gets a live chip.
             wait = waits[min(attempt - 1, len(waits) - 1)]
+            if time.time() - t_start + wait + tmo > total_budget_s:
+                log("TPU probe ladder stopped: %.0fs cap reached"
+                    % total_budget_s)
+                break
+            # spread retries across the probe budget (VERDICT round-3
+            # item 1a): the r01-r03 hangs were transient tunnel states —
+            # an outage that clears mid-bench still gets a live chip
             log("TPU probe retry %d/%d in %ds (last: %s)"
                 % (attempt, len(timeouts) - 1, wait, last_err[:200]))
             time.sleep(wait)
         plat, err = probe_backend_once(tmo)
         if plat is not None:
             if plat == "cpu":
-                return "cpu", None  # no TPU plugin on this machine at all
+                _PROBE_CACHE = ("cpu", None)  # no TPU plugin at all
+                return _PROBE_CACHE
             log("TPU probe ok (%s, %.0fs timeout headroom)" % (plat, tmo))
-            return plat, None
+            _PROBE_CACHE = (plat, None)
+            return _PROBE_CACHE
         last_err = err
     log("TPU backend unavailable; falling back to CPU (last: %s)" % last_err[:300])
     from ingress_plus_tpu.utils.platform import force_cpu_devices
 
     force_cpu_devices(1)
-    return "cpu", "tpu-unavailable: %s" % last_err[:300]
+    _PROBE_CACHE = ("cpu", "tpu-unavailable: %s" % last_err[:300])
+    return _PROBE_CACHE
 
 
 def _widen_k(timed, d_lo: float, d_hi: float, it: int, tag: str,
@@ -112,9 +137,15 @@ def load_fixed_pack():
     to exactly the pack BENCH_r03 measured — 1405 rules / 1233 factors /
     343 scan words — so a throughput number on it is comparable across
     rounds regardless of how the live pack grows (r04's 2.4x CPU drop
-    was unattributable because only the current pack was measured)."""
+    was unattributable because only the current pack was measured).
+
+    Compiled with ``ReductionConfig.off()``: the frozen leg must keep
+    producing the BIT-IDENTICAL legacy tables r03 measured — the
+    approximate reduction (compiler/reduce.py) applies to the live pack
+    only, so the fixed leg keeps isolating code drift from pack size."""
     import importlib.util
 
+    from ingress_plus_tpu.compiler.reduce import ReductionConfig
     from ingress_plus_tpu.compiler.ruleset import compile_ruleset
     from ingress_plus_tpu.compiler.seclang import load_seclang_dir
 
@@ -125,13 +156,227 @@ def load_fixed_pack():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     rules = load_seclang_dir(os.path.join(fix, "crs"))
-    return compile_ruleset(rules + mod.generate_signature_rules())
+    return compile_ruleset(rules + mod.generate_signature_rules(),
+                           reduction=ReductionConfig.off())
 
 
 #: BENCH_r03.json's measured CPU anchor on this frozen pack (scan_impl
 #: pair, 2048-req corpus) — the cross-round comparison point
 R03_REFERENCE = {"req_per_s": 5013.3, "platform": "cpu",
                  "scan_impl": "pair"}
+
+
+def bucket_rows_np(data_list, req_list, sv_list, n_sv, edges):
+    """The ONE L-tier bucket/pad/row_sv assembly (numpy) shared by the
+    live-pack, fixed-pack and PACKSCALE legs — mirrors
+    DetectionPipeline.prefilter's bucketing so every leg measures the
+    geometry the serving path actually dispatches (review finding:
+    hand-synced copies of this drifted between legs once already)."""
+    from ingress_plus_tpu.ops.scan import pad_rows
+
+    bks: dict = {}
+    for i, d in enumerate(data_list):
+        for edge in edges:
+            if len(d) <= edge or edge == edges[-1]:
+                bks.setdefault(edge, []).append(i)
+                break
+    out = []
+    for edge, idxs in sorted(bks.items()):
+        rws = [data_list[i][:edge] for i in idxs]
+        tokens, lengths = pad_rows(rws, max_len=edge, round_to=edge)
+        row_sv = np.zeros((len(rws), n_sv), np.int8)
+        for j, i in enumerate(idxs):
+            row_sv[j, sv_list[i]] = 1
+        out.append((edge, tokens, lengths,
+                    np.asarray([req_list[i] for i in idxs], np.int32),
+                    row_sv))
+    return out
+
+
+def fused_map_fold(tabs, matches, bufs, n_req: int):
+    """Concatenate per-bucket sticky match words and run the
+    factor→rule mapping ONCE — the shared core of every detect_k
+    variant (docs/SCAN_KERNEL.md single-mapping contract; review
+    finding: three near-copies of this fold risked drifting from the
+    serving path).  Traced inside jit."""
+    import jax.numpy as jnp
+
+    from ingress_plus_tpu.models.engine import map_match_words
+
+    rule_hits, _, _ = map_match_words(
+        tabs, jnp.concatenate(matches, axis=0),
+        jnp.concatenate([b[2] for b in bufs]),
+        jnp.concatenate([b[3] for b in bufs]), n_req)
+    return rule_hits
+
+
+def run_pack_scale(scales=(0.5, 1.0, 1.5, 2.0), n_req: int = 1024,
+                   out_path: str | None = None) -> dict:
+    """PACKSCALE leg: compile synthetic packs at multiples of the
+    bundled CRS-shaped ruleset (compiler/packgen.py growth model),
+    measure fused-pair detect throughput per point, and write
+    reports/PACKSCALE.json.  The 2x point is the pack-size-invariance
+    gate: with interning + shared-prefix merging + budgeted reduction
+    (docs/SCAN_KERNEL.md), 2x rules must cost < 1.5x throughput — a
+    superlinear curve is warned about LOUDLY, never silently recorded.
+
+    Per point the candidate inflation of the reduced tables over an
+    exact compile is MEASURED on a corpus row sample (the budget is a
+    model; the measurement is the truth the acceptance gate reads)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ingress_plus_tpu.compiler.packgen import scale_rules
+    from ingress_plus_tpu.compiler.reduce import (
+        ReductionConfig,
+        measure_inflation,
+    )
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+    from ingress_plus_tpu.models.engine import EngineTables
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.ops.scan import scan_pairs
+    from ingress_plus_tpu.serve.normalize import merge_rows, rows_for_requests
+    from ingress_plus_tpu.utils.corpus import generate_corpus
+    from ingress_plus_tpu.utils.microbench import best_time
+
+    base = load_bundled_rules()
+    corpus = generate_corpus(n=n_req, attack_fraction=0.2, seed=42)
+    requests = [lr.request for lr in corpus]
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def detect_k(k: int, tabs, bufs):
+        W = tabs.scan.n_words
+
+        def body(i, carry):
+            acc, states = carry
+            matches = []
+            for (tok, lens, rreq, rsv), match in zip(bufs, states):
+                match, _ = scan_pairs(tabs.scan, tok, lens, None, match)
+                matches.append(match)
+                acc = acc + match.sum()
+            rule_hits = fused_map_fold(tabs, matches, bufs, n_req)
+            return (acc + rule_hits.sum().astype(jnp.uint32),
+                    tuple(matches))
+
+        states = tuple(jnp.zeros((b[0].shape[0], W), jnp.uint32)
+                       for b in bufs)
+        acc, _ = jax.lax.fori_loop(
+            0, k, body, (jnp.zeros((), jnp.uint32), states))
+        return acc
+
+    points = []
+    sample_rows = None
+    for scale in scales:
+        if _budget_left() < 60:
+            log("PACKSCALE: %.0fs budget left — stopping before %sx"
+                % (_budget_left(), scale))
+            break
+        t0 = time.time()
+        rules_s = scale_rules(base, scale)
+        cr = compile_ruleset(rules_s)
+        cr_exact = compile_ruleset(
+            rules_s, reduction=ReductionConfig.off())
+        pipe = DetectionPipeline(cr)
+        rows = rows_for_requests(requests, needed_sv=pipe.needed_sv)
+        data_list, req_list, sv_list = merge_rows(rows)
+        if sample_rows is None:
+            sample_rows = data_list[:512]
+        infl = measure_inflation(cr_exact.tables, cr.tables, sample_rows)
+        n_sv = cr.rule_sv_mask.shape[1]
+        bufs = tuple(
+            (jax.device_put(tokens.astype(np.int32)),
+             jax.device_put(lengths), jax.device_put(rreq),
+             jax.device_put(row_sv))
+            for _edge, tokens, lengths, rreq, row_sv in bucket_rows_np(
+                data_list, req_list, sv_list, n_sv,
+                DetectionPipeline.L_BUCKETS))
+        tables = EngineTables.from_ruleset(cr)
+
+        def timed(kk: int) -> float:
+            return best_time(
+                lambda k2, rep: detect_k(k2, tables, bufs), kk, n=4)
+
+        # the 2x sublinearity gate sits near 1.5x, so each point needs a
+        # LOW-variance estimate: best-of-4 and a K-diff of at least ~1s
+        # of pure compute before we accept the number (run-to-run noise
+        # on a busy 1-core host flipped the gate at a 0.2s target)
+        d_lo = timed(1)
+        it = max(5, min(65, int(max(15.0, _budget_left() * 0.12)
+                                / (5 * max(d_lo, 1e-4)))))
+        d_hi = timed(it)
+        while (d_hi - d_lo < 1.0 and it < 257
+               and 5 * (d_lo + it * max((d_hi - d_lo) / (it - 1), 1e-6))
+               < _budget_left() * 0.3):
+            it *= 2
+            log("[packscale-%sx] widening K to %d (diff %.0f ms)"
+                % (scale, it, (d_hi - d_lo) * 1e3))
+            d_hi = timed(it)
+        delta = d_hi - d_lo
+        rps = n_req / (delta / (it - 1)) if delta > 0.05 else None
+        point = {
+            "scale": scale,
+            "rules": int(cr.n_rules),
+            "factors": int(cr.tables.n_factors),
+            "words": int(cr.tables.n_words),
+            "head_words": int(cr.tables.n_head_words),
+            "factors_exact": int(cr_exact.tables.n_factors),
+            "words_exact": int(cr_exact.tables.n_words),
+            "req_per_s": round(rps, 1) if rps else None,
+            "candidate_inflation": infl,
+            "reduction": cr.reduction,
+            "compile_s": round(time.time() - t0, 1),
+        }
+        points.append(point)
+        log("PACKSCALE %.1fx: %d rules -> %d words (%d exact), "
+            "%s req/s, inflation %s, lost=%d"
+            % (scale, point["rules"], point["words"], point["words_exact"],
+               point["req_per_s"], infl["inflation"],
+               infl["lost_candidates"]))
+        if infl["lost_candidates"]:
+            log("PACKSCALE ERROR: reduced pack LOST %d candidates at "
+                "%.1fx — the reduction is UNSOUND, fix before shipping"
+                % (infl["lost_candidates"], scale))
+
+    result = {"metric": "req/s vs pack scale (fused pair detect step, "
+                        "%d-req corpus, CPU-or-live backend)" % n_req,
+              "points": points}
+    one = next((p for p in points if p["scale"] == 1.0
+                and p["req_per_s"]), None)
+    two = next((p for p in points if p["scale"] == 2.0
+                and p["req_per_s"]), None)
+    if one and two:
+        slowdown = one["req_per_s"] / two["req_per_s"]
+        result["scale_2x"] = {
+            "rules_ratio": round(two["rules"] / one["rules"], 3),
+            "slowdown": round(slowdown, 3),
+            "sublinear": slowdown < 1.5,
+        }
+        if slowdown >= 1.5:
+            log("=" * 64)
+            log("PACKSCALE WARNING: SUPERLINEAR SCALING — 2x rules cost "
+                "%.2fx throughput (gate: < 1.5x).  The pack-size-"
+                "invariance claim does NOT hold on this build/host."
+                % slowdown)
+            log("=" * 64)
+        else:
+            log("PACKSCALE: 2x rules -> %.2fx slowdown (sublinear, "
+                "gate < 1.5x)" % slowdown)
+    else:
+        log("PACKSCALE WARNING: missing 1x/2x points — the scaling "
+            "curve is INCOMPLETE this round (budget or signal loss); "
+            "the sublinearity gate was NOT evaluated")
+    if out_path is None:
+        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "reports", "PACKSCALE.json")
+    try:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        log("PACKSCALE written to %s" % out_path)
+    except OSError as e:
+        log("PACKSCALE write failed (non-fatal): %r" % (e,))
+    return result
 
 
 def run_bench(force_cpu_err: str | None = None) -> dict:
@@ -146,7 +391,6 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
     from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
     from ingress_plus_tpu.models.engine import EngineTables
     from ingress_plus_tpu.models.pipeline import DetectionPipeline
-    from ingress_plus_tpu.ops.scan import pad_rows
     from ingress_plus_tpu.serve.normalize import merge_rows, rows_for_requests
     from ingress_plus_tpu.utils.corpus import generate_corpus
     from ingress_plus_tpu.utils.microbench import best_time, k_diff_time
@@ -198,30 +442,19 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
         ONE buffer-building path shared by the live-pack and fixed-pack
         legs (review finding: a copy diverging between legs would skew
         exactly the cross-round comparability the fixed leg exists
-        for)."""
-        n_sv_x = cr_x.rule_sv_mask.shape[1]
-        bks: dict = {}
-        for i, d in enumerate(dat):
-            for edge in edges:
-                if len(d) <= edge or edge == edges[-1]:
-                    bks.setdefault(edge, []).append(i)
-                    break
+        for); the numpy assembly itself is bucket_rows_np, shared with
+        the PACKSCALE leg too."""
         bufs = []
-        for edge, idxs in sorted(bks.items()):
-            rws = [dat[i][:edge] for i in idxs]
-            tokens, lengths = pad_rows(rws, max_len=edge, round_to=edge)
-            row_sv = np.zeros((len(rws), n_sv_x), np.int8)
-            for j, i in enumerate(idxs):
-                row_sv[j, svs[i]] = 1
+        for edge, tokens, lengths, rreq, row_sv in bucket_rows_np(
+                dat, req_ids, svs, cr_x.rule_sv_mask.shape[1], edges):
             bufs.append((
                 jax.device_put(tokens.astype(np.int32)),
                 jax.device_put(lengths),
-                jax.device_put(np.asarray([req_ids[i] for i in idxs],
-                                          np.int32)),
+                jax.device_put(rreq),
                 jax.device_put(row_sv),
             ))
             if verbose:
-                log("bucket %4dB: %d rows" % (edge, len(rws)))
+                log("bucket %4dB: %d rows" % (edge, tokens.shape[0]))
         return tuple(bufs)
 
     n_sv = cr.rule_sv_mask.shape[1]
@@ -229,7 +462,7 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
     device_buckets = build_device_buckets(cr, data_list, req_list,
                                           sv_list, verbose=True)
 
-    from ingress_plus_tpu.models.engine import detect_rows, map_match_words
+    from ingress_plus_tpu.models.engine import detect_rows
 
     scanner = scanner2 = None
     if platform != "cpu":
@@ -254,12 +487,19 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
         one scan implementation (VERDICT round-1: the serving/bench path
         must measure pair vs take vs pallas, not assume).
 
+        Fused mapping (docs/SCAN_KERNEL.md, the serving path's
+        detect_device_multi shape): every bucket scans at its own
+        (B, L), the sticky match words concatenate, and the factor→rule
+        mapping — the one stage whose cost scales with rule count — runs
+        ONCE per batch instead of once per bucket.
+
         VERDICT round-2 item 1a: ``tabs`` and ``bufs`` are jit ARGUMENTS,
         not closure constants.  Closing over the device buckets made the
         whole scan chain (constant tokens -> constant match words ->
         segment_max scatter) compile-time constant, and XLA spent 2x33s
         constant-folding the scatter-max (BENCH_r02 tail).  As traced
         parameters nothing can fold and compiles stay in seconds."""
+        from ingress_plus_tpu.ops.scan import scan_bytes, scan_pairs
 
         @functools.partial(jax.jit, static_argnames=("k",))
         def detect_k(k: int, tabs, bufs):
@@ -267,38 +507,35 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
 
             # The returned value must depend on EVERY bucket's work, or
             # XLA's while-loop DCE deletes untouched loop-carry chains and
-            # the benchmark times a fraction of the workload.
+            # the benchmark times a fraction of the workload.  The match
+            # carry per bucket keeps each iteration data-dependent on the
+            # previous one (no loop-invariant hoisting).
             def body(i, carry):
                 acc, states = carry
                 out = []
+                matches = []
                 for (tok, lens, rreq, rsv), (state, match) in zip(
                         bufs, states):
                     if impl == "pallas":
                         match, state = scanner(tok, lens, state=state,
                                                match=match)
-                        rule_hits, _, _ = map_match_words(
-                            tabs, match, rreq, rsv, n_req)
                     elif impl == "pallas2":
                         # pair-kernel contract: sticky match chains; the
                         # dead-class-padded state is not a byte carry
                         match, state = scanner2(tok, lens, match=match)
-                        rule_hits, _, _ = map_match_words(
-                            tabs, match, rreq, rsv, n_req)
                     elif impl == "pair":
                         # pair path contract: state=None (request scans
                         # consume only the sticky match, which we chain)
-                        rule_hits, _, _, match, state = detect_rows(
-                            tabs, tok, lens, rreq, rsv,
-                            num_requests=n_req, match=match,
-                            scan_impl="pair")
+                        match, state = scan_pairs(
+                            tabs.scan, tok, lens, None, match)
                     else:
-                        rule_hits, _, _, match, state = detect_rows(
-                            tabs, tok, lens, rreq, rsv,
-                            num_requests=n_req, state=state, match=match,
-                            scan_impl="take")
+                        match, state = scan_bytes(
+                            tabs.scan, tok, lens, state, match)
                     out.append((state, match))
-                    acc = (acc + match.sum()
-                           + rule_hits.sum().astype(jnp.uint32))
+                    matches.append(match)
+                    acc = acc + match.sum()
+                rule_hits = fused_map_fold(tabs, matches, bufs, n_req)
+                acc = acc + rule_hits.sum().astype(jnp.uint32)
                 return (acc, tuple(out))
 
             states = tuple(
@@ -483,6 +720,30 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
                     % (f_delta * 1e3, itf))
     except Exception as e:
         log("fixed-pack leg failed (non-fatal): %r" % (e,))
+
+    # pack-scale leg (ISSUE 6): req/s vs synthetic pack size, the
+    # sublinearity gate for the pack-size-invariant scan kernel.  Runs
+    # inline only when the watchdog budget clearly allows; the
+    # standalone `python bench.py --pack-scale` mode always runs it and
+    # writes reports/PACKSCALE.json.
+    try:
+        if _budget_left() > 300:
+            ps = run_pack_scale()
+            result["pack_scale"] = {
+                "scale_2x": ps.get("scale_2x"),
+                "points": [{k: p[k] for k in
+                            ("scale", "rules", "words", "req_per_s")}
+                           for p in ps.get("points", [])],
+                "artifact": "reports/PACKSCALE.json",
+            }
+            _HEADLINE = dict(result)
+        else:
+            log("pack-scale leg skipped inline (%.0fs budget left); "
+                "run `python bench.py --pack-scale` for the full curve "
+                "(reports/PACKSCALE.json carries the last run)"
+                % _budget_left())
+    except Exception as e:
+        log("pack-scale leg failed (non-fatal): %r" % (e,))
 
     # per-bucket MB/s diagnostics (stderr only; never fatal)
     try:
@@ -1018,6 +1279,23 @@ def main() -> None:
 
     if "--latency-only" in sys.argv:
         latency_only_main()
+        return
+    if "--pack-scale" in sys.argv:
+        # standalone PACKSCALE mode: CPU-pinned unless a backend was
+        # forced, own watchdog, one JSON line = the scaling curve
+        _arm_watchdog()
+        if os.environ.get("BENCH_PLATFORM", "cpu") == "cpu":
+            from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+            force_cpu_devices(1)
+        try:
+            emit(run_pack_scale())
+        except BaseException as e:  # noqa: BLE001 — one JSON line always
+            traceback.print_exc(file=sys.stderr)
+            emit(_fallback_result("pack-scale: %s: %s"
+                                  % (type(e).__name__, str(e)[:300])))
+        if _WATCHDOG_TIMER is not None:
+            _WATCHDOG_TIMER.cancel()
         return
     _arm_watchdog()
     try:

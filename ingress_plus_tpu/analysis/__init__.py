@@ -125,4 +125,5 @@ def run_rulecheck(rules_path: Optional[str | Path] = None,
         baseline_path=_rel(resolved_baseline) if resolved_baseline else "",
         n_rules=compiled.n_rules,
         pack_version=compiled.version,
+        reduction=getattr(compiled, "reduction", None),
     )

@@ -138,6 +138,13 @@ class Report:
     baseline_path: str = ""
     n_rules: int = 0
     pack_version: str = ""
+    #: approximate-merge provenance of the audited pack (compiler
+    #: ReductionReport dict; None = exact compile).  The prefilter audit
+    #: certifies soundness THROUGH the reduction (widened/truncated
+    #: factors still cover every derivation), but an operator reading
+    #: the report must be able to see what was merged and at what
+    #: estimated candidate cost.
+    reduction: Optional[Dict] = None
 
     def counts(self, suppressed: bool = False) -> Dict[str, int]:
         out = {s: 0 for s in SEVERITIES}
@@ -161,6 +168,7 @@ class Report:
             "baseline": self.baseline_path,
             "n_rules": self.n_rules,
             "pack_version": self.pack_version,
+            "reduction": self.reduction,
             "counts": self.counts(),
             "suppressed_counts": self.counts(suppressed=True),
             "findings": [f.to_dict()

@@ -49,6 +49,11 @@ import numpy as np
 
 from ingress_plus_tpu.compiler import factors as F
 from ingress_plus_tpu.compiler.bitap import BitapTables, pack_factors
+from ingress_plus_tpu.compiler.reduce import (
+    ReductionConfig,
+    coarsen_byte_classes,
+    reduce_rule_groups,
+)
 from ingress_plus_tpu.compiler.regex_ast import RegexUnsupported, parse_regex
 from ingress_plus_tpu.compiler.seclang import (
     CLASSES,
@@ -72,6 +77,19 @@ from ingress_plus_tpu.compiler.seclang import (
 VARIANTS = ("raw", "urldec", "urldec_html", "squash_raw", "squash_dec",
             "squash_urldec")
 N_SV = len(STREAMS) * len(VARIANTS)  # stream-variant row space
+
+#: the word-tier split (docs/SCAN_KERNEL.md): streams every request row
+#: can carry vs the body/response streams only some requests produce.
+#: Factors owned exclusively by tail-stream rules pack after
+#: BitapTables.n_head_words so bodyless dispatches scan a word prefix.
+HEAD_STREAMS = ("uri", "args", "headers")
+N_HEAD_SV = len(HEAD_STREAMS) * len(VARIANTS)
+
+#: default approximate-reduction config (compiler/reduce.py): modest
+#: candidate-inflation budget, 16-byte factor windows, exact prefix
+#: merging and word tiering on.  Pass ``reduction=ReductionConfig.off()``
+#: for bit-identical legacy tables (the frozen bench fixture does).
+DEFAULT_REDUCTION = ReductionConfig()
 
 _DECODE_TRANSFORMS = {
     "urlDecode", "urlDecodeUni", "jsDecode", "cssDecode", "hexDecode",
@@ -335,6 +353,10 @@ class CompiledRuleset:
     #: Applied per request by the confirm stage when the carrying rule
     #: matches (models/pipeline.py finalize).
     ctl_specs: Dict[int, Dict] = field(default_factory=dict)
+    #: approximate-reduction provenance (compiler/reduce.py
+    #: ReductionReport.to_dict(), None = exact compile) — serialized
+    #: with the artifact and surfaced by rulecheck's JSON report
+    reduction: Optional[Dict] = None
 
     @property
     def n_rules(self) -> int:
@@ -377,6 +399,8 @@ class CompiledRuleset:
             rule_class=self.rule_class, rule_score=self.rule_score,
             rule_action=self.rule_action, rule_paranoia=self.rule_paranoia,
             rule_ids=self.rule_ids,
+            n_head_words=np.asarray(t.n_head_words, np.int32),
+            n_prefix_shared=np.asarray(t.n_prefix_shared, np.int32),
         )
         meta = {
             "version": self.version or self.fingerprint(),
@@ -391,6 +415,7 @@ class CompiledRuleset:
             "anomaly_threshold": self.anomaly_threshold,
             "paranoia_hint": self.paranoia_hint,
             "ctl_specs": {str(k): v for k, v in self.ctl_specs.items()},
+            "reduction": self.reduction,
         }
         path.with_suffix(".json").write_text(json.dumps(meta))
 
@@ -406,6 +431,12 @@ class CompiledRuleset:
             factor_rule_indptr=z["factor_rule_indptr"],
             factor_rule_ids=z["factor_rule_ids"],
             rule_nfactors=z["rule_nfactors"], factor_len=z["factor_len"],
+            # pre-interning checkpoints carry no tier boundary: the full
+            # width (post_init default) keeps them loadable unchanged
+            n_head_words=(int(z["n_head_words"])
+                          if "n_head_words" in z.files else -1),
+            n_prefix_shared=(int(z["n_prefix_shared"])
+                             if "n_prefix_shared" in z.files else 0),
         )
         rules = []
         action_names = {0: "pass", 1: "block", 2: "deny"}
@@ -434,6 +465,7 @@ class CompiledRuleset:
             paranoia_hint=meta.get("paranoia_hint"),
             ctl_specs={int(k): v
                        for k, v in meta.get("ctl_specs", {}).items()},
+            reduction=meta.get("reduction"),
         )
 
 
@@ -541,8 +573,16 @@ def compile_ruleset(
     rules: Sequence[Rule],
     base_path: Optional[str | Path] = None,
     include_chains: bool = True,
+    reduction: Optional[ReductionConfig] = None,
 ) -> CompiledRuleset:
     """Compile rules → CompiledRuleset.
+
+    ``reduction`` configures the pack-size-invariance passes
+    (compiler/reduce.py + bitap prefix merging; docs/SCAN_KERNEL.md):
+    None = DEFAULT_REDUCTION (budgeted approximate reduction ON — the
+    prefilter may over-trigger within the candidate-inflation budget,
+    verdicts are unchanged because the exact confirm lane decides);
+    ``ReductionConfig.off()`` = bit-identical legacy tables.
 
     Chained rules contribute the FIRST scannable link's factors (a chain hit
     requires every link; prefiltering on one link is sound); the confirm
@@ -673,7 +713,38 @@ def compile_ruleset(
         rule_paranoia[i] = rule.paranoia
         rule_ids[i] = rule.rule_id
 
-    tables = pack_factors(groups, n_rules=len(scannable))
+    cfg = DEFAULT_REDUCTION if reduction is None else reduction
+    report = None
+    if cfg.approximate:
+        groups, rep = reduce_rule_groups(groups, cfg)
+        report = rep
+    rule_tier = None
+    if cfg.word_tiering:
+        # tail tier: rules whose every scanned stream is body/response —
+        # their factors pack after n_head_words so a dispatch carrying
+        # only uri/args/headers rows can slice the word axis
+        tail_streams = set(STREAMS) - set(HEAD_STREAMS)
+        rule_tier = np.asarray(
+            [1 if (r.targets and set(r.targets) <= tail_streams) else 0
+             for r in scannable], dtype=np.int32)
+    tables = pack_factors(groups, n_rules=len(scannable),
+                          prefix_merge=cfg.prefix_merge,
+                          rule_tier=rule_tier)
+    if cfg.approximate and cfg.class_merge and report is not None:
+        owners = np.diff(tables.factor_rule_indptr).astype(np.int64)
+        bt, n_merges, k_in, k_out, cspent = coarsen_byte_classes(
+            tables.byte_table, tables.factor_word, tables.factor_bit,
+            tables.factor_len, owners,
+            budget_frac=max(0.0, cfg.budget - report.spent),
+            merge_cap=cfg.class_merge_cap)
+        tables.byte_table = bt
+        report.class_merges = n_merges
+        report.classes_in = k_in
+        report.classes_out = k_out
+        report.spent += cspent
+    if report is not None:
+        report.prefix_shared = tables.n_prefix_shared
+        report.spent = round(report.spent, 5)
     ctl_specs = _resolve_ctls(scannable, rule_ids)
     cr = CompiledRuleset(
         tables=tables, rules=metas, rule_sv_mask=sv_mask,
@@ -681,6 +752,7 @@ def compile_ruleset(
         rule_action=rule_action, rule_paranoia=rule_paranoia,
         rule_ids=rule_ids, anomaly_threshold=anomaly_threshold,
         paranoia_hint=paranoia_hint, ctl_specs=ctl_specs,
+        reduction=report.to_dict() if report is not None else None,
     )
     cr.version = cr.fingerprint()
     return cr

@@ -31,7 +31,13 @@ import numpy as np
 
 from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, N_SV
 from ingress_plus_tpu.compiler.seclang import CLASSES
-from ingress_plus_tpu.ops.scan import ScanTables, scan_bytes, scan_pairs
+from ingress_plus_tpu.ops.scan import (
+    ScanTables,
+    scan_bytes,
+    scan_bytes_jit,
+    scan_pairs,
+    scan_pairs_jit,
+)
 from ingress_plus_tpu.utils import faults
 
 
@@ -43,17 +49,25 @@ class EngineTables:
     scan: ScanTables
     factor_word: jax.Array     # (F,) int32
     factor_bit: jax.Array      # (F,) uint32
-    factor_rule: jax.Array     # (F, R) float32 dense factor→rule map
-    rule_sv: jax.Array         # (R, N_SV) float32
+    #: PREFILTER GROUP axis (docs/SCAN_KERNEL.md "rule grouping"): rules
+    #: with identical (factor set, stream-variant mask, no-prefilter
+    #: flag) produce identical candidate columns, so the rule-count-
+    #: scaling mapping matmul runs over G ≤ R equivalence classes and a
+    #: cheap gather expands groups back to rules.  Clone-heavy pack
+    #: growth (the dominant real-world growth mode) then costs the
+    #: mapping nothing at all.
+    factor_rule: jax.Array     # (F, G) float32 dense factor→group map
+    rule_sv: jax.Array         # (G, N_SV) float32
     rule_score: jax.Array      # (R,) int32
     rule_class: jax.Array      # (R, C) float32 one-hot
-    rule_no_prefilter: jax.Array  # (R,) bool — rules that always confirm
+    rule_no_prefilter: jax.Array  # (G,) bool — groups that always confirm
+    rule_group: jax.Array      # (R,) int32 rule → prefilter group id
 
     def tree_flatten(self):
         return (
             (self.scan, self.factor_word, self.factor_bit, self.factor_rule,
              self.rule_sv, self.rule_score, self.rule_class,
-             self.rule_no_prefilter),
+             self.rule_no_prefilter, self.rule_group),
             None,
         )
 
@@ -62,30 +76,81 @@ class EngineTables:
         return cls(*children)
 
     @classmethod
-    def from_ruleset(cls, cr: CompiledRuleset) -> "EngineTables":
+    def from_ruleset(cls, cr: CompiledRuleset,
+                     head_only: bool = False) -> "EngineTables":
+        """Build device tables; ``head_only=True`` slices the word axis
+        to ``BitapTables.n_head_words`` and keeps only the factors
+        living there (docs/SCAN_KERNEL.md "per-bucket slicing").  Sound
+        for dispatches whose rows all carry uri/args/headers
+        stream-variants: every factor beyond the boundary is owned
+        exclusively by body/response-only rules, which never apply to
+        such rows — the sliced scan computes exactly the candidates the
+        full scan would for them, at the head words' width."""
         t = cr.tables
-        F, R = t.n_factors, cr.n_rules
-        fr = np.zeros((max(F, 1), max(R, 1)), dtype=np.float32)
-        for f in range(F):
+        Wh = t.n_head_words
+        if head_only and Wh < t.n_words:
+            keep = np.nonzero(t.factor_word < Wh)[0]
+            bt = type(t)(
+                byte_table=t.byte_table[:, :Wh],
+                init_mask=t.init_mask[:Wh],
+                final_mask=t.final_mask[:Wh],
+                factor_word=t.factor_word[keep],
+                factor_bit=t.factor_bit[keep],
+                factor_rule_indptr=t.factor_rule_indptr,  # re-derived below
+                factor_rule_ids=t.factor_rule_ids,
+                rule_nfactors=t.rule_nfactors,  # FULL-pack counts: a
+                # body-only rule with factors is not "no prefilter"
+                factor_len=t.factor_len[keep],
+                n_head_words=Wh,
+            )
+            factor_sel = keep
+        else:
+            bt = t
+            factor_sel = None
+        F, R = bt.factor_word.shape[0], cr.n_rules
+        # per-rule factor memberships (within THIS table's factor
+        # subset), for the prefilter-group dedup
+        rule_factors: list = [[] for _ in range(R)]
+        for fi in range(F):
+            f = int(factor_sel[fi]) if factor_sel is not None else fi
             lo, hi = t.factor_rule_indptr[f], t.factor_rule_indptr[f + 1]
-            fr[f, t.factor_rule_ids[lo:hi]] = 1.0
+            for r in t.factor_rule_ids[lo:hi]:
+                rule_factors[int(r)].append(fi)
+        nopf_rule = t.rule_nfactors == 0
+        groups: dict = {}
+        rule_group = np.zeros((max(R, 1),), np.int32)
+        for r in range(R):
+            key = (tuple(rule_factors[r]),
+                   cr.rule_sv_mask[r].tobytes(), bool(nopf_rule[r]))
+            g = groups.setdefault(key, len(groups))
+            rule_group[r] = g
+        G = max(len(groups), 1)
+        fr = np.zeros((max(F, 1), G), dtype=np.float32)
+        rule_sv_g = np.zeros((G, cr.rule_sv_mask.shape[1]), np.float32)
+        nopf_g = np.zeros((G,), bool)
+        for (fids, sv_bytes, nopf), g in groups.items():
+            fr[list(fids), g] = 1.0
+            rule_sv_g[g] = np.frombuffer(
+                sv_bytes, dtype=bool).astype(np.float32)
+            nopf_g[g] = nopf
         onehot = np.zeros((max(R, 1), len(CLASSES)), dtype=np.float32)
         if R:
             onehot[np.arange(R), cr.rule_class] = 1.0
         # F == 0 (every rule confirm-only, e.g. a pure 920-protocol pack):
         # factor_word/bit must pad like factor_rule's dummy row — the
-        # dummy maps to no rule (all-zero fr row), so it can never fire
-        factor_word = t.factor_word if F else np.zeros((1,), np.int32)
-        factor_bit = (t.factor_bit if F else np.zeros((1,), np.int32))
+        # dummy maps to no group (all-zero fr row), so it can never fire
+        factor_word = bt.factor_word if F else np.zeros((1,), np.int32)
+        factor_bit = (bt.factor_bit if F else np.zeros((1,), np.int32))
         return cls(
-            scan=ScanTables.from_bitap(t),
+            scan=ScanTables.from_bitap(bt),
             factor_word=jnp.asarray(factor_word, jnp.int32),
             factor_bit=jnp.asarray(factor_bit.astype(np.uint32)),
             factor_rule=jnp.asarray(fr),
-            rule_sv=jnp.asarray(cr.rule_sv_mask.astype(np.float32)),
+            rule_sv=jnp.asarray(rule_sv_g),
             rule_score=jnp.asarray(cr.rule_score, jnp.int32),
             rule_class=jnp.asarray(onehot),
-            rule_no_prefilter=jnp.asarray(t.rule_nfactors == 0),
+            rule_no_prefilter=jnp.asarray(nopf_g),
+            rule_group=jnp.asarray(rule_group),
         )
 
 
@@ -98,32 +163,47 @@ def map_match_words(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Match words → (rule_hits, class_hits, scores).  Factored out of
     detect_rows so scan implementations living outside the jit (the
-    Pallas kernel path) reuse the identical rule-mapping math."""
+    Pallas kernel path) reuse the identical rule-mapping math.
+
+    Rows fold to REQUESTS before the factor→rule expansion: the (·, F) ×
+    (F, R) dot — the one term here that scales with rule count — runs on
+    Q request rows, not B scan rows (B ≈ 5x Q on the bench corpus; this
+    was the dominant detect cost at the 2k-rule scale, BENCH_r05).  The
+    stream-variant gate is therefore applied per REQUEST, not per row: a
+    factor firing on any of a request's rows counts for every rule of
+    that request with a matching stream-variant.  That is a strict
+    over-approximation of the old row-level gate (candidates only ever
+    added — the exact confirm lane decides verdicts), the same trade the
+    budgeted reduction makes in compiler/reduce.py, and in practice a
+    factor that fires on one normalization variant of a text fires on
+    its siblings too."""
     # factor hits: gather each factor's word, test its bit     (B, F)
     mw = jnp.take(match_words, tables.factor_word, axis=1)
     fh = ((mw >> tables.factor_bit) & jnp.uint32(1)).astype(jnp.float32)
 
-    # factor → rule prefilter hits                              (B, R)
-    row_rule = jnp.dot(fh, tables.factor_rule,
-                       preferred_element_type=jnp.float32) > 0
+    # rows → requests BEFORE the rule expansion: factor hits   (Q, F)
+    req_fh = jax.ops.segment_max(fh, row_req, num_segments=num_requests)
+    # ...and stream-variant coverage                           (Q, N_SV)
+    req_sv = jax.ops.segment_max(row_sv.astype(jnp.float32), row_req,
+                                 num_segments=num_requests)
 
-    # a rule counts for a row only if the row carries one of the rule's
-    # stream-variant ids                                        (B, R)
-    applies = jnp.dot(row_sv.astype(jnp.float32), tables.rule_sv.T,
+    # factor → prefilter-GROUP hits (G ≤ R equivalence classes of rules
+    # with identical candidate behavior — clone rules cost nothing here)
+    req_group = jnp.dot(req_fh, tables.factor_rule,
+                        preferred_element_type=jnp.float32) > 0  # (Q, G)
+
+    # a group counts only for requests carrying one of its
+    # stream-variant ids                                       (Q, G)
+    applies = jnp.dot(req_sv, tables.rule_sv.T,
                       preferred_element_type=jnp.float32) > 0
-    row_rule = jnp.logical_and(row_rule, applies)
-
-    # rows → requests (segment OR)                              (Q, R)
-    rule_hits = jax.ops.segment_max(
-        row_rule.astype(jnp.int32), row_req, num_segments=num_requests,
-    ) > 0
-
-    # rules with no prefilter must always reach the confirm stage for any
+    # groups with no prefilter always reach the confirm stage for any
     # request that has at least one applicable row
-    req_has_rows = jax.ops.segment_max(
-        applies.astype(jnp.int32), row_req, num_segments=num_requests) > 0
-    rule_hits = jnp.logical_or(
-        rule_hits, jnp.logical_and(req_has_rows, tables.rule_no_prefilter[None, :]))
+    group_hits = jnp.logical_and(
+        jnp.logical_or(req_group, tables.rule_no_prefilter[None, :]),
+        applies)
+
+    # groups → rules (gather)                                  (Q, R)
+    rule_hits = jnp.take(group_hits, tables.rule_group, axis=1)
 
     hits_f = rule_hits.astype(jnp.float32)
     class_hits = jnp.dot(hits_f, tables.rule_class,
@@ -135,6 +215,17 @@ def map_match_words(
 
 map_match_words_jit = jax.jit(
     map_match_words, static_argnames=("num_requests",))
+
+
+def map_pad_total(total: int) -> int:
+    """Power-of-two row padding for the single mapping pass — the ONE
+    definition of the mapping executable's batch geometry (the
+    pipeline's recompile gauge keys on it; a drifted copy would count
+    phantom compiles)."""
+    pad = 8
+    while pad < total:
+        pad *= 2
+    return pad
 
 
 def detect_rows(
@@ -173,6 +264,8 @@ detect_rows_jit = jax.jit(
     detect_rows, static_argnames=("num_requests", "scan_impl"))
 
 
+
+
 class DetectionEngine:
     """Host-facing wrapper: upload tables once, detect per batch.
 
@@ -190,6 +283,14 @@ class DetectionEngine:
     def __init__(self, cr: CompiledRuleset, scan_impl: str = "pair"):
         self.ruleset = cr
         self.tables = EngineTables.from_ruleset(cr)
+        # head-sliced twin (docs/SCAN_KERNEL.md): word prefix + the
+        # factors living there, for dispatches with no body/response
+        # rows; None when the pack has no word tiering — or when EVERY
+        # factor is tail-tier (n_head_words == 0: a zero-word slice is
+        # degenerate and its mapping gather would crash)
+        self.head_tables = (
+            EngineTables.from_ruleset(cr, head_only=True)
+            if 0 < cr.tables.n_head_words < cr.tables.n_words else None)
         self.scan_impl = scan_impl        # one of SCAN_IMPLS
         self.pallas_interpret = False     # tests force True on CPU
         self._pallas = None
@@ -214,8 +315,20 @@ class DetectionEngine:
             "n_rules": int(self.ruleset.n_rules),
             "n_factors": int(t.n_factors),
             "n_words": int(t.n_words),
+            "n_head_words": int(t.n_head_words),
+            "n_prefix_shared": int(t.n_prefix_shared),
             "max_factor_len": int(t.max_factor_len),
+            "reduction": getattr(self.ruleset, "reduction", None),
         }
+
+    def head_slicing_active(self) -> bool:
+        """True iff a head-only dispatch would actually use the sliced
+        tables: the pack is word-tiered AND the scan impl honors the
+        slice (the Pallas kernels are built on the full tables — for
+        them head_only is a no-op, so callers must not key executables
+        or warm twins on it)."""
+        return (self.head_tables is not None
+                and self.scan_impl not in ("pallas", "pallas2"))
 
     def swap_ruleset(self, cr: CompiledRuleset) -> None:
         # tables are a jit *argument* (pytree), so a geometry change just
@@ -223,6 +336,9 @@ class DetectionEngine:
         # (that would dump pre-warmed shapes for the new tables too)
         self.ruleset = cr
         self.tables = EngineTables.from_ruleset(cr)
+        self.head_tables = (
+            EngineTables.from_ruleset(cr, head_only=True)
+            if 0 < cr.tables.n_head_words < cr.tables.n_words else None)
         self._pallas = None
         self._pallas2 = None
 
@@ -287,6 +403,69 @@ class DetectionEngine:
         materialize afterwards (one sync per batch, not per bucket)."""
         rule_hits, _, _ = self._rule_hits_device(
             tokens, lengths, row_req, row_sv, num_requests)
+        return rule_hits
+
+    def detect_device_multi(self, buckets, num_requests: int,
+                            head_only: bool = False):
+        """Multi-bucket dispatch with ONE mapping pass (docs/
+        SCAN_KERNEL.md): each length bucket scans in its own jit
+        program — executable space stays ADDITIVE per (B, L) tier, the
+        serving-stability property the per-bucket path always had — and
+        the rule-count-scaling factor→rule mapping runs once on the
+        concatenated match words, padded to a power-of-two row count so
+        its executables key on coarse shapes too.  (A single fully-fused
+        program per bucket SET would multiply the executable space by
+        every combination of tier sizes a traffic mix produces; the
+        serve plane recompiled its way into brownout under exactly that
+        — the bench's detect_k, one static batch shape repeated, is
+        where full fusion pays.)
+
+        ``head_only=True`` (caller asserts no row carries a
+        body/response stream-variant) scans the sliced head tables —
+        the word prefix — instead of the full pack width.  Returns the
+        (Q, R) rule-hit device array without blocking."""
+        faults.sleep_if("dispatch_hang")
+        faults.raise_if("dispatch_raise")
+        pallas = self.scan_impl in ("pallas", "pallas2")
+        tabs = (self.head_tables
+                if head_only and self.head_tables is not None
+                and not pallas else self.tables)
+        if not buckets:
+            R = self.ruleset.n_rules
+            return jnp.zeros((num_requests, max(R, 1)), bool)
+        ms, rrs, rss = [], [], []
+        total = 0
+        for tok, ln, rr, rs in buckets:
+            tok = jnp.asarray(tok)
+            ln = jnp.asarray(ln)
+            if pallas:
+                scanner = (self._pallas_scanner()
+                           if self.scan_impl == "pallas"
+                           else self._pallas_pair_scanner())
+                m, _ = scanner(tok, ln, interpret=self.pallas_interpret)
+            elif self.scan_impl == "take":
+                m, _ = scan_bytes_jit(tabs.scan, tok, ln)
+            else:
+                m, _ = scan_pairs_jit(tabs.scan, tok, ln)
+            ms.append(m)
+            rrs.append(np.asarray(rr))
+            rss.append(np.asarray(rs))
+            total += int(tok.shape[0])
+        # pad the mapping batch to a power of two: its executables key
+        # on (B_total_pad, Q), independent of the bucket mix
+        pad_total = map_pad_total(total)
+        W = tabs.scan.n_words
+        n_sv = rss[0].shape[1] if rss else 0
+        if pad_total > total:
+            ms.append(jnp.zeros((pad_total - total, W), jnp.uint32))
+            pad_req = np.full((pad_total - total,), num_requests - 1,
+                              np.int32)
+            rrs.append(pad_req)
+            rss.append(np.zeros((pad_total - total, n_sv), np.int8))
+        rule_hits, _, _ = map_match_words_jit(
+            tabs, jnp.concatenate(ms, axis=0),
+            jnp.asarray(np.concatenate(rrs)),
+            jnp.asarray(np.concatenate(rss)), num_requests)
         return rule_hits
 
     # ------------------------------------------------- impl auto-select

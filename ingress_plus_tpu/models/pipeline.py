@@ -22,7 +22,11 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, VARIANTS
+from ingress_plus_tpu.compiler.ruleset import (
+    CompiledRuleset,
+    N_HEAD_SV,
+    VARIANTS,
+)
 from ingress_plus_tpu.compiler.seclang import CLASSES, STREAMS
 from ingress_plus_tpu.models.acl import AclStore
 from ingress_plus_tpu.models.confirm import ConfirmRule, parse_exclusion_token
@@ -279,9 +283,12 @@ class DetectionPipeline:
         # a reload under pressure doesn't reset the ladder
         self.load_controller = LoadController()
         self.tenant_rule_mask = tenant_rule_mask
-        # (B, L, Q_pad) engine shapes served so far — a replacement
-        # pipeline warms exactly these before it is swapped in
+        # bucket-set signatures served so far — a replacement pipeline
+        # warms exactly these before it is swapped in
         self.seen_shapes: set = set()
+        # underlying executable shapes (per-(B, L) scan jits + the
+        # pow2-padded mapping jit) — the recompile gauge's ground truth
+        self._seen_exec: set = set()
         #: the outgoing generation's counters, frozen at the last
         #: hot-swap (drift's "before"; None until a swap happens)
         self.frozen_rule_stats = None
@@ -298,6 +305,10 @@ class DetectionPipeline:
         self.paranoia_mask = ruleset.rule_paranoia <= paranoia_level
         self.needed_sv = set(
             int(sv) for sv in np.nonzero(ruleset.rule_sv_mask.any(axis=0))[0])
+        # head-slice qualification bound (docs/SCAN_KERNEL.md): rows
+        # whose stream-variant ids all sit below this are uri/args/
+        # headers rows and may scan the sliced head words
+        self._n_head_sv = N_HEAD_SV
         # runtime ctl exclusions (CRS exclusion-package shape): resolve
         # the compile-time specs to index masks once per install —
         # finalize then applies plain boolean ops per request
@@ -360,17 +371,76 @@ class DetectionPipeline:
         self.rule_stats.reset()
         self.stats.reset_efficiency()
 
-    def warm_shape(self, B: int, L: int, Q_pad: int) -> None:
-        """Pre-compile one engine executable (serving swap path).
+    def _count_new_executables(self, bucket_shapes, Q_pad: int,
+                               head_ok: bool, fused: bool = True) -> int:
+        """How many REAL jit executables a dispatch of this bucket set
+        will compile fresh.  Fused engines (detect_device_multi): one
+        per unseen (B, L) scan shape plus one for an unseen (pow2-padded
+        total rows, Q) mapping shape.  Legacy per-bucket engines
+        (MeshEngine): one per unseen (B, L, Q) fused executable — their
+        programs key on the request pad too and have no separate
+        mapping pass.  Also records the shapes as seen."""
+        new = 0
+        if not fused:
+            for B, L in bucket_shapes:
+                key = ("legacy", B, L, Q_pad)
+                if key not in self._seen_exec:
+                    new += 1
+                    self._seen_exec.add(key)
+            return new
+        for B, L in bucket_shapes:
+            key = ("scan", B, L, head_ok)
+            if key not in self._seen_exec:
+                new += 1
+                self._seen_exec.add(key)
+        from ingress_plus_tpu.models.engine import map_pad_total
 
+        total = sum(B for B, _ in bucket_shapes)
+        mkey = ("map", map_pad_total(total), Q_pad, head_ok)
+        if mkey not in self._seen_exec:
+            new += 1
+            self._seen_exec.add(mkey)
+        return new
+
+    def warm_shape(self, buckets, Q_pad: int,
+                   head_ok: bool = False) -> None:
+        """Pre-compile one engine executable set (serving swap path).
+
+        ``buckets`` is a bucket-set signature — a tuple of (B, L) row
+        shapes, exactly a ``seen_shapes`` entry's first element (a
+        legacy (B, L, Q) int triple is accepted for older callers).
         dtypes must match the live path exactly (uint8 tokens from
         pad_rows) — jit keys executables on dtype, so an int32 warm
-        compiles a cache entry real traffic never hits."""
+        compiles a cache entry real traffic never hits.
+
+        When THIS pipeline's pack is word-tiered but the replayed entry
+        came from an untiered incumbent (head_ok=False), the head-sliced
+        twin is warmed too: post-swap bodyless traffic computes
+        head_ok=True and must not pay its XLA compile in front of
+        canary traffic (a compile past the hang budget would read as a
+        candidate dispatch hang and roll back a good rollout)."""
+        if isinstance(buckets, int):     # legacy (B, L, Q) positional form
+            buckets, Q_pad, head_ok = ((buckets, Q_pad),), head_ok, False
         n_sv = len(STREAMS) * len(VARIANTS)
-        self.engine.detect(
-            np.zeros((B, L), np.uint8), np.zeros((B,), np.int32),
-            np.zeros((B,), np.int32), np.zeros((B, n_sv), np.int8), Q_pad)
-        self.seen_shapes.add((B, L, Q_pad))
+        multi = getattr(self.engine, "detect_device_multi", None)
+        slicing = getattr(self.engine, "head_slicing_active", None)
+        variants = [head_ok]
+        if (not head_ok and multi is not None
+                and slicing is not None and slicing()):
+            variants.append(True)
+        for head in variants:
+            bks = tuple(
+                (np.zeros((B, L), np.uint8), np.zeros((B,), np.int32),
+                 np.zeros((B,), np.int32), np.zeros((B, n_sv), np.int8))
+                for B, L in buckets)
+            if multi is not None:
+                np.asarray(multi(bks, Q_pad, head_only=head))
+            else:
+                for tok, lens, rreq, rsv in bks:
+                    self.engine.detect(tok, lens, rreq, rsv, Q_pad)
+            self._count_new_executables(tuple(buckets), Q_pad, head,
+                                        fused=multi is not None)
+            self.seen_shapes.add((tuple(buckets), Q_pad, head))
 
     # ------------------------------------------------------------ detect
 
@@ -499,6 +569,7 @@ class DetectionPipeline:
             # the compiled programs — the following dispatches pay
             # serve-time compiles (ipt_engine_recompiles_total)
             self.seen_shapes.clear()
+            self._seen_exec.clear()
             self.engine.drop_compiled()
         rows = rows_for_requests(requests, needed_sv=self.needed_sv)
         data_list, req_list, sv_list = merge_rows(rows)
@@ -514,20 +585,30 @@ class DetectionPipeline:
         if data_list:
             n_sv = len(STREAMS) * len(VARIANTS)
             te0 = time.perf_counter()
-            # Shape stability: jit caches one executable per (B, L, Q)
-            # triple, so rows bucket into fixed L tiers, row counts pad to
-            # powers of two, and Q pads likewise.  Without this every
-            # distinct batch size recompiles — unserveable.
+            # Shape stability: jit caches one executable per bucket-set
+            # signature, so rows bucket into fixed L tiers, row counts
+            # pad to powers of two, and Q pads likewise.  Without this
+            # every distinct batch size recompiles — unserveable.
             by_bucket: Dict[int, List[int]] = {}
             for i, d in enumerate(data_list):
                 for L in self.L_BUCKETS:
                     if len(d) <= L or L == self.L_BUCKETS[-1]:
                         by_bucket.setdefault(L, []).append(i)
                         break
-            # Dispatch every bucket before materializing any result: XLA
-            # dispatch is async, so the device pipelines the bucket scans
-            # back-to-back instead of paying one host sync per bucket.
-            dispatched = []
+            # Single-mapping dispatch (docs/SCAN_KERNEL.md): each bucket
+            # scans in its own jit program, the rule-count-scaling
+            # factor→rule mapping runs once per batch.  Engines that
+            # predate the fused API (parallel/serve_mesh MeshEngine)
+            # keep the per-bucket detect_device path — feature-detected,
+            # never assumed.  head_ok: no row carries a body/response
+            # stream-variant ⇒ the sliced head words suffice.
+            multi = getattr(self.engine, "detect_device_multi", None)
+            slicing = getattr(self.engine, "head_slicing_active", None)
+            head_ok = (multi is not None
+                       and slicing is not None and slicing()
+                       and all(s < self._n_head_sv
+                               for sv in sv_list for s in sv))
+            buckets = []
             for L, idxs in sorted(by_bucket.items()):
                 B_pad = self._pad_q(len(idxs), floor=8)
                 stats.truncated_rows += sum(
@@ -541,14 +622,7 @@ class DetectionPipeline:
                 row_sv = np.zeros((B_pad, n_sv), dtype=np.int8)
                 for j, i in enumerate(idxs):
                     row_sv[j, sv_list[i]] = 1
-                dispatched.append(self.engine.detect_device(
-                    tokens, lengths, row_req, row_sv, self._pad_q(Q)))
-                shape = (tokens.shape[0], tokens.shape[1], self._pad_q(Q))
-                if shape not in self.seen_shapes:
-                    # a shape warmup never compiled: this dispatch paid
-                    # a serve-time jit compile (the recompile gauge)
-                    stats.engine_compiles += 1
-                self.seen_shapes.add(shape)
+                buckets.append((tokens, lengths, row_req, row_sv))
                 nbytes = sum(len(r) for r in rows_b)
                 stats.rows += len(idxs)
                 stats.row_bytes += nbytes
@@ -560,8 +634,29 @@ class DetectionPipeline:
                     stats.bucket_rows.get(L, 0) + len(idxs)
                 stats.bucket_padded_rows[L] = \
                     stats.bucket_padded_rows.get(L, 0) + B_pad
-            for rh_dev in dispatched:
+            bucket_shapes = tuple((b[0].shape[0], b[0].shape[1])
+                                  for b in buckets)
+            shape = (bucket_shapes, self._pad_q(Q), head_ok)
+            # recompile gauge counts REAL executables, not bucket-set
+            # signatures: one per unseen (B, L) scan shape plus one for
+            # an unseen mapping shape (total rows pow2-padded x Q) — a
+            # novel combination of already-warm executables is free
+            stats.engine_compiles += self._count_new_executables(
+                bucket_shapes, self._pad_q(Q), head_ok,
+                fused=multi is not None)
+            self.seen_shapes.add(shape)
+            if multi is not None:
+                rh_dev = multi(tuple(buckets), self._pad_q(Q),
+                               head_only=head_ok)
                 rule_hits |= np.asarray(rh_dev)
+            else:
+                # legacy engine: per-bucket dispatch, async then OR
+                dispatched = [
+                    self.engine.detect_device(tok, lens, rreq, rsv,
+                                              self._pad_q(Q))
+                    for tok, lens, rreq, rsv in buckets]
+                for rh_dev in dispatched:
+                    rule_hits |= np.asarray(rh_dev)
             stats.engine_us += int((time.perf_counter() - te0) * 1e6)
         rule_hits = self.mask_hits(requests, rule_hits[:Q])
         stats.prefilter_rule_hits += int(rule_hits.sum())

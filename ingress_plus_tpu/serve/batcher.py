@@ -1010,7 +1010,7 @@ class Batcher:
         h["confirm"].observe(trace.confirm_us)
         if trace.n_requests:
             self.batch_size_hist.observe(trace.n_requests)
-        stages = trace.stages()
+        stages = None                 # built only if something IS slow
         thr = self.slow.threshold()   # skip dict build for fast requests
         for ts, r, v in done:
             queue_us = int((t0 - ts) * 1e6)
@@ -1019,6 +1019,8 @@ class Batcher:
             h["e2e"].observe(e2e_us)
             if e2e_us <= thr:
                 continue
+            if stages is None:
+                stages = trace.stages()
             self.slow.offer(e2e_us, self._exemplar(
                 r, v, trace.ts, queue_us, batch=stages))
         for handle, v in finish_verdicts:
@@ -1028,6 +1030,8 @@ class Batcher:
             h["e2e"].observe(e2e_us)
             if e2e_us <= thr:
                 continue
+            if stages is None:
+                stages = trace.stages()
             self.slow.offer(e2e_us, self._exemplar(
                 handle.request, v, trace.ts, 0,
                 body_len=handle.body_len, batch=stages,

@@ -36,9 +36,6 @@ _NAMED_ENTITIES = {
     b"semi": b";", b"equals": b"=", b"lpar": b"(", b"rpar": b")",
 }
 
-_SQUASH = frozenset(SQUASH_BYTES)
-
-
 def url_decode_uni(data: bytes) -> bytes:
     """%XX and %uXXXX decoding (one pass, invalid sequences left intact),
     plus '+' → space, plus overlong-UTF-8 folding.  Mirrors ModSecurity
@@ -51,40 +48,38 @@ def url_decode_uni_raw(data: bytes) -> bytes:
     """The decode loop WITHOUT overlong folding — the streaming variant
     decoder (serve/stream.py IncrementalVariant) needs the two stages
     separate so an overlong pair split across chunks can be held and
-    folded when its continuation byte arrives."""
-    out = bytearray()
-    i, n = 0, len(data)
-    while i < n:
-        b = data[i]
-        if b == 0x2B:  # +
-            out.append(0x20)
-            i += 1
-        elif b == 0x25 and i + 1 < n:  # %
-            nxt = data[i + 1]
-            if nxt in (0x75, 0x55) and i + 5 < n:  # %uXXXX
-                hx = [_HEX.get(data[i + 2 + k]) for k in range(4)]
-                if all(h is not None for h in hx):
-                    code = (hx[0] << 12) | (hx[1] << 8) | (hx[2] << 4) | hx[3]
-                    out.append(code & 0xFF if code > 0xFF else code)
-                    i += 6
-                    continue
-                out.append(b)
-                i += 1
-            elif i + 2 < n or (i + 2 == n):
-                h1 = _HEX.get(data[i + 1]) if i + 1 < n else None
-                h2 = _HEX.get(data[i + 2]) if i + 2 < n else None
-                if h1 is not None and h2 is not None:
-                    out.append((h1 << 4) | h2)
-                    i += 3
-                else:
-                    out.append(b)
-                    i += 1
-            else:
-                out.append(b)
-                i += 1
-        else:
-            out.append(b)
-            i += 1
+    folded when its continuation byte arrives.
+
+    Fast-pathed (the profile's #1 host-prep cost, ISSUE 6 code-drift
+    satellite): '+' folds via one C-level replace, %-free rows return
+    unchanged after one C-level scan, and rows WITH escapes process
+    per-%-segment instead of per byte.  '+' inside a %-escape needs no
+    special order: decoded bytes were never re-scanned for '+' in the
+    byte loop either ("%2B" decodes to a literal '+'), and a '+' in an
+    escape's hex positions makes it invalid in both forms."""
+    if 0x2B in data:  # +
+        data = data.replace(b"+", b" ")
+    if 0x25 not in data:  # %
+        return data
+    parts = data.split(b"%")
+    out = bytearray(parts[0])
+    for p in parts[1:]:
+        # p is everything after one '%' up to the next '%'
+        if len(p) >= 5 and p[0] in (0x75, 0x55):  # %uXXXX
+            hx = [_HEX.get(p[1 + k]) for k in range(4)]
+            if all(h is not None for h in hx):
+                code = (hx[0] << 12) | (hx[1] << 8) | (hx[2] << 4) | hx[3]
+                out.append(code & 0xFF if code > 0xFF else code)
+                out += p[5:]
+                continue
+        if len(p) >= 2:  # %XX
+            h1, h2 = _HEX.get(p[0]), _HEX.get(p[1])
+            if h1 is not None and h2 is not None:
+                out.append((h1 << 4) | h2)
+                out += p[2:]
+                continue
+        out.append(0x25)  # invalid escape: '%' left intact
+        out += p
     return bytes(out)
 
 
@@ -132,6 +127,8 @@ def fold_overlong_utf8(data: bytes) -> bytes:
 
 def html_entity_decode(data: bytes) -> bytes:
     """&#NN; / &#xHH; / common named entities (one pass)."""
+    if 0x26 not in data:  # & — one C-level scan, no Python byte loop
+        return data
     out = bytearray()
     i, n = 0, len(data)
     while i < n:
@@ -168,9 +165,13 @@ def remove_nulls(data: bytes) -> bytes:
     return data.replace(b"\x00", b"")
 
 
+_SQUASH_DELETE = bytes(sorted(SQUASH_BYTES))
+
+
 def squash(data: bytes) -> bytes:
-    """Delete SQUASH_BYTES (whitespace, backslash, quotes, caret)."""
-    return bytes(b for b in data if b not in _SQUASH)
+    """Delete SQUASH_BYTES (whitespace, backslash, quotes, caret) —
+    one C-level translate, no Python byte loop."""
+    return data.translate(None, _SQUASH_DELETE)
 
 
 def variant_chain(data: bytes, variant: int) -> bytes:

@@ -1082,6 +1082,8 @@ def warmup_pipeline(pipeline, max_batch: int) -> None:
 
     from ingress_plus_tpu.utils.corpus import generate_corpus
 
+    import dataclasses
+
     t0 = _t.time()
     reqs = [lr.request for lr in generate_corpus(n=max_batch, seed=1)]
     # one size per Q-pad tier (engine executables are keyed on the padded
@@ -1094,6 +1096,15 @@ def warmup_pipeline(pipeline, max_batch: int) -> None:
     sizes.append(max_batch)
     for size in sizes:
         pipeline.detect(reqs[:size])
+    # head-sliced twin shapes (docs/SCAN_KERNEL.md): the synthetic corpus
+    # carries bodies, so every batch above warmed the FULL-width tables —
+    # but bodyless (GET-only) cycles dispatch against the sliced head
+    # words and would otherwise pay their compile in front of live
+    # traffic.  Only word-tiered packs have the twin.
+    if getattr(pipeline.engine, "head_tables", None) is not None:
+        bodyless = [dataclasses.replace(r, body=b"") for r in reqs]
+        for size in sizes:
+            pipeline.detect(bodyless[:size])
     print("warmup: compiled serve shapes in %.1fs" % (_t.time() - t0),
           file=sys.stderr)
 

@@ -41,7 +41,15 @@ def force_cpu_devices(n_devices: int = 8) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def probe_backend_once(timeout: int = 60):
+#: process-level cache of a SUCCESSFUL probe verdict (platform string).
+#: A live backend stays live for the process's purposes; re-probing it
+#: costs a subprocess + full jax import (~5-20s) per call site.
+#: Failures are NOT cached here — retry ladders (bench.probe_backend)
+#: must see fresh attempts; they cache their own final verdict.
+_PROBE_OK: Optional[str] = None
+
+
+def probe_backend_once(timeout: int = 60, use_cache: bool = True):
     """``jax.devices()`` in a THROWAWAY SUBPROCESS under a hard timeout.
 
     Returns ``(platform, None)`` on success or ``(None, error_string)``.
@@ -60,6 +68,9 @@ def probe_backend_once(timeout: int = 60):
     import subprocess
     import sys
 
+    global _PROBE_OK
+    if use_cache and _PROBE_OK is not None:
+        return _PROBE_OK, None
     try:
         p = subprocess.run(
             [sys.executable, "-c",
@@ -70,7 +81,8 @@ def probe_backend_once(timeout: int = 60):
     out = [l for l in p.stdout.strip().splitlines()
            if l.startswith("PLATFORM=")]
     if p.returncode == 0 and out:
-        return out[-1].split("=", 1)[1], None
+        _PROBE_OK = out[-1].split("=", 1)[1]
+        return _PROBE_OK, None
     err = (p.stderr.strip().splitlines() or ["rc=%d" % p.returncode])[-1]
     return None, err[:300]
 

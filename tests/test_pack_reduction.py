@@ -1,0 +1,271 @@
+"""Interned/merged-pack equivalence suite (ISSUE 6).
+
+The pack-size-invariant scan kernel rewrites the factor universe
+(compiler/reduce.py) and the bit layout (compiler/bitap.py prefix
+merging, word tiering).  These tests pin its two contracts:
+
+  * SOUNDNESS — the reduced prefilter's candidates are a SUPERSET of
+    the exact pack's on any input (property-style over seeded random
+    rule subsets and corpus rows), and confirm-lane verdicts are
+    byte-identical (the confirm stage decides; reduction may only add
+    confirm work).
+  * BUDGET BOUNDARY — budget=0 disables every approximate op: tables
+    are bit-identical to the legacy compile.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from ingress_plus_tpu.compiler.bitap import (
+    factors_to_rules,
+    matches_to_factors,
+    pack_factors,
+    reference_scan,
+)
+from ingress_plus_tpu.compiler.reduce import (
+    ReductionConfig,
+    batch_reference_scan,
+    byte_model,
+    candidate_matrix,
+    coarsen_byte_classes,
+    measure_inflation,
+    reduce_rule_groups,
+)
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.normalize import merge_rows, rows_for_requests
+from ingress_plus_tpu.utils.corpus import generate_corpus
+
+
+def _lit(s: str):
+    return tuple(frozenset([c]) for c in s.encode())
+
+
+@pytest.fixture(scope="module")
+def bundled():
+    return load_bundled_rules()
+
+
+@pytest.fixture(scope="module")
+def corpus_rows():
+    corpus = generate_corpus(n=96, attack_fraction=0.3, seed=5)
+    data_list, _, _ = merge_rows(
+        rows_for_requests([lr.request for lr in corpus]))
+    return data_list[:400]
+
+
+# ------------------------------------------------------- budget boundary
+
+
+def test_budget_zero_is_bit_identical(bundled):
+    """budget=0 ⇒ no approximate op fires: tables match the legacy
+    compile bit for bit, whatever the other approximate knobs say."""
+    sub = bundled[:220]
+    legacy = compile_ruleset(sub, reduction=ReductionConfig.off())
+    zero = compile_ruleset(sub, reduction=ReductionConfig(
+        budget=0.0, max_factor_len=12, fold_merge=True, pair_merge=True,
+        class_merge=True, prefix_merge=False, word_tiering=False))
+    for name in ("byte_table", "init_mask", "final_mask", "factor_word",
+                 "factor_bit", "factor_len", "factor_rule_indptr",
+                 "factor_rule_ids", "rule_nfactors"):
+        np.testing.assert_array_equal(
+            getattr(legacy.tables, name), getattr(zero.tables, name),
+            err_msg=name)
+    assert legacy.reduction is None
+    # budget=0 still reports an (all-zero) provenance block when the
+    # reduction path ran
+    assert zero.reduction is None or zero.reduction["factors_out"] == \
+        zero.reduction["factors_in"]
+
+
+def test_budget_zero_reduce_is_identity():
+    groups = [[_lit("union select"), _lit("benchmark(")], [_lit("union")]]
+    out, rep = reduce_rule_groups(groups, ReductionConfig(budget=0.0))
+    assert out == groups
+    assert rep.truncated == rep.fold_merged == rep.pair_merged == 0
+
+
+# ------------------------------------------------------ prefix merging
+
+
+def test_prefix_merge_exact_semantics():
+    """A factor that is a prefix of another shares its bits; scan
+    results stay exactly identical on hit and miss inputs."""
+    g = [[_lit("union select")], [_lit("union")], [_lit("uni")],
+         [_lit("select")]]
+    plain = pack_factors(g)
+    merged = pack_factors(g, prefix_merge=True)
+    assert merged.n_prefix_shared == 2          # "union", "uni"
+    assert merged.n_words <= plain.n_words
+    for data in (b"union select 1", b"xx union", b"uni", b"none here",
+                 b"selec", b"select *"):
+        want = factors_to_rules(
+            plain, matches_to_factors(plain, reference_scan(plain, data)))
+        got = factors_to_rules(
+            merged, matches_to_factors(merged, reference_scan(merged, data)))
+        np.testing.assert_array_equal(want, got, err_msg=repr(data))
+
+
+def test_prefix_merged_pack_decodes_and_audits_clean():
+    """The rulecheck prefilter audit must decode factors THROUGH the
+    shared-bit indirection (interior final bits, shared start bits) and
+    still certify them."""
+    from ingress_plus_tpu.analysis.prefilter_audit import (
+        decode_factors,
+        table_consistency,
+    )
+
+    g = [[_lit("passwd")], [_lit("passwd123")], [_lit("pass")]]
+    t = pack_factors(g, prefix_merge=True)
+    assert t.n_prefix_shared == 2
+    assert table_consistency(t) == []
+    decoded = decode_factors(t)
+    # decode order is length-sorted; compare as sets of sequences
+    assert set(decoded) == {_lit("passwd"), _lit("passwd123"),
+                            _lit("pass")}
+
+
+def test_word_tiering_places_tail_factors_last():
+    g = [[_lit("request-side")], [_lit("response-only")]]
+    t = pack_factors(g, prefix_merge=True,
+                     rule_tier=np.asarray([0, 1], np.int32))
+    assert t.n_head_words == 1
+    assert int(t.factor_word[list(t.factor_len).index(13)]) >= 1
+
+
+# --------------------------------------------- class coarsening (op 4)
+
+
+def test_coarsen_byte_classes_is_monotone():
+    g = [[_lit("select")], [_lit("szlect")], [_lit("union")]]
+    t = pack_factors(g)
+    owners = np.diff(t.factor_rule_indptr).astype(np.int64)
+    bt2, n_merges, k_in, k_out, _spent = coarsen_byte_classes(
+        t.byte_table, t.factor_word, t.factor_bit, t.factor_len,
+        owners, budget_frac=10.0, merge_cap=64)
+    assert n_merges > 0 and k_out < k_in
+    # bits only ever added ⇒ matches only ever added
+    assert ((bt2 & t.byte_table) == t.byte_table).all()
+    t2 = pack_factors(g)
+    t2.byte_table = bt2
+    rng = random.Random(0)
+    for _ in range(50):
+        data = bytes(rng.randrange(32, 127)
+                     for _ in range(rng.randint(0, 40)))
+        m1 = reference_scan(t, data)
+        m2 = reference_scan(t2, data)
+        assert (m1 & ~m2).sum() == 0    # superset of match bits
+    # and the known hits still hit
+    h = factors_to_rules(t2, matches_to_factors(
+        t2, reference_scan(t2, b"1 union szlect x")))
+    assert h[1] and h[2]
+
+
+# ------------------------------------- property: superset + verdicts
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_random_subsets_candidates_superset_verdicts_identical(
+        bundled, corpus_rows, seed):
+    """For random rule subsets: the reduced pack's raw prefilter
+    candidates are a superset of the exact pack's on corpus rows, and
+    full-pipeline verdicts are byte-identical."""
+    rng = random.Random(seed)
+    sub = [r for r in bundled if rng.random() < 0.12]
+    assert len(sub) > 50
+    exact = compile_ruleset(sub, reduction=ReductionConfig.off())
+    reduced = compile_ruleset(sub)
+    assert reduced.tables.n_words <= exact.tables.n_words
+    m = measure_inflation(exact.tables, reduced.tables, corpus_rows)
+    assert m["lost_candidates"] == 0, m
+    # verdict parity end to end (confirm decides; generation differs by
+    # construction, elapsed is timing)
+    corpus = generate_corpus(n=64, attack_fraction=0.3, seed=seed + 50)
+    reqs = [lr.request for lr in corpus]
+    ve = DetectionPipeline(exact, mode="block").detect(reqs)
+    vr = DetectionPipeline(reduced, mode="block").detect(reqs)
+    for a, b in zip(ve, vr):
+        assert (a.blocked, a.attack, a.score, a.rule_ids, a.classes) == \
+            (b.blocked, b.attack, b.score, b.rule_ids, b.classes)
+
+
+def test_batch_reference_scan_matches_scalar(bundled, corpus_rows):
+    sub = bundled[:150]
+    cr = compile_ruleset(sub)
+    rows = corpus_rows[:40]
+    M = batch_reference_scan(cr.tables, rows)
+    for i, r in enumerate(rows[:10]):
+        np.testing.assert_array_equal(M[i], reference_scan(cr.tables, r))
+    cm = candidate_matrix(cr.tables, rows[:10])
+    assert cm.shape == (10, cr.n_rules)
+
+
+# ----------------------------------------------------- head-slice path
+
+
+def test_head_slice_rule_hits_match_full(bundled):
+    """Bodyless batches may scan the sliced head words only; the
+    resulting candidates must equal the full-table dispatch's for the
+    same requests (tail factors belong to rules that cannot apply)."""
+    cr = compile_ruleset(bundled)
+    assert cr.tables.n_head_words < cr.tables.n_words
+    corpus = generate_corpus(n=48, attack_fraction=0.4, seed=9)
+    reqs = [lr.request for lr in corpus if not lr.request.body][:24]
+    assert len(reqs) >= 8
+    p = DetectionPipeline(cr, mode="block")
+    assert p.engine.head_tables is not None
+    hits_head = p.prefilter(reqs)
+    head = p.engine.head_tables
+    p.engine.head_tables = None          # force the full-width path
+    hits_full = p.prefilter(reqs)
+    p.engine.head_tables = head
+    np.testing.assert_array_equal(hits_head, hits_full)
+
+
+def test_reduction_report_round_trips(tmp_path, bundled):
+    cr = compile_ruleset(bundled[:120])
+    assert cr.reduction is not None
+    assert cr.reduction["budget"] > 0
+    p = tmp_path / "pack"
+    cr.save(p)
+    back = type(cr).load(p)
+    assert back.reduction == cr.reduction
+    assert back.tables.n_head_words == cr.tables.n_head_words
+    np.testing.assert_array_equal(back.tables.byte_table,
+                                  cr.tables.byte_table)
+
+
+def test_body_only_pack_has_no_degenerate_head_slice():
+    """A pack whose every scannable rule targets only body/response
+    streams tiers ALL factors tail (n_head_words == 0): the engine must
+    not build a zero-word head slice (its mapping gather would crash on
+    warm_shape's head-twin pass during a hot swap — review finding)."""
+    from ingress_plus_tpu.compiler.seclang import Rule
+
+    rules = [Rule(rule_id=1, operator="rx", argument="evil_payload",
+                  targets=["body"]),
+             Rule(rule_id=2, operator="rx", argument="leak_marker",
+                  targets=["resp_body"])]
+    cr = compile_ruleset(rules)
+    assert cr.tables.n_head_words == 0
+    p = DetectionPipeline(cr, mode="block")
+    assert p.engine.head_tables is None
+    assert not p.engine.head_slicing_active()
+    p.warm_shape(((8, 64),), 4)            # must not crash
+    from ingress_plus_tpu.serve.normalize import Request
+
+    v = p.detect([Request(request_id="x", uri="/a",
+                          body=b"evil_payload=1")])[0]
+    assert v.rule_ids == [1]
+
+
+def test_byte_model_is_normalized():
+    mu = byte_model()
+    assert mu.shape == (256,)
+    assert abs(float(mu.sum()) - 1.0) < 1e-9
+    assert (mu > 0).all()

@@ -191,7 +191,13 @@ def test_pallas_pair_full_pack_geometry():
     from ingress_plus_tpu.ops.pallas_scan import PallasPairScanner
     from ingress_plus_tpu.ops.scan import scan_pairs
 
-    cr = compile_ruleset(load_bundled_rules())
+    from ingress_plus_tpu.compiler.reduce import ReductionConfig
+
+    # exact compile: this test exists to exercise the 500+-word
+    # multi-tile geometry, which the approximate reduction deliberately
+    # shrinks — disable it here, the kernel must still handle the width
+    cr = compile_ruleset(load_bundled_rules(),
+                         reduction=ReductionConfig.off())
     t = ScanTables.from_bitap(cr.tables)
     assert t.n_words > 400   # the point of this test
     import jax.numpy as jnp

@@ -78,6 +78,7 @@ def test_fail_open_on_engine_error(ruleset):
     p = DetectionPipeline(ruleset, mode="block", fail_open=True)
     raise_ = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("tpu gone"))
     p.engine.detect = p.engine.detect_device = raise_
+    p.engine.detect_device_multi = raise_   # the fused serve-path entry
     v = p.detect([ATTACKS[0][1]])[0]
     assert not v.blocked and v.fail_open
     assert p.stats.fail_open == 1

@@ -57,7 +57,12 @@ def server(tmp_path_factory, loadgen_bin):
         [sys.executable, "-m", "ingress_plus_tpu.serve",
          "--socket", sock, "--http-port", "19901",
          "--rules-dir", str(rules_dir), "--platform", "cpu",
-         "--max-delay-us", "1000", "--no-warmup",
+         # warmup ON (tiny pack, compiles in seconds): with --no-warmup
+         # a cold-compile stall mid-loadgen queues requests long enough
+         # for the brownout ladder to serve degraded (attack, unblocked)
+         # verdicts — the test then flakes on blocked == attacks under
+         # full-suite CPU contention
+         "--max-delay-us", "1000", "--max-batch", "64",
          "--spool-dir", str(spool), "--export-interval-s", "0.5"],
         cwd=str(REPO), env=env,
         stderr=subprocess.PIPE, text=True)
