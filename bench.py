@@ -379,6 +379,128 @@ def run_pack_scale(scales=(0.5, 1.0, 1.5, 2.0), n_req: int = 1024,
     return result
 
 
+def mesh_point_main(n_devices: int) -> None:
+    """Subprocess entry for one mesh-scale point (``--mesh-point=K``):
+    pin K virtual CPU devices (the device count is fixed at backend
+    init, which is why every point needs its own interpreter), compile
+    the bundled pack, run the lane-sharded serve measurement, and print
+    the result dict as ONE JSON line (the parent collects it)."""
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(n_devices)
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+    from ingress_plus_tpu.parallel.serve_mesh import run_lane_measurement
+
+    cr = compile_ruleset(load_bundled_rules())
+    n_req = int(os.environ.get("MESH_POINT_REQS", "1024"))
+    m = run_lane_measurement(cr, n_lanes=n_devices, n_req=n_req,
+                             max_batch=32, tier_warmup=False)
+    print(json.dumps(m), flush=True)
+
+
+def run_mesh_scale(points=(1, 2, 4, 8),
+                   out_path: str | None = None) -> dict:
+    """MESHSCALE leg (ISSUE 7): aggregate serve-plane req/s at 1/2/4/8
+    simulated devices (``--xla_force_host_platform_device_count`` via a
+    fresh subprocess per point), through the REAL lane-sharded batcher
+    — the measured trajectory of ROADMAP item 2, not a smoke test.
+    Writes reports/MESHSCALE.json; scaling efficiency at 8 devices
+    below 0.7 is warned about LOUDLY, never silently recorded.  On a
+    host with fewer cores than devices the virtual chips serialize and
+    the warning explains WHY — the number is still honest."""
+    import subprocess
+
+    here = os.path.abspath(__file__)
+    results = []
+    for k in points:
+        budget = _budget_left()
+        if budget < 90:
+            log("MESHSCALE: %.0fs budget left — stopping before %d "
+                "devices" % (budget, k))
+            break
+        try:
+            out = subprocess.run(
+                [sys.executable, here, "--mesh-point=%d" % k],
+                capture_output=True, text=True,
+                timeout=max(90, min(300, budget - 10)))
+        except subprocess.TimeoutExpired:
+            log("MESHSCALE: %d-device point timed out (non-fatal)" % k)
+            continue
+        sys.stderr.write(out.stderr[-1500:])
+        line = (out.stdout.strip().splitlines() or [""])[-1]
+        if out.returncode != 0 or not line.startswith("{"):
+            log("MESHSCALE: %d-device point rc=%d (non-fatal)"
+                % (k, out.returncode))
+            continue
+        try:
+            m = json.loads(line)
+        except json.JSONDecodeError:
+            # a point killed mid-write emits truncated JSON — skip the
+            # point like every other per-point failure, never abort
+            # the whole curve
+            log("MESHSCALE: %d-device point emitted malformed JSON "
+                "(non-fatal)" % k)
+            continue
+        results.append(m)
+        log("MESHSCALE %d devices: %s req/s (util %s, recompiles %s)"
+            % (k, m.get("req_per_s_mesh"),
+               m.get("per_device_utilization"),
+               m.get("serve_time_recompiles")))
+    result = {
+        "metric": "aggregate serve-plane req/s vs simulated device "
+                  "count (lane-sharded batcher, bundled CRS pack, "
+                  "virtual CPU devices)",
+        "host_cpus": os.cpu_count(),
+        "points": results,
+    }
+    base = next((m for m in results
+                 if m["n_lanes"] == 1 and m.get("req_per_s_mesh")), None)
+    if base:
+        scaling = {}
+        for m in results:
+            if not m.get("req_per_s_mesh"):
+                continue
+            k = m["n_lanes"]
+            sp = m["req_per_s_mesh"] / base["req_per_s_mesh"]
+            scaling[str(k)] = {"speedup": round(sp, 3),
+                               "efficiency": round(sp / k, 3)}
+        result["scaling"] = scaling
+        eight = scaling.get("8")
+        if eight is not None:
+            result["efficiency_8dev"] = eight["efficiency"]
+            if eight["efficiency"] < 0.7:
+                log("=" * 64)
+                log("MESHSCALE WARNING: scaling efficiency at 8 devices "
+                    "is %.2f (gate: >= 0.7) — the mesh serve plane is "
+                    "NOT near-linear on this host." % eight["efficiency"])
+                if (os.cpu_count() or 1) < 8:
+                    log("  (host has %d CPU core(s) for 8 virtual "
+                        "devices: the simulated chips SERIALIZE — this "
+                        "measures dispatch overhead, not chip-parallel "
+                        "capacity; rerun on >=8 cores or a real mesh "
+                        "for the capacity number)" % (os.cpu_count() or 1))
+                log("=" * 64)
+            else:
+                log("MESHSCALE: 8-device efficiency %.2f (gate >= 0.7)"
+                    % eight["efficiency"])
+    else:
+        log("MESHSCALE WARNING: no 1-device baseline point — the "
+            "scaling curve is INCOMPLETE this round (budget or point "
+            "failure); the efficiency gate was NOT evaluated")
+    if out_path is None:
+        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "reports", "MESHSCALE.json")
+    try:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        log("MESHSCALE written to %s" % out_path)
+    except OSError as e:
+        log("MESHSCALE write failed (non-fatal): %r" % (e,))
+    return result
+
+
 def run_bench(force_cpu_err: str | None = None) -> dict:
     """Measure and return the result dict.  ``force_cpu_err`` non-None
     means a prior attempt failed at dispatch time despite a good probe
@@ -744,6 +866,33 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
                 % _budget_left())
     except Exception as e:
         log("pack-scale leg failed (non-fatal): %r" % (e,))
+
+    # mesh-scale leg (ISSUE 7): aggregate serve-plane req/s across
+    # 1/2/4/8 simulated devices — the measured multichip trajectory.
+    # Inline only with clear budget headroom (each point is a fresh
+    # subprocess that recompiles the pack); the standalone
+    # `python bench.py --mesh-scale` mode always runs the full curve.
+    try:
+        if _budget_left() > 330:
+            ms = run_mesh_scale()
+            result["mesh_scale"] = {
+                "scaling": ms.get("scaling"),
+                "efficiency_8dev": ms.get("efficiency_8dev"),
+                "host_cpus": ms.get("host_cpus"),
+                "points": [{kk: p.get(kk) for kk in
+                            ("n_lanes", "req_per_s_mesh",
+                             "serve_time_recompiles")}
+                           for p in ms.get("points", [])],
+                "artifact": "reports/MESHSCALE.json",
+            }
+            _HEADLINE = dict(result)
+        else:
+            log("mesh-scale leg skipped inline (%.0fs budget left); "
+                "run `python bench.py --mesh-scale` for the curve "
+                "(reports/MESHSCALE.json carries the last run)"
+                % _budget_left())
+    except Exception as e:
+        log("mesh-scale leg failed (non-fatal): %r" % (e,))
 
     # per-bucket MB/s diagnostics (stderr only; never fatal)
     try:
@@ -1279,6 +1428,24 @@ def main() -> None:
 
     if "--latency-only" in sys.argv:
         latency_only_main()
+        return
+    point = [a.split("=", 1)[1] for a in sys.argv
+             if a.startswith("--mesh-point=")]
+    if point:
+        mesh_point_main(int(point[0]))
+        return
+    if "--mesh-scale" in sys.argv:
+        # standalone MESHSCALE mode: one subprocess per simulated
+        # device count, own watchdog, one JSON line = the scaling curve
+        _arm_watchdog()
+        try:
+            emit(run_mesh_scale())
+        except BaseException as e:  # noqa: BLE001 — one JSON line always
+            traceback.print_exc(file=sys.stderr)
+            emit(_fallback_result("mesh-scale: %s: %s"
+                                  % (type(e).__name__, str(e)[:300])))
+        if _WATCHDOG_TIMER is not None:
+            _WATCHDOG_TIMER.cancel()
         return
     if "--pack-scale" in sys.argv:
         # standalone PACKSCALE mode: CPU-pinned unless a backend was

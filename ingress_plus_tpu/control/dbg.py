@@ -202,6 +202,25 @@ def render_breaker(health: dict) -> str:
     lines.append("shed:    %s"
                  % (", ".join("%s=%d" % kv for kv in sorted(shed.items()))
                     or "-"))
+    lanes = rb.get("lanes") or []
+    if len(lanes) > 1:
+        # per-device lane plane (docs/MESH_SERVING.md): one row per
+        # chip — where the capacity went when a breaker above is open
+        lines.append("")
+        lines.append("lanes:")
+        lines.append("  %-4s %-14s %-9s %5s %5s %6s %8s %7s"
+                     % ("lane", "device", "breaker", "trips", "hangs",
+                        "errors", "requests", "fill"))
+        for ln in lanes:
+            brk_l = ln.get("breaker") or {}
+            fill = ln.get("dispatch_fill")
+            lines.append(
+                "  %-4s %-14s %-9s %5s %5s %6s %8s %7s"
+                % (ln.get("lane"), ln.get("device") or "-",
+                   brk_l.get("state", "?"), brk_l.get("trips"),
+                   ln.get("hangs"), ln.get("errors"),
+                   ln.get("requests"),
+                   ("%.3f" % fill) if fill is not None else "-"))
     return "\n".join(lines)
 
 

@@ -295,6 +295,10 @@ class DetectionEngine:
         self.pallas_interpret = False     # tests force True on CPU
         self._pallas = None
         self._pallas2 = None
+        # per-device replicated tables (docs/MESH_SERVING.md): the
+        # sigpack rides to each serve lane's chip ONCE, at first use —
+        # {device: (tables, head_tables|None)}
+        self._device_tables: dict = {}
 
     def rebuilt(self, cr: CompiledRuleset) -> "DetectionEngine":
         """Fresh engine of the SAME kind on a new ruleset — the batcher
@@ -341,6 +345,25 @@ class DetectionEngine:
             if 0 < cr.tables.n_head_words < cr.tables.n_words else None)
         self._pallas = None
         self._pallas2 = None
+        self._device_tables = {}
+
+    def tables_for(self, device):
+        """The (tables, head_tables) pair replicated to ``device`` —
+        device_put once per chip per generation (docs/MESH_SERVING.md
+        "sigpack replication"); ``device=None`` is the default-device
+        pair.  The replica is a pytree copy, so the jit cache keys one
+        executable set per device (XLA executables are device-bound;
+        the lane warmup compiles them all in one overlapped pass)."""
+        if device is None:
+            return self.tables, self.head_tables
+        key = device
+        pair = self._device_tables.get(key)
+        if pair is None:
+            pair = (jax.device_put(self.tables, device),
+                    (jax.device_put(self.head_tables, device)
+                     if self.head_tables is not None else None))
+            self._device_tables[key] = pair
+        return pair
 
     # ----------------------------------------------------- scan backends
 
@@ -363,6 +386,7 @@ class DetectionEngine:
         jax.clear_caches()
         self._pallas = None
         self._pallas2 = None
+        self._device_tables = {}
 
     def _rule_hits_device(self, tokens, lengths, row_req, row_sv,
                           num_requests: int):
@@ -406,7 +430,7 @@ class DetectionEngine:
         return rule_hits
 
     def detect_device_multi(self, buckets, num_requests: int,
-                            head_only: bool = False):
+                            head_only: bool = False, device=None):
         """Multi-bucket dispatch with ONE mapping pass (docs/
         SCAN_KERNEL.md): each length bucket scans in its own jit
         program — executable space stays ADDITIVE per (B, L) tier, the
@@ -423,21 +447,38 @@ class DetectionEngine:
         ``head_only=True`` (caller asserts no row carries a
         body/response stream-variant) scans the sliced head tables —
         the word prefix — instead of the full pack width.  Returns the
-        (Q, R) rule-hit device array without blocking."""
+        (Q, R) rule-hit device array without blocking.
+
+        ``device`` pins the dispatch to one chip of the serve mesh
+        (docs/MESH_SERVING.md): inputs are device_put there and the
+        scan runs against that device's replicated tables
+        (``tables_for``), so N lanes' dispatches execute concurrently
+        on N chips.  The Pallas kernels are built on the default
+        device's tables — for them ``device`` is ignored (the serve
+        lanes use pair/take on meshes; documented limitation)."""
         faults.sleep_if("dispatch_hang")
         faults.raise_if("dispatch_raise")
         pallas = self.scan_impl in ("pallas", "pallas2")
-        tabs = (self.head_tables
-                if head_only and self.head_tables is not None
-                and not pallas else self.tables)
+        full_tabs, head_tabs = (self.tables, self.head_tables)
+        if device is not None and not pallas:
+            full_tabs, head_tabs = self.tables_for(device)
+        tabs = (head_tabs
+                if head_only and head_tabs is not None
+                and not pallas else full_tabs)
         if not buckets:
             R = self.ruleset.n_rules
             return jnp.zeros((num_requests, max(R, 1)), bool)
+
+        def _dev(x):
+            return (jax.device_put(x, device)
+                    if device is not None and not pallas
+                    else jnp.asarray(x))
+
         ms, rrs, rss = [], [], []
         total = 0
         for tok, ln, rr, rs in buckets:
-            tok = jnp.asarray(tok)
-            ln = jnp.asarray(ln)
+            tok = _dev(tok)
+            ln = _dev(ln)
             if pallas:
                 scanner = (self._pallas_scanner()
                            if self.scan_impl == "pallas"
@@ -457,15 +498,15 @@ class DetectionEngine:
         W = tabs.scan.n_words
         n_sv = rss[0].shape[1] if rss else 0
         if pad_total > total:
-            ms.append(jnp.zeros((pad_total - total, W), jnp.uint32))
+            ms.append(_dev(np.zeros((pad_total - total, W), np.uint32)))
             pad_req = np.full((pad_total - total,), num_requests - 1,
                               np.int32)
             rrs.append(pad_req)
             rss.append(np.zeros((pad_total - total, n_sv), np.int8))
         rule_hits, _, _ = map_match_words_jit(
             tabs, jnp.concatenate(ms, axis=0),
-            jnp.asarray(np.concatenate(rrs)),
-            jnp.asarray(np.concatenate(rss)), num_requests)
+            _dev(np.concatenate(rrs)),
+            _dev(np.concatenate(rss)), num_requests)
         return rule_hits
 
     # ------------------------------------------------- impl auto-select

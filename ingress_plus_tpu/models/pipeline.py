@@ -131,6 +131,41 @@ class PipelineStats:
         self.bucket_padded_rows = {}
 
 
+@dataclass
+class _ScanJob:
+    """In-flight per-lane scan (detect_launch → detect_collect): the
+    host-prep products plus the pending device dispatch.  ``pending``
+    is a serve-lane handle (lanes.LanePending) whose wait() the
+    collector bounds; ``result`` is the synchronous no-lane variant."""
+
+    requests: List[Request]
+    t0: float
+    lane: object = None
+    level: int = 0
+    head_ok: bool = False
+    live_rows: int = 0
+    padded_rows: int = 0
+    busy_us: int = 0
+    pending: object = None
+    result: Optional[np.ndarray] = None
+
+
+def warm_sizes(max_batch: int) -> List[int]:
+    """The ONE Q-pad warmup tier ladder — 1, then the pow2 tiers up to
+    ``max_batch`` — shared by server.warmup_pipeline,
+    Batcher.warm_lanes and the mesh measurement harness.  A drifted
+    copy would leave a "warmed" server paying serve-time compiles,
+    which the mesh path treats as hang-risk (reviewer catch: three
+    hand-synced copies)."""
+    sizes, q = [1], 4
+    while q < max_batch:
+        sizes.append(q)
+        q *= 2
+    if max_batch > 1:
+        sizes.append(max_batch)
+    return sizes
+
+
 #: brownout ladder rungs (LoadController.level indexes this):
 #: full detection → prefilter-only (skip the confirm lane; verdicts
 #: flagged degraded, never blocking) → fail-open (no scan at all)
@@ -286,8 +321,15 @@ class DetectionPipeline:
         # bucket-set signatures served so far — a replacement pipeline
         # warms exactly these before it is swapped in
         self.seen_shapes: set = set()
+        # per-lane twin of seen_shapes for mesh serving
+        # (docs/MESH_SERVING.md): (lane_index, buckets, Q_pad, head_ok)
+        # entries — the batcher's hot-swap replay warms each lane's
+        # device-bound executables too
+        self.seen_lane_shapes: set = set()
         # underlying executable shapes (per-(B, L) scan jits + the
-        # pow2-padded mapping jit) — the recompile gauge's ground truth
+        # pow2-padded mapping jit, keyed per lane device — XLA
+        # executables are device-bound) — the recompile gauge's ground
+        # truth
         self._seen_exec: set = set()
         #: the outgoing generation's counters, frozen at the last
         #: hot-swap (drift's "before"; None until a swap happens)
@@ -372,35 +414,67 @@ class DetectionPipeline:
         self.stats.reset_efficiency()
 
     def _count_new_executables(self, bucket_shapes, Q_pad: int,
-                               head_ok: bool, fused: bool = True) -> int:
+                               head_ok: bool, fused: bool = True,
+                               lane_key=None) -> int:
         """How many REAL jit executables a dispatch of this bucket set
         will compile fresh.  Fused engines (detect_device_multi): one
         per unseen (B, L) scan shape plus one for an unseen (pow2-padded
         total rows, Q) mapping shape.  Legacy per-bucket engines
         (MeshEngine): one per unseen (B, L, Q) fused executable — their
         programs key on the request pad too and have no separate
-        mapping pass.  Also records the shapes as seen."""
+        mapping pass.  ``lane_key`` scopes the keys to one serve lane's
+        device (XLA executables are device-bound, so the same shape on
+        another chip IS a fresh compile — the gauge must not hide it).
+        Also records the shapes as seen."""
         new = 0
         if not fused:
             for B, L in bucket_shapes:
-                key = ("legacy", B, L, Q_pad)
+                key = ("legacy", B, L, Q_pad, lane_key)
                 if key not in self._seen_exec:
                     new += 1
                     self._seen_exec.add(key)
             return new
         for B, L in bucket_shapes:
-            key = ("scan", B, L, head_ok)
+            key = ("scan", B, L, head_ok, lane_key)
             if key not in self._seen_exec:
                 new += 1
                 self._seen_exec.add(key)
         from ingress_plus_tpu.models.engine import map_pad_total
 
         total = sum(B for B, _ in bucket_shapes)
-        mkey = ("map", map_pad_total(total), Q_pad, head_ok)
+        mkey = ("map", map_pad_total(total), Q_pad, head_ok, lane_key)
         if mkey not in self._seen_exec:
             new += 1
             self._seen_exec.add(mkey)
         return new
+
+    def warm_lane_shape(self, buckets, Q_pad: int, head_ok: bool,
+                        lane) -> None:
+        """Pre-compile one LANE's device-bound executable set (mesh
+        warmup + swap replay, docs/MESH_SERVING.md): zero buffers of
+        the recorded shape dispatch against the lane's device.  Runs on
+        the CALLING thread — device pinning needs only the device, not
+        the lane's worker, so warmers never clog a live lane's dispatch
+        queue; callers fan shapes across ephemeral threads to overlap
+        the per-lane compiles (one overlapped compile pass for an
+        8-lane start, not 8 serial ones)."""
+        n_sv = len(STREAMS) * len(VARIANTS)
+        multi = getattr(self.engine, "detect_device_multi", None)
+        bks = tuple(
+            (np.zeros((B, L), np.uint8), np.zeros((B,), np.int32),
+             np.zeros((B,), np.int32), np.zeros((B, n_sv), np.int8))
+            for B, L in buckets)
+        self._count_new_executables(tuple(buckets), Q_pad, head_ok,
+                                    fused=multi is not None,
+                                    lane_key=lane.index)
+        self.seen_lane_shapes.add((lane.index, tuple(buckets), Q_pad,
+                                   head_ok))
+        if multi is not None:
+            np.asarray(multi(bks, Q_pad, head_only=head_ok,
+                             device=lane.device))
+        else:
+            for tok, lens, rreq, rsv in bks:
+                self.engine.detect(tok, lens, rreq, rsv, Q_pad)
 
     def warm_shape(self, buckets, Q_pad: int,
                    head_ok: bool = False) -> None:
@@ -504,6 +578,116 @@ class DetectionPipeline:
                 for r in requests
             ]
 
+    def detect_launch(self, requests: Sequence[Request], lane=None,
+                      count_batch: bool = True):
+        """First half of ``detect_strict`` for one serve lane's share
+        of a mesh cycle (docs/MESH_SERVING.md): host prep NOW, on the
+        calling dispatch thread (single-writer stats hold), device scan
+        ASYNC on the lane's worker thread against tables replicated to
+        the lane's device.  Returns a job for :meth:`detect_collect`;
+        splitting at the device boundary is what lets the batcher
+        overlap the next cycle's pad/pack/normalize with this cycle's
+        dispatch (double-buffered transfer) and bound each lane's wait
+        independently (per-lane watchdog)."""
+        t0 = time.perf_counter()
+        requests = list(requests)
+        job = _ScanJob(requests=requests, t0=t0, lane=lane)
+        if not requests:
+            return job
+        st = self.stats
+        st.requests += len(requests)
+        if count_batch:
+            # one admission cycle = one batch regardless of how many
+            # lane shares it splits into — the mesh batcher counts the
+            # cycle's FIRST share only, so stats.batches keeps its
+            # PR 4 meaning (reviewer catch: N-fold inflation)
+            st.batches += 1
+        job.level = self.load_controller.level
+        if job.level >= 2:
+            return job        # collect produces fail-open verdicts
+        (buckets, bucket_shapes, head_ok, bucket_us,
+         live_rows, padded_rows) = self._build_scan_buckets(requests)
+        job.head_ok = head_ok
+        job.live_rows = live_rows
+        job.padded_rows = padded_rows
+        if not buckets:
+            return job
+        Q_pad = self._pad_q(len(requests))
+        engine = self.engine
+        multi = getattr(engine, "detect_device_multi", None)
+        lane_key = lane.index if lane is not None else None
+        st.engine_us += bucket_us   # pad/pack rides the scan stage
+        st.engine_compiles += self._count_new_executables(
+            bucket_shapes, Q_pad, head_ok, fused=multi is not None,
+            lane_key=lane_key)
+        if lane is not None:
+            self.seen_lane_shapes.add(
+                (lane.index, bucket_shapes, Q_pad, head_ok))
+        else:
+            self.seen_shapes.add((bucket_shapes, Q_pad, head_ok))
+        device = lane.device if lane is not None else None
+
+        def _dispatch():
+            tb0 = time.perf_counter()
+            try:
+                if multi is not None:
+                    return np.asarray(multi(
+                        tuple(buckets), Q_pad, head_only=head_ok,
+                        device=device))
+                acc = None
+                for tok, lens, rreq, rsv in buckets:
+                    rh = np.asarray(engine.detect_device(
+                        tok, lens, rreq, rsv, Q_pad))
+                    acc = rh if acc is None else np.logical_or(acc, rh)
+                return acc
+            finally:
+                # device busy time measured INSIDE the worker: the
+                # overlap design means launch→collect wall includes a
+                # whole drain window — that must not book as scan time
+                job.busy_us = int((time.perf_counter() - tb0) * 1e6)
+
+        if lane is not None:
+            job.pending = lane.submit(_dispatch)
+        else:
+            job.result = _dispatch()
+        return job
+
+    def detect_collect(self, job,
+                       timeout: Optional[float] = None) -> List[Verdict]:
+        """Second half of :meth:`detect_launch`: bound-wait the device
+        result, then mask + confirm + score exactly as ``detect``
+        would.  Raises ``DeviceHang`` (lane wedged past ``timeout``) or
+        the dispatch's own error — ``detect_strict`` semantics, so the
+        batcher's per-lane breaker can count failures before producing
+        the fail-open verdicts itself."""
+        requests = job.requests
+        if not requests:
+            return []
+        st = self.stats
+        if job.level >= 2:
+            st.fail_open += len(requests)
+            st.degraded += len(requests)
+            return [
+                Verdict(request_id=r.request_id, blocked=False,
+                        attack=False, classes=[], rule_ids=[], score=0,
+                        fail_open=True, degraded=True)
+                for r in requests
+            ]
+        Q = len(requests)
+        rule_hits = np.zeros((self._pad_q(Q), self.ruleset.n_rules),
+                             dtype=bool)
+        if job.pending is not None:
+            rule_hits |= job.pending.wait(timeout)
+            st.engine_us += job.busy_us
+        elif job.result is not None:
+            rule_hits |= job.result
+            st.engine_us += job.busy_us
+        masked = self.mask_hits(requests, rule_hits[:Q])
+        st.prefilter_rule_hits += int(masked.sum())
+        if job.level == 1:
+            return self._finalize_prefilter_only(requests, masked, job.t0)
+        return self.finalize(requests, masked, job.t0)
+
     def _detect_inner(self, requests: List[Request], t0: float) -> List[Verdict]:
         self.stats.requests += len(requests)
         self.stats.batches += 1
@@ -558,17 +742,23 @@ class DetectionPipeline:
             v.generation = rs.version
         return verdicts
 
-    def prefilter(self, requests: List[Request]) -> np.ndarray:
-        """Scan stage: requests → masked (Q, R) prefilter rule hits.
-        Exposed separately so the streaming body path (serve/stream.py)
-        can scan a body-less request now and OR in chunk-carried body
-        hits at stream end."""
+    def _build_scan_buckets(self, requests: List[Request]):
+        """Host prep shared by ``prefilter`` (the synchronous single-
+        lane path) and ``detect_launch`` (the per-lane mesh path):
+        normalize rows, merge, L-tier bucket/pad/pack, and the
+        device-efficiency accounting.  Returns ``(buckets,
+        bucket_shapes, head_ok, bucket_us, live_rows, padded_rows)``;
+        ``buckets`` is empty when no request carries scannable bytes.
+        stats.prep_us gets the normalize/merge cost; the pad/pack cost
+        (``bucket_us``) rides the scan stage — the caller adds it to
+        engine_us (docs/OBSERVABILITY.md)."""
         tp0 = time.perf_counter()
         if faults.fire("recompile_storm"):
             # injected executable loss: forget every warm shape and drop
             # the compiled programs — the following dispatches pay
             # serve-time compiles (ipt_engine_recompiles_total)
             self.seen_shapes.clear()
+            self.seen_lane_shapes.clear()
             self._seen_exec.clear()
             self.engine.drop_compiled()
         rows = rows_for_requests(requests, needed_sv=self.needed_sv)
@@ -579,63 +769,83 @@ class DetectionPipeline:
         # per-bucket pad/pack below is interleaved with async dispatch
         # and rides the scan stage — documented in docs/OBSERVABILITY.md)
         stats.prep_us += int((time.perf_counter() - tp0) * 1e6)
+        if not data_list:
+            return [], (), False, 0, 0, 0
+        te0 = time.perf_counter()
+        n_sv = len(STREAMS) * len(VARIANTS)
+        # Shape stability: jit caches one executable per bucket-set
+        # signature, so rows bucket into fixed L tiers, row counts
+        # pad to powers of two, and Q pads likewise.  Without this
+        # every distinct batch size recompiles — unserveable.
+        by_bucket: Dict[int, List[int]] = {}
+        for i, d in enumerate(data_list):
+            for L in self.L_BUCKETS:
+                if len(d) <= L or L == self.L_BUCKETS[-1]:
+                    by_bucket.setdefault(L, []).append(i)
+                    break
+        # head_ok: no row carries a body/response stream-variant ⇒ the
+        # sliced head words suffice (docs/SCAN_KERNEL.md).
+        multi = getattr(self.engine, "detect_device_multi", None)
+        slicing = getattr(self.engine, "head_slicing_active", None)
+        head_ok = (multi is not None
+                   and slicing is not None and slicing()
+                   and all(s < self._n_head_sv
+                           for sv in sv_list for s in sv))
+        buckets = []
+        live_rows = padded_rows = 0
+        for L, idxs in sorted(by_bucket.items()):
+            B_pad = self._pad_q(len(idxs), floor=8)
+            stats.truncated_rows += sum(
+                1 for i in idxs if len(data_list[i]) > L)
+            rows_b = [data_list[i][:L] for i in idxs]
+            rows_b += [b""] * (B_pad - len(idxs))
+            tokens, lengths = pad_rows(rows_b, max_len=L, round_to=L)
+            row_req = np.zeros((B_pad,), np.int32)
+            row_req[: len(idxs)] = [req_list[i] for i in idxs]
+            row_req[len(idxs):] = self._pad_q(Q) - 1
+            row_sv = np.zeros((B_pad, n_sv), dtype=np.int8)
+            for j, i in enumerate(idxs):
+                row_sv[j, sv_list[i]] = 1
+            buckets.append((tokens, lengths, row_req, row_sv))
+            nbytes = sum(len(r) for r in rows_b)
+            stats.rows += len(idxs)
+            stats.row_bytes += nbytes
+            stats.live_rows += len(idxs)
+            stats.live_row_bytes += nbytes
+            stats.padded_rows += B_pad
+            stats.padded_bytes += B_pad * tokens.shape[1]
+            stats.bucket_rows[L] = \
+                stats.bucket_rows.get(L, 0) + len(idxs)
+            stats.bucket_padded_rows[L] = \
+                stats.bucket_padded_rows.get(L, 0) + B_pad
+            live_rows += len(idxs)
+            padded_rows += B_pad
+        bucket_shapes = tuple((b[0].shape[0], b[0].shape[1])
+                              for b in buckets)
+        bucket_us = int((time.perf_counter() - te0) * 1e6)
+        return (buckets, bucket_shapes, head_ok, bucket_us,
+                live_rows, padded_rows)
 
+    def prefilter(self, requests: List[Request]) -> np.ndarray:
+        """Scan stage: requests → masked (Q, R) prefilter rule hits.
+        Exposed separately so the streaming body path (serve/stream.py)
+        can scan a body-less request now and OR in chunk-carried body
+        hits at stream end."""
+        Q = len(requests)
+        stats = self.stats
+        (buckets, bucket_shapes, head_ok, bucket_us,
+         _live, _padded) = self._build_scan_buckets(requests)
         R = self.ruleset.n_rules
         rule_hits = np.zeros((self._pad_q(Q), R), dtype=bool)
-        if data_list:
-            n_sv = len(STREAMS) * len(VARIANTS)
+        if buckets:
             te0 = time.perf_counter()
-            # Shape stability: jit caches one executable per bucket-set
-            # signature, so rows bucket into fixed L tiers, row counts
-            # pad to powers of two, and Q pads likewise.  Without this
-            # every distinct batch size recompiles — unserveable.
-            by_bucket: Dict[int, List[int]] = {}
-            for i, d in enumerate(data_list):
-                for L in self.L_BUCKETS:
-                    if len(d) <= L or L == self.L_BUCKETS[-1]:
-                        by_bucket.setdefault(L, []).append(i)
-                        break
             # Single-mapping dispatch (docs/SCAN_KERNEL.md): each bucket
             # scans in its own jit program, the rule-count-scaling
             # factor→rule mapping runs once per batch.  Engines that
             # predate the fused API (parallel/serve_mesh MeshEngine)
             # keep the per-bucket detect_device path — feature-detected,
-            # never assumed.  head_ok: no row carries a body/response
-            # stream-variant ⇒ the sliced head words suffice.
+            # never assumed.
             multi = getattr(self.engine, "detect_device_multi", None)
-            slicing = getattr(self.engine, "head_slicing_active", None)
-            head_ok = (multi is not None
-                       and slicing is not None and slicing()
-                       and all(s < self._n_head_sv
-                               for sv in sv_list for s in sv))
-            buckets = []
-            for L, idxs in sorted(by_bucket.items()):
-                B_pad = self._pad_q(len(idxs), floor=8)
-                stats.truncated_rows += sum(
-                    1 for i in idxs if len(data_list[i]) > L)
-                rows_b = [data_list[i][:L] for i in idxs]
-                rows_b += [b""] * (B_pad - len(idxs))
-                tokens, lengths = pad_rows(rows_b, max_len=L, round_to=L)
-                row_req = np.zeros((B_pad,), np.int32)
-                row_req[: len(idxs)] = [req_list[i] for i in idxs]
-                row_req[len(idxs):] = self._pad_q(Q) - 1
-                row_sv = np.zeros((B_pad, n_sv), dtype=np.int8)
-                for j, i in enumerate(idxs):
-                    row_sv[j, sv_list[i]] = 1
-                buckets.append((tokens, lengths, row_req, row_sv))
-                nbytes = sum(len(r) for r in rows_b)
-                stats.rows += len(idxs)
-                stats.row_bytes += nbytes
-                stats.live_rows += len(idxs)
-                stats.live_row_bytes += nbytes
-                stats.padded_rows += B_pad
-                stats.padded_bytes += B_pad * tokens.shape[1]
-                stats.bucket_rows[L] = \
-                    stats.bucket_rows.get(L, 0) + len(idxs)
-                stats.bucket_padded_rows[L] = \
-                    stats.bucket_padded_rows.get(L, 0) + B_pad
-            bucket_shapes = tuple((b[0].shape[0], b[0].shape[1])
-                                  for b in buckets)
             shape = (bucket_shapes, self._pad_q(Q), head_ok)
             # recompile gauge counts REAL executables, not bucket-set
             # signatures: one per unseen (B, L) scan shape plus one for
@@ -657,7 +867,8 @@ class DetectionPipeline:
                     for tok, lens, rreq, rsv in buckets]
                 for rh_dev in dispatched:
                     rule_hits |= np.asarray(rh_dev)
-            stats.engine_us += int((time.perf_counter() - te0) * 1e6)
+            stats.engine_us += bucket_us + int(
+                (time.perf_counter() - te0) * 1e6)
         rule_hits = self.mask_hits(requests, rule_hits[:Q])
         stats.prefilter_rule_hits += int(rule_hits.sum())
         return rule_hits

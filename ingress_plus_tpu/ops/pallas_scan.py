@@ -34,6 +34,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax-version compat: CompilerParams was TPUCompilerParams on older
+# pallas (same fields); resolve once at import
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 from ingress_plus_tpu.ops.scan import ScanTables, classes_for
 
 
@@ -141,7 +146,7 @@ def _pallas_scan(tokens, lengths, planes, init, final, state, match,
             jax.ShapeDtypeStruct((B, Wp), jnp.int32),    # state
         ],
         scratch_shapes=[pltpu.VMEM((CL * TB, Wp), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(toks_pm, lengths, planes, init, final, state, match)
@@ -409,7 +414,7 @@ def _pallas_pair_scan(cls_tokens, lengths, planes, init, final, state,
         ],
         scratch_shapes=[pltpu.VMEM((blk, Wp), jnp.int32),
                         pltpu.VMEM((blk, Wp), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(toks_pm, toks_pm, lengths, planes, init, final, state, match)

@@ -1,0 +1,29 @@
+"""jax version compatibility for the parallel plane.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the top
+level, and its replication-check kwarg was renamed ``check_rep`` →
+``check_vma`` along the way.  The sharded engines target the new
+spelling; this shim adapts older jax installs (the container toolchain
+pins one, CI another) instead of failing at import — the whole
+mesh/dcn test family errored at collection on the old-jax containers
+before this existed.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    _RENAME = None
+except ImportError:   # pre-0.5 jax: experimental namespace, old kwarg
+    from jax.experimental.shard_map import (  # type: ignore[assignment]
+        shard_map as _shard_map,
+    )
+
+    _RENAME = ("check_vma", "check_rep")
+
+
+def shard_map(*args, **kw):
+    if _RENAME is not None and _RENAME[0] in kw:
+        kw[_RENAME[1]] = kw.pop(_RENAME[0])
+    return _shard_map(*args, **kw)

@@ -28,7 +28,8 @@ semantics.
 
 from __future__ import annotations
 
-from typing import Optional
+import time
+from typing import Optional, Sequence
 
 import jax
 import numpy as np
@@ -40,6 +41,109 @@ try:  # Mesh type only used for annotations / isinstance docs
     from jax.sharding import Mesh
 except Exception:  # pragma: no cover
     Mesh = None
+
+
+def batch_mesh(devices: Optional[Sequence] = None):
+    """The data-parallel serve mesh: every local device on one
+    ``("batch",)`` axis (docs/MESH_SERVING.md).  Scan rows shard across
+    it at request granularity (serve/lanes.py LanePool) with the
+    sigpack replicated once per device
+    (models/engine.DetectionEngine.tables_for)."""
+    from jax.sharding import Mesh as _Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    return _Mesh(np.asarray(devs), ("batch",))
+
+
+def run_lane_measurement(cr: CompiledRuleset, n_lanes: int,
+                         n_req: int = 1024, max_batch: int = 64,
+                         mode: str = "block",
+                         seed: int = 42,
+                         tier_warmup: bool = True) -> dict:
+    """Measure the LANE-SHARDED serve plane end to end: a real Batcher
+    with ``n_lanes`` per-device lanes over the local jax devices, warmed
+    then driven with a labeled corpus through the real admission queue.
+    Returns ``req_per_s_mesh`` plus per-device utilization — the number
+    MULTICHIP graduates to (a smoke test proves the mesh exists; this
+    proves what it serves).  Shared by ``bench.py --mesh-point`` and
+    ``__graft_entry__.dryrun_multichip`` so the two artifacts can never
+    measure different programs."""
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.batcher import Batcher
+    from ingress_plus_tpu.utils.corpus import generate_corpus
+
+    devices = jax.devices()
+    pipeline = DetectionPipeline(cr, mode=mode)
+    # throughput harness: the whole corpus floods the queue at once, so
+    # the SLO machinery must stand down — a huge deadline (no queue-math
+    # shedding of the backlog) and a queue that fits the corpus.  The
+    # serve default keeps its bounded admission; this measures capacity.
+    batcher = Batcher(pipeline, max_batch=max_batch,
+                      max_delay_s=0.0005, n_lanes=n_lanes,
+                      lane_devices=devices,
+                      hard_deadline_s=600.0,
+                      queue_cap=max(8192, n_req + 16))
+    try:
+        corpus = generate_corpus(n=n_req, attack_fraction=0.2, seed=seed)
+        requests = [lr.request for lr in corpus]
+        t_w0 = time.perf_counter()
+        # ``tier_warmup=False`` (the bench mesh-scale points on the
+        # full CRS pack): skip the exhaustive Q-pad-tier pass — the
+        # corpus warm pass below compiles exactly the shapes the
+        # measured pass replays, at a fraction of the big pack's tier
+        # compile bill
+        if tier_warmup and n_lanes > 1:
+            batcher.warm_lanes()
+        elif tier_warmup:
+            # same coverage for the 1-lane baseline point: every Q-pad
+            # tier through the single-lane path
+            from ingress_plus_tpu.models.pipeline import warm_sizes
+
+            for size in warm_sizes(max_batch):
+                pipeline.detect(requests[:size])
+            pipeline.reset_detection_observations()
+        # one unmeasured pass of the corpus itself: live traffic's
+        # bucket mixes differ from the synthetic warm corpus, and a
+        # first-pass jit compile inside the measured window would book
+        # as mesh throughput (the r03-r05 lesson, per lane now)
+        futs = [batcher.submit(r) for r in requests]
+        for f in futs:
+            f.result(timeout=600)
+        warm_s = time.perf_counter() - t_w0
+        batcher.reset_latency_observations()
+        # measured pass: the full admission→split→scan→confirm→verdict
+        # chain, wall-clocked from first submit to last resolved future
+        t0 = time.perf_counter()
+        futs = [batcher.submit(r) for r in requests]
+        verdicts = [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+        fail_open = sum(1 for v in verdicts if v.fail_open)
+        attacks = sum(1 for v in verdicts if v.attack)
+        lanes = batcher.lanes.snapshot()
+        util = {str(ln["lane"]): (round(ln["busy_us"] / (wall * 1e6), 4)
+                                  if wall > 0 else None)
+                for ln in lanes}
+        return {
+            "n_devices": len(devices),
+            "n_lanes": n_lanes,
+            "requests": n_req,
+            "req_per_s_mesh": round(n_req / wall, 1) if wall > 0 else None,
+            "wall_s": round(wall, 3),
+            "warmup_s": round(warm_s, 1),
+            "verdicts": len(verdicts),
+            "fail_open": fail_open,
+            "attacks": attacks,
+            "per_device_utilization": util,
+            "per_lane": [{k: ln[k] for k in
+                          ("lane", "device", "requests", "rows",
+                           "dispatch_fill", "hangs", "errors", "busy_us")}
+                         for ln in lanes],
+            "serve_time_recompiles": pipeline.stats.engine_compiles,
+            "ruleset": {"rules": int(cr.n_rules),
+                        "words": int(cr.tables.n_words)},
+        }
+    finally:
+        batcher.close()
 
 
 def parse_mesh_spec(spec: str, n_devices: Optional[int] = None):

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ingress_plus_tpu.parallel._compat import shard_map
 
 from ingress_plus_tpu.compiler.ruleset import CompiledRuleset, N_SV
 from ingress_plus_tpu.compiler.seclang import CLASSES

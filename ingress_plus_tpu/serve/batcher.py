@@ -21,6 +21,19 @@ with a hang budget backed by a circuit breaker (open = CPU confirm-only
 fallback, half-open = single canary batches); and a monitor thread
 backstops the dispatch thread itself.  Every path keeps the one
 invariant: an admitted request resolves to exactly one verdict.
+
+Mesh serving (docs/MESH_SERVING.md): with ``n_lanes > 1`` the SAME
+admission queue feeds N per-device lanes (serve/lanes.py) — each
+drained cycle is sharded across the healthy lanes (scan rows travel
+with their requests, balanced by scanned bytes), every lane has its own
+watchdog budget and circuit breaker, and host→device transfer is
+double-buffered: the dispatch loop launches cycle N on the lanes
+asynchronously and preps/pads/packs cycle N+1 while the devices crunch,
+finalizing N only when N+1's launch is in flight.  A hung or erroring
+chip degrades CAPACITY (its share fails open once, its breaker trips,
+the splitter routes around it, the half-open canary brings it back),
+never the service; the CPU confirm-only fallback engages only when
+every lane is down.
 """
 
 from __future__ import annotations
@@ -29,10 +42,17 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
-from dataclasses import dataclass, field, replace
-from typing import Callable, List, Optional
+from dataclasses import dataclass, replace
+from typing import List, Optional
 
 from ingress_plus_tpu.models.pipeline import DetectionPipeline, Verdict
+from ingress_plus_tpu.serve.lanes import (
+    CircuitBreaker,
+    DeviceHang,
+    Lane,
+    LanePool,
+    LaneWorker,
+)
 from ingress_plus_tpu.serve.normalize import Request
 from ingress_plus_tpu.serve.stream import StreamEngine, StreamState
 from ingress_plus_tpu.serve.unpack import GZIP_MAGIC, unpack_body
@@ -45,6 +65,10 @@ from ingress_plus_tpu.utils.trace import (
     SlowRing,
     TraceRing,
 )
+
+#: backward-compat alias — the single-device worker grew into
+#: serve/lanes.LaneWorker when the lane plane went per-chip
+_DeviceLane = LaneWorker
 
 #: batch-size distribution buckets: 1..4096 requests, power-of-two edges
 #: (the Q-pad tiers the engine compiles for)
@@ -67,146 +91,36 @@ def _fail_open_verdict(request_id: str) -> Verdict:
                    classes=[], rule_ids=[], score=0, fail_open=True)
 
 
-class DeviceHang(Exception):
-    """A device-lane call exceeded the hang budget."""
+class _MeshCycle:
+    """One in-flight mesh dispatch cycle: launched on the lanes,
+    finalized one drain later (the double buffer)."""
+
+    __slots__ = (
+        "t0", "guard", "route", "pipeline", "ro", "cand_items",
+        "lane_parts", "fallback_items", "finish_verdicts",
+        "n_reqs", "n_finishes", "n_stream_items", "min_ts",
+        "max_queue_delay_us", "engine_us0", "confirm_us0", "prep_us0",
+        "compiles0", "launch_d_engine", "launch_d_prep",
+        "launch_d_compiles", "overlap_drain_s",
+    )
+
+    def __init__(self):
+        self.overlap_drain_s = 0.0
 
 
-class _DeviceLane:
-    """Single-worker executor for the device dispatch, so the dispatch
-    thread can bound its wait (``call(fn, timeout)``): a wedged XLA
-    dispatch times out instead of head-of-line-blocking every tenant.
+class _CycleGuard:
+    """One armed dispatch cycle the watchdog monitor backstops: the
+    futures to release fail-open if the cycle blows past its grace.
+    With the double-buffered mesh loop up to two cycles are armed at
+    once (the launched-but-not-finalized one plus the one being
+    launched), so guards live in a list instead of a single slot."""
 
-    On timeout the lane is ABANDONED — Python cannot kill a thread
-    stuck in native code, so the batcher replaces the lane and the
-    zombie worker (at most one per hang) exits when/if the stuck call
-    returns.  A zombie that un-sticks may still mutate pipeline
-    telemetry counters concurrently with live traffic — bounded noise
-    in observability, never in verdicts (its batch's futures were
-    already resolved fail-open, and ``_safe_set`` tolerates the late
-    duplicate set)."""
+    __slots__ = ("deadline", "items", "fired")
 
-    def __init__(self, seq: int = 0):
-        self.seq = seq
-        self._q: "queue.Queue" = queue.Queue()
-        self._thread = threading.Thread(
-            target=self._run, daemon=True, name="ipt-device-%d" % seq)
-        self._thread.start()
-
-    def _run(self) -> None:
-        while True:
-            item = self._q.get()
-            if item is None:
-                return
-            fn, box, ev = item
-            try:
-                box["result"] = fn()
-            except BaseException as e:  # noqa: BLE001 — relayed to the caller
-                box["error"] = e
-            ev.set()
-
-    def call(self, fn: Callable, timeout: float):
-        box: dict = {}
-        ev = threading.Event()
-        self._q.put((fn, box, ev))
-        if not ev.wait(timeout):
-            self._q.put(None)   # the worker exits if it ever un-sticks
-            raise DeviceHang("device dispatch exceeded %.3fs" % timeout)
-        if "error" in box:
-            raise box["error"]
-        return box.get("result")
-
-    def close(self, timeout: float = 2.0) -> None:
-        self._q.put(None)
-        self._thread.join(timeout=timeout)
-
-
-class CircuitBreaker:
-    """Device-path circuit breaker (docs/ROBUSTNESS.md).
-
-    closed → open on a dispatch HANG (immediate: a wedged device does
-    not get ``failure_threshold`` more batches to wedge) or on
-    ``failure_threshold`` consecutive dispatch errors; open → half_open
-    once ``cooldown_s`` has passed; half_open routes a SINGLE canary
-    batch to the device — success closes the breaker, another
-    failure/hang re-opens it and restarts the cooldown.  While open,
-    the batcher serves through the CPU confirm-only fallback."""
-
-    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
-
-    def __init__(self, failure_threshold: int = 3,
-                 cooldown_s: float = 5.0):
-        self.failure_threshold = failure_threshold
-        self.cooldown_s = cooldown_s
-        self.state = self.CLOSED
-        self.failures = 0           # consecutive, reset on success
-        self.trips = 0
-        self.closes = 0
-        self.probes = 0
-        self.last_trip_reason: Optional[str] = None
-        self._opened_at = 0.0
-        self._lock = threading.Lock()
-
-    def route(self) -> str:
-        """Where this batch goes: "device" | "canary" | "fallback"."""
-        with self._lock:
-            if self.state == self.CLOSED:
-                return "device"
-            if self.state == self.OPEN:
-                if time.monotonic() - self._opened_at < self.cooldown_s:
-                    return "fallback"
-                self.state = self.HALF_OPEN
-                self.probes += 1
-            return "canary"
-
-    def trip(self, reason: str) -> None:
-        with self._lock:
-            self._trip_locked(reason)
-
-    def _trip_locked(self, reason: str) -> None:
-        self.state = self.OPEN
-        self._opened_at = time.monotonic()
-        self.trips += 1
-        self.failures = 0
-        self.last_trip_reason = reason
-
-    def record_failure(self, reason: str = "dispatch_error") -> None:
-        with self._lock:
-            if self.state == self.HALF_OPEN:
-                self._trip_locked("canary_" + reason)
-                return
-            self.failures += 1
-            if self.state == self.CLOSED \
-                    and self.failures >= self.failure_threshold:
-                self._trip_locked(reason)
-
-    def record_success(self) -> None:
-        with self._lock:
-            self.failures = 0
-            if self.state == self.HALF_OPEN:
-                self.state = self.CLOSED
-                self.closes += 1
-
-    def snapshot(self) -> dict:
-        with self._lock:
-            return {
-                "state": self.state,
-                "consecutive_failures": self.failures,
-                "failure_threshold": self.failure_threshold,
-                "cooldown_s": self.cooldown_s,
-                "trips": self.trips,
-                "closes": self.closes,
-                "probes": self.probes,
-                "last_trip_reason": self.last_trip_reason,
-                # the OPEN->HALF_OPEN transition only happens on the
-                # next batch (route()); probe_due tells traffic-less
-                # consumers (/readyz) that the cooldown has elapsed and
-                # the breaker WANTS a canary — readiness must come back
-                # so the canary can arrive, or an out-of-rotation pod
-                # would stay unready forever
-                "probe_due": (self.state == self.OPEN
-                              and time.monotonic() - self._opened_at
-                              >= self.cooldown_s),
-            }
+    def __init__(self, deadline: float, items: List):
+        self.deadline = deadline
+        self.items = items      # [(request_id, future), ...]
+        self.fired = False
 
 
 @dataclass
@@ -262,6 +176,8 @@ class Batcher:
         hang_budget_s: float = 30.0,
         breaker_failures: int = 3,
         breaker_cooldown_s: float = 5.0,
+        n_lanes: int = 1,
+        lane_devices=None,
     ):
         self.pipeline = pipeline
         self.stream_engine = StreamEngine(pipeline)
@@ -292,14 +208,26 @@ class Batcher:
         self._batch_ewma = Ewma(alpha=0.2)
         self._batch_ewma_n = 0   # samples seen; shedding needs a floor
         self.pipeline.load_controller.configure_deadline(hard_deadline_s)
-        self.breaker = CircuitBreaker(failure_threshold=breaker_failures,
-                                      cooldown_s=breaker_cooldown_s)
-        self._lane = _DeviceLane()
-        # (release_deadline, [(request_id, future), ...]) of the cycle
-        # the dispatch thread is currently running, or None between
-        # cycles — the monitor releases it fail-open when the dispatch
-        # thread itself wedges (grace >> the lane's own hang budget)
-        self._cycle_guard: Optional[tuple] = None
+        # per-device lane plane (serve/lanes.py, docs/MESH_SERVING.md):
+        # n_lanes == 1 is the classic single-lane fail-safe plane of
+        # PR 4 (the pool's primary breaker IS self.breaker); n_lanes > 1
+        # shards each cycle across per-chip lanes behind this one
+        # admission queue.  lane_devices defaults to the local jax
+        # devices when the pool is actually multi-lane.
+        if n_lanes > 1 and lane_devices is None:
+            try:
+                import jax
+
+                lane_devices = jax.devices()
+            except Exception:
+                lane_devices = None
+        self.lanes = LanePool(n_lanes=n_lanes, devices=lane_devices,
+                              failure_threshold=breaker_failures,
+                              cooldown_s=breaker_cooldown_s)
+        # armed dispatch cycles — the monitor releases a cycle's futures
+        # fail-open when it blows past its grace (the double-buffered
+        # mesh loop keeps up to two armed at once)
+        self._active_guards: List[_CycleGuard] = []
         self._watch_grace = 2.0 * hang_budget_s + hard_deadline_s + 1.0
         self._stop = threading.Event()
         self._swap_lock = threading.Lock()
@@ -324,6 +252,19 @@ class Batcher:
 
     # ------------------------------------------------------------- API
 
+    @property
+    def breaker(self) -> CircuitBreaker:
+        """The PRIMARY lane's breaker — the single-lane fail-safe
+        plane's breaker object, unchanged (PR 4 contract: /readyz, the
+        oversized side lane and the bench's robustness block read it).
+        Multi-lane consumers read per-lane state from ``lanes``."""
+        return self.lanes.primary.breaker
+
+    def device_available(self) -> bool:
+        """Readiness view across the lane plane: at least one lane can
+        (or wants to, probe_due) take device work."""
+        return self.lanes.any_available()
+
     def reset_latency_observations(self) -> None:
         """Zero the stage histograms, the slow-exemplar ring, AND the
         detection-plane telemetry (RuleStats + device-efficiency group).
@@ -335,6 +276,8 @@ class Batcher:
             h.reset()
         self.batch_size_hist.reset()
         self.slow.reset()
+        for lane in self.lanes.lanes:
+            lane.stats = type(lane.stats)()
         self.pipeline.reset_detection_observations()
 
     def queue_depth(self) -> int:
@@ -459,8 +402,13 @@ class Batcher:
         kind, body, headers = plan
         self.stats.oversized_rerouted += 1
         if self.breaker.state != CircuitBreaker.CLOSED:
-            # the scan plane is dead/suspect: an oversized inflate+scan
-            # against it would wedge THIS worker too — fail open now
+            # oversized scans ride the DEFAULT device (the stream
+            # engine is not lane-pinned), whose health the PRIMARY
+            # lane's breaker tracks: a suspect default device would
+            # wedge this unwatchdogged worker too — fail open now.
+            # Healthy sibling lanes don't help here (reviewer catch:
+            # an any-lane-closed gate let this worker scan a wedged
+            # default device).
             self.pipeline.stats.fail_open += 1
             _safe_set(fut, _fail_open_verdict(request.request_id))
             return
@@ -573,6 +521,31 @@ class Batcher:
             engine=old.engine.rebuilt(ruleset))
         for shape in sorted(getattr(old, "seen_shapes", ())):
             new.warm_shape(*shape)
+        # mesh lanes: the incumbent's per-lane shapes warm on the NEW
+        # pack too, each lane on its own ephemeral thread so the 8
+        # device-bound compiles overlap instead of serializing in front
+        # of the swap (docs/MESH_SERVING.md) — ephemeral threads, not
+        # the lane workers, so live dispatches are never queued behind
+        # a swap-time compile
+        lane_shapes: dict = {}
+        for lane_idx, buckets, q_pad, head in sorted(
+                getattr(old, "seen_lane_shapes", ())):
+            if lane_idx < self.lanes.n:
+                lane_shapes.setdefault(lane_idx, []).append(
+                    (buckets, q_pad, head))
+        if lane_shapes:
+            def _warm_lane(idx, shapes):
+                lane = self.lanes.lane(idx)
+                for buckets, q_pad, head in shapes:
+                    new.warm_lane_shape(buckets, q_pad, head, lane)
+
+            warmers = [threading.Thread(target=_warm_lane, args=(i, s),
+                                        name="ipt-swapwarm-%d" % i)
+                       for i, s in lane_shapes.items()]
+            for t in warmers:
+                t.start()
+            for t in warmers:
+                t.join()
         new.stats = old.stats  # counters span swaps (Prometheus contract)
         # the brownout ladder's pressure signal also spans swaps — a
         # reload under load must not reset the ladder to full detection
@@ -643,7 +616,7 @@ class Batcher:
         self._thread.join(timeout=5)
         self._oversized_thread.join(timeout=5)
         self._watchdog.join(timeout=5)
-        self._lane.close()
+        self.lanes.close()
         # requests still queued at shutdown would strand their
         # connection handlers until the client times out — resolve them
         # fail-open, the same contract the oversized side lane had
@@ -661,11 +634,14 @@ class Batcher:
 
     # ------------------------------------------------------------ loop
 
-    def _drain(self) -> List:
-        """Block for the first item, then collect until max_batch or the
-        first item's deadline."""
+    def _drain(self, first_timeout: float = 0.05) -> List:
+        """Block up to ``first_timeout`` for the first item, then
+        collect until max_batch or the first item's deadline.  The
+        double-buffered mesh loop drains with a tight first timeout
+        while a launched cycle is still in flight — finalizing the
+        previous cycle must not wait out a full idle tick."""
         try:
-            first = self._q.get(timeout=0.05)
+            first = self._q.get(timeout=first_timeout)
         except queue.Empty:
             return []
         batch = [first]
@@ -688,25 +664,30 @@ class Batcher:
         return batch
 
     def _stream_step_guarded(self, begins, chunks, finishes,
-                             route: str) -> List:
-        """Stream scan work rides the SAME watchdogged lane as the
-        batch dispatch: a device wedge first hitting a stream cycle
-        must not hang the dispatch thread past the hang budget (the
-        monitor's much larger grace is the backstop, not the budget).
-        On a hang: this cycle's stream handles are poisoned, finishes
-        resolve fail-open here, and the breaker trips like any other
+                             route: str, lane: Optional[Lane] = None) -> List:
+        """Stream scan work rides ONE watchdogged lane (the primary, or
+        the first serving lane of a mesh pool — sticky-verdict stream
+        state is pinned so chunk scans never interleave across
+        devices): a device wedge first hitting a stream cycle must not
+        hang the dispatch thread past the hang budget (the monitor's
+        much larger grace is the backstop, not the budget).  On a hang:
+        this cycle's stream handles are poisoned, finishes resolve
+        fail-open here, and THAT lane's breaker trips like any other
         device hang."""
         if not (begins or chunks or finishes):
             return []
+        if lane is None:
+            lane = self.lanes.primary
+        lane.stats.stream_cycles += 1
         try:
-            return self._lane.call(
+            return lane.call(
                 lambda: self._stream_step(begins, chunks, finishes,
                                           device_ok=(route != "fallback")),
                 self.hang_budget_s)
         except DeviceHang:
             self.stats.hangs += 1
-            self.breaker.trip("hang")
-            self._lane = _DeviceLane(self._lane.seq + 1)
+            lane.stats.hangs += 1
+            lane.breaker.trip("hang")
             for h in begins:
                 h.error = True
             for h, _ in chunks:
@@ -731,41 +712,63 @@ class Batcher:
         and counts toward the breaker.  "fallback" (breaker open) →
         the CPU confirm-only path, no device touched."""
         p = self.pipeline
+        lane = self.lanes.primary
         if route == "fallback":
             self.stats.cpu_fallback_batches += 1
             return p.detect_cpu_only(requests)
         try:
-            verdicts = self._lane.call(
+            # per-device telemetry on the single-lane path too (the
+            # device="0" series must describe real traffic, and the
+            # 1-lane mesh-scale baseline reads busy_us for utilization
+            # — reviewer catch: these stayed zero); row deltas are safe
+            # to sample here — the caller holds the swap lock
+            rows0 = p.stats.live_rows
+            padded0 = p.stats.padded_rows
+            tb0 = time.perf_counter()
+            verdicts = lane.call(
                 lambda: p.detect_strict(requests), self.hang_budget_s)
-            self.breaker.record_success()
+            lane.breaker.record_success()
+            st = lane.stats
+            st.requests += len(requests)
+            st.busy_us += int((time.perf_counter() - tb0) * 1e6)
+            # max(…, 0): a concurrent reset_detection_observations can
+            # zero the live counters mid-call — clamp, never go negative
+            st.rows += max(p.stats.live_rows - rows0, 0)
+            st.padded_rows += max(p.stats.padded_rows - padded0, 0)
             return verdicts
         except DeviceHang:
             # the stuck batch fails open NOW (the client-side budget is
-            # long blown); the zombie lane is abandoned and the breaker
-            # opens so the next batches go to the CPU fallback
+            # long blown); the zombie lane worker is abandoned
+            # (lane.call) and the breaker opens so the next batches go
+            # to the CPU fallback
             self.stats.hangs += 1
-            self.breaker.trip("hang")
-            self._lane = _DeviceLane(self._lane.seq + 1)
+            lane.stats.hangs += 1
+            lane.breaker.trip("hang")
         except Exception:
             # batcher-level fail-open regardless of the pipeline's own
             # fail_open flag (the serve plane's contract) — but the
             # breaker gets to COUNT the failure first, which is why this
             # path calls detect_strict rather than detect
-            self.breaker.record_failure()
+            lane.stats.errors += 1
+            lane.breaker.record_failure()
         p.stats.fail_open += len(requests)
         return [_fail_open_verdict(r.request_id) for r in requests]
 
     def _detect_candidate(self, requests: List[Request], ro,
-                          route: str) -> List[Verdict]:
+                          route: str,
+                          lane: Optional[Lane] = None) -> List[Verdict]:
         """Candidate-generation dispatch for the canary ramp
-        (control/rollout.py).  Rides the SAME watchdogged lane and
-        follows the cycle's breaker route (breaker open → the candidate
-        scans CPU-only too: a suspect device must not be probed by the
-        canary either) — but failures are attributed to the CANDIDATE:
-        they count toward the rollout's rollback triggers and NEVER
-        toward the shared breaker, so a bad candidate pack cannot push
-        the incumbent path onto its CPU fallback."""
+        (control/rollout.py).  Rides a watchdogged lane (the primary,
+        or the mesh cycle's serving lane) and follows the cycle's
+        breaker route (breaker open → the candidate scans CPU-only too:
+        a suspect device must not be probed by the canary either) — but
+        failures are attributed to the CANDIDATE: they count toward the
+        rollout's rollback triggers and NEVER toward the shared
+        breaker, so a bad candidate pack cannot push the incumbent path
+        onto its CPU fallback."""
         cand = ro.candidate
+        if lane is None:
+            lane = self.lanes.primary
         if cand is None:
             # rolled back between split and dispatch: serve these
             # through the incumbent — the generation they now belong to
@@ -773,18 +776,50 @@ class Batcher:
         if route == "fallback":
             return cand.detect_cpu_only(requests)
         try:
-            return self._lane.call(
+            return lane.call(
                 lambda: cand.detect_strict(requests), self.hang_budget_s)
         except DeviceHang:
             self.stats.hangs += 1
-            self._lane = _DeviceLane(self._lane.seq + 1)
+            lane.stats.hangs += 1
             ro.record_candidate_failure("hang")
         except Exception:
             ro.record_candidate_failure("error")
         self.pipeline.stats.fail_open += len(requests)
         return [_fail_open_verdict(r.request_id) for r in requests]
 
+    def _arm_guard(self, t0: float, items: List) -> _CycleGuard:
+        g = _CycleGuard(t0 + self._watch_grace, items)
+        self._active_guards.append(g)
+        return g
+
+    def _classify_batch(self, batch: List, t0: float):
+        """Shared cycle prologue (single-lane loop AND mesh launch —
+        one copy, not two drifting ones): split the drained items by
+        kind, book the admission counters, arm the watchdog guard.
+        Returns (reqs, begins, chunks, finishes, guard)."""
+        self.stats.batches += 1
+        reqs = [(ts, r, fut) for k, ts, r, fut in batch if k == "req"]
+        begins = [h for k, _, h, _ in batch if k == "begin"]
+        chunks = [pair for k, _, pair, _ in batch if k == "chunk"]
+        finishes = [(h, fut) for k, _, h, fut in batch if k == "finish"]
+        self.stats.max_batch_seen = max(self.stats.max_batch_seen,
+                                        len(reqs))
+        for ts, _, _ in reqs:
+            self.stats.queue_delay_us_sum += int((t0 - ts) * 1e6)
+        items = [(r.request_id, fut) for _ts, r, fut in reqs]
+        items += [(h.request.request_id, fut) for h, fut in finishes]
+        return reqs, begins, chunks, finishes, self._arm_guard(t0, items)
+
+    def _clear_guard(self, guard: _CycleGuard) -> None:
+        try:
+            self._active_guards.remove(guard)
+        except ValueError:
+            pass
+
     def _run(self) -> None:
+        if self.lanes.n > 1:
+            self._run_mesh()
+            return
         while not self._stop.is_set():
             batch = self._drain()
             if not batch:
@@ -794,20 +829,10 @@ class Batcher:
                 self.pipeline.load_controller.observe(0.0)
                 continue
             t0 = time.perf_counter()
-            self.stats.batches += 1
-            reqs = [(ts, r, fut) for k, ts, r, fut in batch if k == "req"]
-            begins = [h for k, _, h, _ in batch if k == "begin"]
-            chunks = [p for k, _, p, _ in batch if k == "chunk"]
-            finishes = [(h, fut) for k, _, h, fut in batch if k == "finish"]
-            self.stats.max_batch_seen = max(self.stats.max_batch_seen,
-                                            len(reqs))
-            for ts, _, _ in reqs:
-                self.stats.queue_delay_us_sum += int((t0 - ts) * 1e6)
-            # arm the monitor: if THIS cycle wedges past every budget,
-            # the watchdog releases its futures fail-open
-            guard = [(r.request_id, fut) for _ts, r, fut in reqs]
-            guard += [(h.request.request_id, fut) for h, fut in finishes]
-            self._cycle_guard = (t0 + self._watch_grace, guard)
+            # prologue + arm the monitor: if THIS cycle wedges past
+            # every budget, the watchdog releases its futures fail-open
+            reqs, begins, chunks, finishes, guard = \
+                self._classify_batch(batch, t0)
             # one breaker decision per cycle: requests AND stream scan
             # work follow it (a wedged device must not be probed twice)
             route = self.breaker.route()
@@ -854,7 +879,7 @@ class Batcher:
                                     for r in requests]
                     for (ts, r, fut), v in zip(normal, verdicts):
                         _safe_set(fut, v)
-                        done.append((ts, r, v))
+                        done.append((ts, r, v, 0))
                 cand_verdicts: List[Verdict] = []
                 if cand_items:
                     creqs = [r for _, r, _ in cand_items]
@@ -866,7 +891,7 @@ class Batcher:
                                          for r in creqs]
                     for (ts, r, fut), v in zip(cand_items, cand_verdicts):
                         _safe_set(fut, v)
-                        done.append((ts, r, v))
+                        done.append((ts, r, v, 0))
                 # end-delta sample, still under the lock (stats object
                 # survives hot-swaps; the side lane can't interleave)
                 ps = self.pipeline.stats
@@ -880,12 +905,12 @@ class Batcher:
             # needs the swap lock the dispatch thread just released)
             if ro is not None:
                 if ro.shadow_active:
-                    for _ts, r, v in done:
+                    for _ts, r, v, _lane in done:
                         ro.mirror(r, v)
                 if cand_items:
                     ro.observe_canary(len(cand_items), cand_verdicts)
                 ro.tick()
-            self._cycle_guard = None
+            self._clear_guard(guard)
             t_end = time.perf_counter()
             took = t_end - t0
             # fail-safe plane signals: cycle-time EWMA feeds the
@@ -934,6 +959,317 @@ class Batcher:
             self.traces.record(trace)
             self._observe(trace, done, finish_verdicts, t0, t_end)
 
+    # ------------------------------------------------- mesh (N lanes)
+
+    def _run_mesh(self) -> None:
+        """Double-buffered per-lane dispatch loop (docs/MESH_SERVING.md)
+        — the mesh-mode twin of ``_run``.  Software-pipelined with
+        depth 1: cycle N's device dispatch is launched asynchronously
+        on the lane workers, then THIS thread drains and preps cycle
+        N+1 (normalize/pad/pack — the host-CPU cost) while the chips
+        crunch, and only then finalizes N (bounded per-lane waits,
+        confirm, verdict futures).  Under load the host prep and the
+        device scan fully overlap; idle, the pending cycle finalizes
+        after at most one batch window."""
+        pending: Optional[_MeshCycle] = None
+        while not self._stop.is_set():
+            if pending is None:
+                batch = self._drain()
+                if not batch:
+                    # idle drain: decay the brownout ladder's signal
+                    self.pipeline.load_controller.observe(0.0)
+                    continue
+            else:
+                td0 = time.perf_counter()
+                batch = self._drain(first_timeout=self.max_delay_s)
+                # the interleaved drain wait is the double buffer's
+                # idle window, not cycle N's service time — excluded
+                # from its clock so the queue-math EWMA and the
+                # deadline-overrun accounting describe real work
+                # (reviewer catch)
+                pending.overlap_drain_s += time.perf_counter() - td0
+            cycle = self._launch_cycle(batch) if batch else None
+            if pending is not None:
+                self._finalize_cycle(pending)
+            pending = cycle
+        if pending is not None:
+            # shutdown with a cycle in flight: its futures must still
+            # resolve (exactly-one-verdict outlives the loop)
+            try:
+                self._finalize_cycle(pending)
+            except Exception:
+                for rid, fut in pending.guard.items:
+                    if not fut.done():
+                        self.pipeline.stats.fail_open += 1
+                        _safe_set(fut, _fail_open_verdict(rid))
+                self._clear_guard(pending.guard)
+
+    def _launch_cycle(self, batch: List) -> "_MeshCycle":
+        """Phase A of a mesh cycle: classify the drained batch, run the
+        pinned-lane stream step, reroute oversized bodies, canary-split,
+        shard the remaining requests across the serving lanes (balanced
+        by scanned bytes, half-open lanes capped to a canary share) and
+        LAUNCH each lane's scan asynchronously.  Returns without
+        touching any device result — the transfer/compute runs while
+        the caller preps the next cycle."""
+        t0 = time.perf_counter()
+        c = _MeshCycle()
+        c.t0 = t0
+        reqs, begins, chunks, finishes, c.guard = \
+            self._classify_batch(batch, t0)
+        c.n_reqs = len(reqs)
+        c.n_finishes = len(finishes)
+        c.n_stream_items = len(begins) + len(chunks) + len(finishes)
+        c.min_ts = min(ts for _, ts, _, _ in batch)
+        c.max_queue_delay_us = max(
+            ((t0 - ts) * 1e6 for _, ts, _, _ in batch), default=0.0)
+        # one breaker decision per lane per cycle; no serving lane at
+        # all ⇒ the whole cycle rides the global CPU fallback
+        targets = self.lanes.routes()
+        c.route = "device" if targets else "fallback"
+        with self._swap_lock:
+            # in-flight cycles finalize on the generation that launched
+            # them (the hot-swap contract: in-flight batches finish on
+            # the old tables) — capture under the lock
+            c.pipeline = self.pipeline
+            ps = c.pipeline.stats
+            c.engine_us0, c.confirm_us0 = ps.engine_us, ps.confirm_us
+            c.prep_us0, c.compiles0 = ps.prep_us, ps.engine_compiles
+            # stream scans are NOT lane-pinned on device: the stream
+            # engine dispatches to the DEFAULT device, so stream work
+            # always rides the PRIMARY lane (which owns it).  Routing
+            # it to a healthy sibling when the primary is sick would
+            # hang that sibling's worker on the same wedged default
+            # device and cascade-trip the whole pool (reviewer catch);
+            # instead streams degrade fail-open while the primary's
+            # breaker is open — batch traffic keeps riding the healthy
+            # lanes.
+            primary = self.lanes.primary
+            stream_route = ("device"
+                            if any(ln is primary for ln, _ in targets)
+                            else "fallback")   # primary down ⇒ poison
+            c.finish_verdicts = self._stream_step_guarded(
+                begins, chunks, finishes, stream_route, lane=primary)
+            # the stream step may just have tripped the primary's
+            # breaker: drop newly-OPEN lanes from this cycle's targets
+            # so no share dispatches to a known-wedged worker
+            targets = [(ln, r) for ln, r in targets
+                       if ln.breaker.state != CircuitBreaker.OPEN]
+            if not targets:
+                c.route = "fallback"
+            normal = []
+            for item in reqs:
+                ts, r, fut = item
+                try:
+                    plan = self._reroute_plan(r)
+                except Exception:
+                    plan = None   # fall back to the batched path
+                if plan is not None:
+                    self._submit_oversized(ts, r, plan, fut)
+                else:
+                    normal.append(item)
+            ro = self.rollout
+            c.cand_items = []
+            if ro is not None and ro.canary_active:
+                normal, c.cand_items = ro.split(normal)
+            c.ro = ro
+            c.lane_parts = []
+            c.fallback_items = []
+            if normal and not targets:
+                c.fallback_items = normal
+            elif normal:
+                shares = LanePool.split(
+                    normal, targets,
+                    weight=lambda it: len(it[1].body) + len(it[1].uri)
+                    + 64)
+                first_share = True
+                for (lane, lroute), part in zip(targets, shares):
+                    if not part:
+                        continue
+                    try:
+                        job = c.pipeline.detect_launch(
+                            [r for _, r, _ in part], lane=lane,
+                            count_batch=first_share)
+                        first_share = False
+                    except Exception:
+                        # host prep died for this share: fail it open
+                        # and count the failure against THIS lane only
+                        lane.stats.errors += 1
+                        lane.breaker.record_failure()
+                        c.pipeline.stats.fail_open += len(part)
+                        for _ts, r, fut in part:
+                            _safe_set(fut,
+                                      _fail_open_verdict(r.request_id))
+                        continue
+                    lane.stats.requests += len(part)
+                    lane.stats.rows += job.live_rows
+                    lane.stats.padded_rows += job.padded_rows
+                    c.lane_parts.append((lane, lroute, part, job))
+            c.launch_d_engine = ps.engine_us - c.engine_us0
+            c.launch_d_prep = ps.prep_us - c.prep_us0
+            c.launch_d_compiles = ps.engine_compiles - c.compiles0
+        return c
+
+    def _finalize_cycle(self, c: "_MeshCycle") -> None:
+        """Phase B of a mesh cycle: bounded per-lane collection (wait,
+        mask, confirm, score), per-lane breaker accounting, the global
+        CPU fallback share, the canary candidate share, verdict
+        resolution, rollout hooks and the cycle's observability."""
+        done: List = []   # (submit_ts, request, verdict, lane_idx)
+        p = c.pipeline
+        # ONE hang budget for the whole collection: the lanes dispatched
+        # concurrently at launch, so they share the deadline — k
+        # simultaneously wedged lanes must stall the dispatch thread
+        # for one budget, not k stacked budgets (reviewer catch); a
+        # healthy lane that finished long ago returns instantly
+        # regardless of what its siblings burned
+        collect_deadline = time.perf_counter() + self.hang_budget_s
+        with self._swap_lock:
+            ps = p.stats
+            e0, cf0 = ps.engine_us, ps.confirm_us
+            pp0, cp0 = ps.prep_us, ps.engine_compiles
+            for lane, lroute, part, job in c.lane_parts:
+                try:
+                    verdicts = p.detect_collect(
+                        job, timeout=max(
+                            collect_deadline - time.perf_counter(),
+                            0.001))
+                    lane.breaker.record_success()
+                    lane.stats.busy_us += job.busy_us
+                    for (ts, r, fut), v in zip(part, verdicts):
+                        _safe_set(fut, v)
+                        done.append((ts, r, v, lane.index))
+                except DeviceHang:
+                    # THIS chip wedged: its share fails open, its
+                    # breaker trips, its zombie worker is abandoned —
+                    # the sibling lanes' collections proceed untouched
+                    self.stats.hangs += 1
+                    lane.stats.hangs += 1
+                    lane.breaker.trip("hang")
+                    lane.abandon_worker()
+                    done += self._fail_open_part(p, part, lane.index)
+                except Exception:
+                    lane.stats.errors += 1
+                    lane.breaker.record_failure()
+                    done += self._fail_open_part(p, part, lane.index)
+            if c.fallback_items:
+                # every lane down: exact CPU confirm-only verdicts, the
+                # PR 4 fallback as the mesh's last resort
+                self.stats.cpu_fallback_batches += 1
+                freqs = [r for _, r, _ in c.fallback_items]
+                try:
+                    verdicts = p.detect_cpu_only(freqs)
+                    for (ts, r, fut), v in zip(c.fallback_items,
+                                               verdicts):
+                        _safe_set(fut, v)
+                        done.append((ts, r, v, -1))
+                except Exception:
+                    done += self._fail_open_part(p, c.fallback_items, -1)
+            cand_verdicts: List[Verdict] = []
+            if c.cand_items:
+                creqs = [r for _, r, _ in c.cand_items]
+                cand_lane = (c.lane_parts[0][0] if c.lane_parts
+                             else self.lanes.primary)
+                try:
+                    cand_verdicts = self._detect_candidate(
+                        creqs, c.ro, c.route, lane=cand_lane)
+                except Exception:
+                    cand_verdicts = [_fail_open_verdict(r.request_id)
+                                     for r in creqs]
+                for (ts, r, fut), v in zip(c.cand_items, cand_verdicts):
+                    _safe_set(fut, v)
+                    done.append((ts, r, v, cand_lane.index))
+            d_engine = c.launch_d_engine + ps.engine_us - e0
+            d_confirm = ps.confirm_us - cf0   # confirm runs only here
+            d_prep = c.launch_d_prep + ps.prep_us - pp0
+            d_compiles = c.launch_d_compiles + ps.engine_compiles - cp0
+        ro = c.ro
+        if ro is not None:
+            if ro.shadow_active:
+                for _ts, r, v, _lane in done:
+                    ro.mirror(r, v)
+            if c.cand_items:
+                ro.observe_canary(len(c.cand_items), cand_verdicts)
+            ro.tick()
+        self._clear_guard(c.guard)
+        t_end = time.perf_counter()
+        took = max(t_end - c.t0 - c.overlap_drain_s, 0.0)
+        if d_compiles == 0:
+            self._batch_ewma.update(min(took, 2.0 * self.hard_deadline_s))
+            self._batch_ewma_n += 1
+            self.pipeline.load_controller.observe(c.max_queue_delay_us)
+        self.stats.batch_us_sum += int(took * 1e6)
+        if took > self.hard_deadline_s:
+            self.stats.deadline_overruns += c.n_reqs + c.n_finishes
+        self.stats.completed += c.n_reqs + c.n_finishes
+        trace = BatchTrace(
+            ts=time.time(),
+            n_requests=c.n_reqs,
+            n_stream_items=c.n_stream_items,
+            queue_delay_us=int((c.t0 - c.min_ts) * 1e6),
+            batch_us=int(took * 1e6),
+            engine_us=d_engine,
+            confirm_us=d_confirm,
+            prep_us=d_prep,
+            request_ids=[r.request_id for _ts, r, _v, _l in done]
+            + [h.request.request_id for h, _ in c.finish_verdicts])
+        self.traces.record(trace)
+        self._observe(trace, done, c.finish_verdicts, c.t0, t_end)
+
+    def _fail_open_part(self, pipeline, part, lane_idx: int) -> List:
+        """Resolve one lane share fail-open; returns its done-entries
+        so the e2e histogram and slow ring still see these requests."""
+        out = []
+        pipeline.stats.fail_open += len(part)
+        for ts, r, fut in part:
+            v = _fail_open_verdict(r.request_id)
+            _safe_set(fut, v)
+            out.append((ts, r, v, lane_idx))
+        return out
+
+    def warm_lanes(self, max_batch: Optional[int] = None) -> None:
+        """Pre-compile every per-lane executable an all-healthy mesh
+        dispatch can hit (the mesh twin of server.warmup_pipeline):
+        every lane warms EVERY Q-pad tier up to max_batch (not just its
+        1/N share of an all-healthy split — when siblings die, the
+        rebalanced shares grow toward max_batch, and a serve-time
+        compile past the hang budget would read as a HANG and trip the
+        recovering lane's breaker; observed on the first cut of this
+        path).  Each tier dispatches on all lanes CONCURRENTLY —
+        detect_launch is async on each lane's own worker, so an 8-lane
+        start pays ONE overlapped compile pass per tier, not 8 serial
+        full-corpus warmups, and each device-bound executable compiles
+        exactly once (the recompile gauge keys on (lane, shape), so
+        serve-time recompiles stay 0 — asserted in the e2e test).
+        Head-sliced twins (docs/SCAN_KERNEL.md) are warmed by a
+        bodyless pass when the pack is word-tiered."""
+        from ingress_plus_tpu.utils.corpus import generate_corpus
+
+        import dataclasses
+
+        if max_batch is None:
+            max_batch = self.max_batch
+        reqs = [lr.request for lr in generate_corpus(n=max_batch, seed=1)]
+        variants = [reqs]
+        slicing = getattr(self.pipeline.engine, "head_slicing_active",
+                          None)
+        if slicing is not None and slicing():
+            variants.append([dataclasses.replace(r, body=b"")
+                             for r in reqs])
+        from ingress_plus_tpu.models.pipeline import warm_sizes
+
+        for corpus in variants:
+            for size in warm_sizes(max_batch):
+                jobs = []
+                with self._swap_lock:
+                    for lane in self.lanes.lanes:
+                        jobs.append((lane, self.pipeline.detect_launch(
+                            corpus[:size], lane=lane)))
+                    for _lane, job in jobs:
+                        self.pipeline.detect_collect(job, timeout=None)
+        # warmup traffic must not pollute the detection telemetry
+        self.pipeline.reset_detection_observations()
+
     def _watch(self) -> None:
         """Monitor thread: last-resort backstop for a wedged DISPATCH
         THREAD (the device lane already bounds the device call; this
@@ -945,21 +1281,19 @@ class Batcher:
         a dead dispatcher."""
         period = min(max(self.hang_budget_s / 4.0, 0.05), 1.0)
         stuck_at_batches: Optional[int] = None
-        fired_guard: Optional[tuple] = None
         while not self._stop.wait(period):
-            guard = self._cycle_guard
-            # NEVER write _cycle_guard from here: the dispatch thread
-            # is its only writer — a monitor-side clear could race the
-            # dispatcher un-sticking and clobber the NEXT cycle's
-            # freshly armed guard, leaving that cycle unprotected.
-            # Identity-tracking the fired guard gives the same
-            # fire-once behavior without the write.
-            if (guard is not None and guard is not fired_guard
-                    and time.perf_counter() > guard[0]):
-                fired_guard = guard
+            # NEVER remove from _active_guards here: the dispatch
+            # thread is its only mutator — a monitor-side removal could
+            # race the dispatcher un-sticking and drop the NEXT cycle's
+            # freshly armed guard.  The per-guard fired flag gives
+            # fire-once behavior without touching the list.
+            for guard in list(self._active_guards):
+                if guard.fired or time.perf_counter() <= guard.deadline:
+                    continue
+                guard.fired = True
                 released = 0
                 st = self.pipeline.stats
-                for rid, fut in guard[1]:
+                for rid, fut in guard.items:
                     if not fut.done():
                         st.fail_open += 1
                         _safe_set(fut, _fail_open_verdict(rid))
@@ -1012,7 +1346,7 @@ class Batcher:
             self.batch_size_hist.observe(trace.n_requests)
         stages = None                 # built only if something IS slow
         thr = self.slow.threshold()   # skip dict build for fast requests
-        for ts, r, v in done:
+        for ts, r, v, lane_idx in done:
             queue_us = int((t0 - ts) * 1e6)
             e2e_us = int((t_end - ts) * 1e6)
             h["queue"].observe(queue_us)
@@ -1021,8 +1355,10 @@ class Batcher:
                 continue
             if stages is None:
                 stages = trace.stages()
+            # lane attribution on the exemplar (docs/MESH_SERVING.md):
+            # /debug/slow shows WHICH device served a slow request
             self.slow.offer(e2e_us, self._exemplar(
-                r, v, trace.ts, queue_us, batch=stages))
+                r, v, trace.ts, queue_us, batch=stages, lane=lane_idx))
         for handle, v in finish_verdicts:
             # streams: end-to-end is begin→finish (the verdict's own
             # clock), not this cycle's queue wait

@@ -435,6 +435,41 @@ class ServeLoop:
             "# TYPE ipt_cpu_fallback_batches_total counter",
             "ipt_cpu_fallback_batches_total %d" % s.cpu_fallback_batches,
         ]
+        # --- per-device lane plane (docs/MESH_SERVING.md): one series
+        # per lane, labeled device= — a single-lane server emits
+        # device="0" so dashboards are mesh-shape-agnostic.  The
+        # unlabeled aggregates above keep their PR 4 meaning.
+        lane_snaps = self.batcher.lanes.snapshot()
+        brk_num = {"closed": 0, "half_open": 1, "open": 2}
+        lines.append("# TYPE ipt_lane_count gauge")
+        lines.append("ipt_lane_count %d" % len(lane_snaps))
+        # labeled twins of metrics whose TYPE lines (and unlabeled
+        # aggregates) were emitted above — no duplicate TYPE lines
+        for metric, getter in (
+                ("ipt_dispatch_fill",
+                 lambda ln: (ln["dispatch_fill"]
+                             if ln["dispatch_fill"] is not None
+                             else "NaN")),
+                ("ipt_breaker_state",
+                 lambda ln: brk_num.get(ln["breaker"]["state"], 2)),
+                ("ipt_breaker_trips_total",
+                 lambda ln: ln["breaker"]["trips"]),
+                ("ipt_watchdog_hangs_total",
+                 lambda ln: ln["hangs"]),
+        ):
+            for ln in lane_snaps:
+                lines.append('%s{device="%s"} %s'
+                             % (metric, ln["lane"], getter(ln)))
+        for metric, key, mtype in (
+                ("ipt_lane_requests_total", "requests", "counter"),
+                ("ipt_lane_rows_total", "rows", "counter"),
+                ("ipt_lane_errors_total", "errors", "counter"),
+                ("ipt_lane_busy_us_sum", "busy_us", "counter"),
+        ):
+            lines.append("# TYPE %s %s" % (metric, mtype))
+            for ln in lane_snaps:
+                lines.append('%s{device="%s"} %s'
+                             % (metric, ln["lane"], ln[key]))
         lines.append("# TYPE ipt_shed_total counter")
         lines += bounded_counter_series(
             "ipt_shed_total", "reason", dict(p.shed))
@@ -599,6 +634,9 @@ class ServeLoop:
                     "hangs": s.hangs,
                     "cpu_fallback_batches": s.cpu_fallback_batches,
                     "watchdog_released": s.watchdog_released,
+                    # per-device lane plane (docs/MESH_SERVING.md);
+                    # `dbg breaker` renders the lane table from here
+                    "lanes": self.batcher.lanes.snapshot(),
                 },
             }).encode()
         if path.startswith("/readyz"):
@@ -612,8 +650,10 @@ class ServeLoop:
             # an OPEN breaker whose cooldown has elapsed (probe_due) or
             # a HALF_OPEN one counts as ready: the canary that would
             # close it can only arrive if traffic routes here again —
-            # staying unready would deadlock an out-of-rotation pod
-            if brk["state"] == "open" and not brk["probe_due"]:
+            # staying unready would deadlock an out-of-rotation pod.
+            # Mesh pools stay ready while ANY lane can serve — one dead
+            # chip is a capacity event, not a readiness event.
+            if not self.batcher.device_available():
                 reasons.append("breaker_open")
             if lc.level > 0:
                 reasons.append("degraded_%s" % lc.snapshot()["mode"])
@@ -999,7 +1039,8 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
                           breaker_cooldown_s: float = 5.0,
                           lkg_dir: Optional[str] = None,
                           rollout_steps=None,
-                          rollout_fail_on: str = "error") -> Batcher:
+                          rollout_fail_on: str = "error",
+                          n_lanes: int = 1) -> Batcher:
     from ingress_plus_tpu.compiler.ruleset import compile_ruleset
     from ingress_plus_tpu.compiler.seclang import load_seclang_dir
     from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
@@ -1026,6 +1067,16 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
                  else load_bundled_rules())
         cr = compile_ruleset(rules)
     engine = None
+    # n_lanes == 0 is the --lanes auto sentinel: it resolves to a
+    # multi-lane pool on any multi-device host, so the exclusion check
+    # must treat it as multi-lane BEFORE resolution (reviewer catch: a
+    # post-resolution check let `--mesh 2x4 --lanes auto` through)
+    if mesh_spec and n_lanes != 1:
+        raise ValueError(
+            "--mesh (TP ruleset sharding, one program over the mesh) "
+            "and --lanes (DP per-device lanes) are different "
+            "parallelizations of the same chips — pick one "
+            "(docs/MESH_SERVING.md)")
     if mesh_spec:
         # multi-chip serving: same batcher/pipeline/confirm, the scan
         # rides the DP x TP sharded step (parallel/serve_mesh)
@@ -1035,6 +1086,12 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
         engine = MeshEngine(cr, parse_mesh_spec(mesh_spec))
         print("mesh serving: %s over %d devices"
               % (mesh_spec, engine.mesh.size), file=sys.stderr)
+    if n_lanes == 0:   # --lanes auto: one lane per local device
+        import jax
+
+        n_lanes = max(1, len(jax.devices()))
+        print("lane serving: auto -> %d per-device lanes" % n_lanes,
+              file=sys.stderr)
     pipeline = DetectionPipeline(cr, mode=mode, engine=engine)
     if mesh_spec:
         if scan_impl == "pallas":
@@ -1054,7 +1111,7 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
             file=sys.stderr)
     else:
         pipeline.engine.scan_impl = scan_impl
-    if warmup:
+    if warmup and n_lanes <= 1:
         warmup_pipeline(pipeline, max_batch)
         # the warmup corpus is synthetic (20% attacks): drop it from
         # the detection-plane telemetry so /rules/* and the efficiency
@@ -1064,7 +1121,21 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
                       hard_deadline_s=hard_deadline_s, queue_cap=queue_cap,
                       hang_budget_s=hang_budget_s,
                       breaker_failures=breaker_failures,
-                      breaker_cooldown_s=breaker_cooldown_s)
+                      breaker_cooldown_s=breaker_cooldown_s,
+                      n_lanes=n_lanes)
+    if warmup and n_lanes > 1:
+        # mesh warmup (docs/MESH_SERVING.md): every lane's device-bound
+        # executables compile in ONE overlapped pass, every Q-pad tier
+        # up to max_batch per lane (degraded rebalances grow a lane's
+        # share toward max_batch, and a serve-time compile past the
+        # hang budget would read as a hang); resets the detection
+        # telemetry itself
+        import time as _t
+
+        t0 = _t.time()
+        batcher.warm_lanes()
+        print("warmup: compiled %d-lane serve shapes in %.1fs"
+              % (n_lanes, _t.time() - t0), file=sys.stderr)
     # guarded-rollout controller: idle until an admit; makes STAGED the
     # default semantics of /configuration/ruleset on this server
     cfg = RolloutConfig(fail_on=rollout_fail_on, lkg_dir=lkg_dir)
@@ -1088,12 +1159,11 @@ def warmup_pipeline(pipeline, max_batch: int) -> None:
     reqs = [lr.request for lr in generate_corpus(n=max_batch, seed=1)]
     # one size per Q-pad tier (engine executables are keyed on the padded
     # request count, powers of two with floor 4) so no live batch size
-    # triggers a fresh multi-second compile
-    sizes, q = [], 4
-    while q < max_batch:
-        sizes.append(q)
-        q *= 2
-    sizes.append(max_batch)
+    # triggers a fresh multi-second compile — the ONE shared ladder
+    # (models/pipeline.warm_sizes)
+    from ingress_plus_tpu.models.pipeline import warm_sizes
+
+    sizes = warm_sizes(max_batch)
     for size in sizes:
         pipeline.detect(reqs[:size])
     # head-sliced twin shapes (docs/SCAN_KERNEL.md): the synthetic corpus
@@ -1107,6 +1177,18 @@ def warmup_pipeline(pipeline, max_batch: int) -> None:
             pipeline.detect(bodyless[:size])
     print("warmup: compiled serve shapes in %.1fs" % (_t.time() - t0),
           file=sys.stderr)
+
+
+def _parse_lanes(value: str) -> int:
+    """--lanes: 'auto' → the internal 0 sentinel (one lane per local
+    device); integers must be >= 1 — an explicit 0 must not silently
+    collide with the sentinel and fan out per-device."""
+    if value == "auto":
+        return 0
+    n = int(value)
+    if n < 1:
+        raise SystemExit("--lanes must be >= 1 or 'auto', got %r" % value)
+    return n
 
 
 def main(argv=None) -> None:
@@ -1128,6 +1210,14 @@ def main(argv=None) -> None:
                          "'data=2,model=4' or '2x4' (DP x TP sharding "
                          "across the local chips; see parallel/"
                          "serve_mesh.py)")
+    ap.add_argument("--lanes", default="1",
+                    help="data-parallel per-device serve lanes behind "
+                         "one admission queue (docs/MESH_SERVING.md): "
+                         "an integer lane count, or 'auto' = one lane "
+                         "per local device.  Each lane gets its own "
+                         "watchdog + circuit breaker; a sick chip "
+                         "degrades capacity, not the service.  "
+                         "Mutually exclusive with --mesh")
     ap.add_argument("--scan-impl", default="auto",
                     choices=["auto", "pair", "take", "pallas", "pallas2"],
                     help="TPU scan implementation; auto = startup "
@@ -1226,7 +1316,8 @@ def main(argv=None) -> None:
         lkg_dir=args.lkg_dir,
         rollout_steps=[float(s) for s in
                        args.rollout_steps.split(",") if s.strip()],
-        rollout_fail_on=args.rollout_fail_on)
+        rollout_fail_on=args.rollout_fail_on,
+        n_lanes=_parse_lanes(args.lanes))
 
     post = None
     if args.spool_dir or args.export_url:

@@ -87,13 +87,19 @@ class FaultRule:
     ``after``: skip the first N arrivals; ``times``: fire at most N
     times (None = unlimited); ``delay_s``: sleep duration for
     hang/slow sites; ``prob``: per-arrival firing probability drawn
-    from the plan's seeded RNG (1.0 = always)."""
+    from the plan's seeded RNG (1.0 = always); ``lane``: restrict the
+    site to ONE serve lane (docs/MESH_SERVING.md) — arrivals from
+    other lanes' dispatch threads neither count nor fire, so a plan
+    like ``dispatch_hang:lane=1,times=1`` wedges exactly one chip
+    while its siblings keep serving (the lane-isolation fault
+    matrix)."""
 
     site: str
     after: int = 0
     times: Optional[int] = None
     delay_s: float = 1.0
     prob: float = 1.0
+    lane: Optional[int] = None
 
     @classmethod
     def parse(cls, text: str) -> "FaultRule":
@@ -106,14 +112,15 @@ class FaultRule:
         for part in filter(None, (p.strip() for p in argstr.split(","))):
             k, _, v = part.partition("=")
             k = k.strip()
-            if k not in ("after", "times", "delay_s", "prob"):
+            if k not in ("after", "times", "delay_s", "prob", "lane"):
                 raise ValueError("unknown fault arg %r in %r" % (k, text))
             kw[k] = float(v)
         return cls(site=site,
                    after=int(kw.get("after", 0)),
                    times=int(kw["times"]) if "times" in kw else None,
                    delay_s=float(kw.get("delay_s", 1.0)),
-                   prob=float(kw.get("prob", 1.0)))
+                   prob=float(kw.get("prob", 1.0)),
+                   lane=int(kw["lane"]) if "lane" in kw else None)
 
 
 class FaultPlan:
@@ -145,6 +152,11 @@ class FaultPlan:
         rule = self.rules.get(site)
         if rule is None:
             return None
+        if rule.lane is not None and rule.lane != current_lane():
+            # lane-targeted rule: another lane's arrival is invisible —
+            # it neither counts toward ``after`` nor consumes ``times``
+            # (per-lane arrival order is deterministic, so replays hold)
+            return None
         with self._lock:
             n = self.arrivals[site]
             self.arrivals[site] = n + 1
@@ -164,6 +176,7 @@ class FaultPlan:
                 "rules": [
                     {"site": r.site, "after": r.after, "times": r.times,
                      "delay_s": r.delay_s, "prob": r.prob,
+                     "lane": r.lane,
                      "arrivals": self.arrivals[r.site],
                      "fired": self.fired[r.site]}
                     for r in self.rules.values()
@@ -177,6 +190,20 @@ class FaultPlan:
 # sit on hot paths and must cost nothing in production.
 
 _active: Optional[FaultPlan] = None
+
+# thread-local lane attribution: each lane WORKER thread
+# (serve/lanes.py LaneWorker) stamps its lane index once at startup, so
+# ``lane=``-targeted rules can tell which chip's dispatch reached a
+# site.  The serve loop / dispatch / test threads read as None.
+_lane_local = threading.local()
+
+
+def set_current_lane(index: Optional[int]) -> None:
+    _lane_local.lane = index
+
+
+def current_lane() -> Optional[int]:
+    return getattr(_lane_local, "lane", None)
 
 
 def install(plan: Optional[FaultPlan]) -> None:
@@ -700,6 +727,131 @@ def _scenario_lkg_corrupt(install_plan) -> dict:
     return {"ok": not violations, "violations": violations}
 
 
+# ------------------------------------------------ lane-isolation
+# (serve/lanes.py, docs/MESH_SERVING.md).  The mesh invariant: a fault
+# targeted at ONE lane degrades that lane's capacity only — sibling
+# lanes keep serving real verdicts, no global CPU fallback engages,
+# every admitted request still gets exactly one verdict, and the sick
+# lane recovers through its own half-open canary.
+
+
+def _mk_lane_batcher(n_lanes: int = 2, **kw):
+    """A multi-lane batcher warmed with REAL traffic of the shapes the
+    scenarios drive (pre-plan): a serve-time XLA compile inside a
+    scenario would read as a lane hang on a busy host."""
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.batcher import Batcher
+
+    pipeline = DetectionPipeline(_matrix_ruleset(), mode="block")
+    kw.setdefault("max_batch", 16)
+    kw.setdefault("max_delay_s", 0.001)
+    b = Batcher(pipeline, n_lanes=n_lanes, **kw)
+    for wave in range(3):
+        futs = [b.submit(r) for r in
+                _requests(16, attack_every=4, tag="lw%d" % wave)]
+        _collect(futs, timeout_s=120)
+    for size in (1, 4):
+        futs = [b.submit(r) for r in _requests(size, tag="ls%d" % size)]
+        _collect(futs, timeout_s=120)
+    return b
+
+
+def _lane_states(b) -> dict:
+    return {ln.index: ln.breaker.state for ln in b.lanes.lanes}
+
+
+def _check_lane_isolation(b, sick: int, violations) -> None:
+    """Shared asserts: only the sick lane tripped, siblings closed, no
+    global CPU fallback, and fresh traffic still detects attacks."""
+    for ln in b.lanes.lanes:
+        if ln.index == sick:
+            if ln.breaker.trips < 1:
+                violations.append("lane %d breaker never tripped on its "
+                                  "targeted fault" % sick)
+        elif ln.breaker.trips > 0:
+            violations.append("HEALTHY lane %d breaker tripped (%s) — "
+                              "the fault leaked across lanes"
+                              % (ln.index, ln.breaker.last_trip_reason))
+    if b.stats.cpu_fallback_batches:
+        violations.append("global CPU fallback engaged with healthy "
+                          "lanes available")
+    vs, viol = _collect([b.submit(r) for r in
+                         _requests(12, attack_every=3, tag="li")], 60)
+    _check_verdicts(vs, viol, 12)
+    violations.extend(viol)
+    if not any(v.attack and not v.fail_open for v in vs):
+        violations.append("healthy lanes lost detection after the "
+                          "single-lane fault")
+
+
+def _drive_lane_recovery(b, sick: int, violations,
+                         deadline_s: float = 20.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while b.lanes.lane(sick).breaker.state != "closed" \
+            and time.monotonic() < deadline:
+        _collect([b.submit(r) for r in _requests(8, tag="lr")], 30)
+        time.sleep(0.1)
+    if b.lanes.lane(sick).breaker.state != "closed":
+        violations.append("sick lane %d never recovered half-open "
+                          "(state=%s)" % (sick,
+                                          b.lanes.lane(sick).breaker.state))
+
+
+def _scenario_lane_dispatch_hang(install_plan) -> dict:
+    """dispatch_hang targeted at lane 1 of a 2-lane mesh: lane 1's
+    share fails open once and ITS breaker trips; lane 0 serves every
+    cycle uninterrupted; lane 1 recovers through its half-open
+    canary."""
+    # generous hang budget: a loaded 1-core CI host can starve an
+    # HONEST lane dispatch for a second-plus, and a contention-tripped
+    # healthy lane would fail the isolation assert (observed flake)
+    b = _mk_lane_batcher(hang_budget_s=3.0, breaker_cooldown_s=0.5)
+    install_plan(FaultPlan.from_spec(
+        "dispatch_hang:lane=1,times=1,delay_s=8.0"))
+    try:
+        violations: List[str] = []
+        futs = [b.submit(r) for r in _requests(24, attack_every=4,
+                                               tag="lh")]
+        verdicts, viol = _collect(futs, timeout_s=60)
+        _check_verdicts(verdicts, viol, 24)
+        violations += viol
+        if not any(v.fail_open for v in verdicts):
+            violations.append("hung lane's share did not fail open")
+        if not any(v.attack and not v.fail_open for v in verdicts):
+            violations.append("sibling lane served no real verdicts "
+                              "during the hang")
+        _check_lane_isolation(b, sick=1, violations=violations)
+        _drive_lane_recovery(b, sick=1, violations=violations)
+        return {"ok": not violations, "violations": violations,
+                "lanes": _lane_states(b), "hangs": b.stats.hangs}
+    finally:
+        b.close()
+
+
+def _scenario_lane_dispatch_raise(install_plan) -> dict:
+    """dispatch_raise targeted at lane 1: consecutive errors open only
+    lane 1's breaker (failure_threshold=2), siblings keep serving, no
+    global fallback, half-open recovery once the fault exhausts."""
+    b = _mk_lane_batcher(breaker_failures=2, breaker_cooldown_s=0.3)
+    install_plan(FaultPlan.from_spec("dispatch_raise:lane=1,times=2"))
+    try:
+        violations: List[str] = []
+        for wave in range(3):
+            futs = [b.submit(r) for r in
+                    _requests(8, attack_every=4, tag="le%d" % wave)]
+            verdicts, viol = _collect(futs, timeout_s=60)
+            _check_verdicts(verdicts, viol, 8)
+            violations += viol
+            time.sleep(0.05)
+        _check_lane_isolation(b, sick=1, violations=violations)
+        _drive_lane_recovery(b, sick=1, violations=violations)
+        return {"ok": not violations, "violations": violations,
+                "lanes": _lane_states(b),
+                "errors": [ln.stats.errors for ln in b.lanes.lanes]}
+    finally:
+        b.close()
+
+
 SCENARIOS = {
     "overload_burst": _scenario_overload,
     "dispatch_hang": _scenario_dispatch_hang,
@@ -711,6 +863,8 @@ SCENARIOS = {
     "rollout_promote_fail": _scenario_rollout_promote_fail,
     "rollout_shadow_diverge": _scenario_rollout_shadow_diverge,
     "lkg_corrupt": _scenario_lkg_corrupt,
+    "lane_dispatch_hang": _scenario_lane_dispatch_hang,
+    "lane_dispatch_raise": _scenario_lane_dispatch_raise,
 }
 
 

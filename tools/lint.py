@@ -58,10 +58,11 @@ if str(REPO) not in sys.path:  # script execution puts tools/ first
 #: the mypy gate is TARGETED: the correctness-critical planes first;
 #: widen as modules gain annotations (zero-warning baseline per scope)
 MYPY_SCOPE = ["ingress_plus_tpu/compiler", "ingress_plus_tpu/analysis",
-              "ingress_plus_tpu/serve",
+              "ingress_plus_tpu/serve",   # includes serve/lanes.py
               "ingress_plus_tpu/models/rule_stats.py",
               "ingress_plus_tpu/post/topk.py",
-              "ingress_plus_tpu/control/rollout.py"]
+              "ingress_plus_tpu/control/rollout.py",
+              "ingress_plus_tpu/parallel/serve_mesh.py"]
 
 
 def _tool_available(module: str, binary: str) -> bool:
