@@ -983,6 +983,43 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
         % (len(sample), tp, fn, fp))
     result["quality_sample"] = {"requests": len(sample), "tp": tp,
                                 "fn": fn, "fp": fp}
+    # learned-scorer quality leg (ISSUE 8, docs/LEARNED_SCORING.md):
+    # per-family precision/recall + the fixed-vs-learned comparison at
+    # the calibrated threshold — the ModSec-Learn claim as a measured
+    # block in the driver artifact, never an assertion.  A deterministic
+    # seeded retrain on the golden corpus, so the block reproduces.
+    try:
+        from ingress_plus_tpu.utils.evalf1 import evaluate as _f1_eval
+        from ingress_plus_tpu.utils.export_corpus import (
+            build_feature_dataset)
+        from ingress_plus_tpu.learn.train import train_from_dataset
+
+        t_sc = time.time()
+        ds = build_feature_dataset(n=1024, seed=20260729,
+                                   ruleset=pipeline.ruleset)
+        head = train_from_dataset(ds)
+        rep = _f1_eval(n=1024, batch=128, seed=20260729,
+                       pipeline=pipeline, warm=False, scoring_head=head)
+        result["scorer_quality"] = {
+            "head_version": head.version,
+            "threshold": round(float(head.threshold), 6),
+            "per_family_precision": rep.per_family,
+            "per_class_recall": rep.per_class_recall,
+            "comparison": rep.scorer_comparison,
+            "train_eval_s": round(time.time() - t_sc, 1),
+        }
+        cmpb = rep.scorer_comparison or {}
+        log("scorer quality: fixed fp=%s learned fp=%s new_fn=%s "
+            "(threshold %.3f)"
+            % (cmpb.get("fixed", {}).get("fp"),
+               cmpb.get("learned", {}).get("fp"),
+               cmpb.get("new_fn_vs_fixed"), head.threshold))
+        if cmpb.get("new_fn_vs_fixed", 0):
+            log("WARNING: learned head LOST attacks the fixed weights "
+                "caught — the zero-new-FN calibration did not hold on "
+                "this corpus")
+    except Exception as e:
+        log("scorer quality leg failed (non-fatal): %r" % (e,))
     # the full adversarial eval (non-self-referential: public classic
     # payloads x encoding evasions + 10k benign requests) is pinned by
     # tests/test_quality.py and written to reports/QUALITY.json — embed

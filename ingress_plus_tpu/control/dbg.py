@@ -15,6 +15,7 @@ state.  Same idea against our HTTP plane:
         [--fail-on error]
     python -m ingress_plus_tpu.control.dbg rules    [--server host:port]
     python -m ingress_plus_tpu.control.dbg drift    [--server host:port]
+    python -m ingress_plus_tpu.control.dbg scoring  [--swap head.npz] [--force]
     python -m ingress_plus_tpu.control.dbg breaker  [--server host:port]
     python -m ingress_plus_tpu.control.dbg faults   [--set 'site:times=1']
 
@@ -284,6 +285,45 @@ def render_rollout(st: dict) -> str:
     return "\n".join(lines)
 
 
+def render_scoring(st: dict) -> str:
+    """Terminal view for `dbg scoring`: the learned scoring lane out of
+    /scoring (docs/LEARNED_SCORING.md) — installed head, operating
+    point, and the live fixed-vs-learned divergence counters."""
+    if not st.get("active"):
+        lines = ["scoring: FIXED CRS weights (no learned head installed)",
+                 "  anomaly_threshold=%s  generation=%s"
+                 % (st.get("anomaly_threshold"), st.get("generation"))]
+        return "\n".join(lines)
+    head = st.get("head") or {}
+    diff = st.get("diff") or {}
+    lines = [
+        "scoring: LEARNED head %s  (fixed threshold=%s still exported)"
+        % (head.get("version", "?"), st.get("anomaly_threshold")),
+        "  threshold=%s  bias=%s  rules_in_head=%s  coverage=%s"
+        % (head.get("threshold"), head.get("bias"),
+           head.get("rules_in_head"), head.get("coverage")),
+        "  bound to ruleset %s  (generation %s)"
+        % (head.get("bound_ruleset"), st.get("generation")),
+        "  divergence: %s"
+        % (", ".join("%s=%d" % kv for kv in sorted(diff.items())) or
+           "none observed"),
+    ]
+    prov = head.get("provenance") or {}
+    base = prov.get("baseline") or {}
+    if base:
+        lines.append("  trained: dataset=%s  fp %s->%s  new_fn=%s"
+                     % (prov.get("dataset", "?"),
+                        (base.get("fixed") or {}).get("fp"),
+                        (base.get("learned") or {}).get("fp"),
+                        base.get("new_fn_vs_fixed")))
+    tw = head.get("top_weights") or []
+    if tw:
+        lines.append("  top weights: %s"
+                     % ", ".join("%s=%+.3f" % (w["rule_id"], w["weight"])
+                                 for w in tw[:8]))
+    return "\n".join(lines)
+
+
 def render_drift(drift: dict, top: int = 20) -> str:
     """Terminal table for `dbg drift`: per-rule hit-rate deltas across
     the most recent hot reload, went-quiet rules first."""
@@ -320,7 +360,7 @@ def main(argv=None) -> int:
                     choices=["conf", "health", "metrics", "latency",
                              "tenants", "ruleset", "acl", "rulecheck",
                              "rules", "drift", "breaker", "faults",
-                             "rollout"])
+                             "rollout", "scoring"])
     ap.add_argument("--server", default="127.0.0.1:9901")
     ap.add_argument("--rules", default=None,
                     help="rulecheck: rules tree to analyze (default: "
@@ -372,6 +412,17 @@ def main(argv=None) -> int:
             else:
                 out = render_rollout(json.loads(_call(args.server,
                                                       "/rollout")))
+        elif args.cmd == "scoring":
+            if args.swap:
+                # staged scoring-head push (the admission gate answers;
+                # --force = break-glass one-shot install)
+                out = _call(args.server,
+                            "/configuration/scoring"
+                            + ("?mode=force" if args.force else ""),
+                            {"path": args.swap}, timeout=300)
+            else:
+                out = render_scoring(json.loads(_call(args.server,
+                                                      "/scoring")))
         elif args.cmd == "faults":
             if args.set_json is not None:
                 # --set 'dispatch_hang:times=1' installs; --set '' clears

@@ -134,6 +134,10 @@ class RolloutConfig:
     max_diff_frac: float = 0.02
     #: mirrored verdicts required before the diff fraction can trigger
     diff_min_compared: int = 50
+    #: scoring-head admission: minimum fraction of the head's rule-id
+    #: map found in the live pack (a head trained against a different
+    #: pack generation scores with silently-missing features below this)
+    scorer_min_coverage: float = 0.90
     #: last-known-good artifact directory (None disables persistence)
     lkg_dir: Optional[str] = None
 
@@ -254,8 +258,17 @@ class RolloutController:
         self.state = IDLE
         self.candidate = None            # DetectionPipeline | None
         self.candidate_version = ""
+        #: what kind of artifact is rolling out: "ruleset" | "scorer"
+        self.candidate_kind = ""
+        #: the candidate pipeline's generation tag (ruleset version, or
+        #: ruleset+head for a scoring rollout) — what its verdicts are
+        #: stamped with; the mirror's self-diff skip keys on THIS, not
+        #: on candidate_version (a scoring candidate's version is the
+        #: head's, but its verdicts carry the combined tag)
+        self.candidate_generation = ""
         self.candidate_artifact = ""     # source path ("" = in-memory)
         self._candidate_cr = None        # CompiledRuleset for LKG persist
+        self._candidate_head = None      # ScoringHead for scorer LKG
         self.step_idx = 0
         self.step_served = 0
         self.started_at = 0.0
@@ -319,6 +332,7 @@ class RolloutController:
         self.canary_active = False
         self.candidate = None
         self._candidate_cr = None
+        self._candidate_head = None
 
     # -------------------------------------------------------- admission
 
@@ -401,11 +415,27 @@ class RolloutController:
             "artifact": self.candidate_artifact,
             "replay": replay,
         }
+        self._enter_admitted(candidate, ruleset.version, "ruleset",
+                             report, cr=ruleset)
+        self._event("admitted", candidate=ruleset.version)
+        return report
+
+    def _enter_admitted(self, candidate, version: str, kind: str,
+                        report: dict, cr=None, head=None) -> None:
+        """Shared ADMITTED-state install for both artifact kinds
+        (ruleset packs and scoring heads): every per-rollout counter
+        and baseline resets in ONE place under the lock, then the
+        shadow lane opens — a counter added for one kind can never
+        leak stale values into the other's next rollout."""
+        live = self.batcher.pipeline
         with self._lock:
             self.state = ADMITTED
             self.candidate = candidate
-            self._candidate_cr = ruleset
-            self.candidate_version = ruleset.version
+            self._candidate_cr = cr
+            self._candidate_head = head
+            self.candidate_version = version
+            self.candidate_kind = kind
+            self.candidate_generation = candidate.generation_tag
             self.step_idx = 0
             self.step_served = 0
             self.candidate_requests = 0
@@ -420,8 +450,6 @@ class RolloutController:
             self._dead_baseline = _runtime_dead(live)
             self.last_admission = report
             self._start_shadow_locked()
-        self._event("admitted", candidate=ruleset.version)
-        return report
 
     def _static_gate(self, ruleset: CompiledRuleset) -> list:
         """The rulecheck checks that run on a COMPILED pack (no SecLang
@@ -476,6 +504,10 @@ class RolloutController:
             acl_store=live.acl_store,
             tenant_acl=dict(live.tenant_acl),
             default_acl=live.default_acl,
+            # an installed learned head rides a ruleset rollout (rule-id
+            # remap re-binds it to the candidate pack's axis) — a pack
+            # promote must not silently drop the scoring model
+            scoring_head=live.scoring_head,
             engine=live.engine.rebuilt(ruleset))
         # tenant (EP) rule subsets re-derived against the CANDIDATE's
         # rule axis (the same derivation a promote/swap runs)
@@ -506,6 +538,10 @@ class RolloutController:
         twin = DetectionPipeline(
             live.ruleset, mode="block",
             anomaly_threshold=live.anomaly_threshold,
+            # the twin IS the incumbent scorer: a scoring-head rollout
+            # diffs learned-vs-learned (or learned-vs-fixed) exactly as
+            # live traffic would see it
+            scoring_head=live.scoring_head,
             engine=live.engine)
         labeled = generate_corpus(n=self.config.corpus_n,
                                   attack_fraction=0.5, seed=20260804)
@@ -551,6 +587,135 @@ class RolloutController:
             "benign_new_block_ids": benign_new_blocks[:8],
         }
 
+    # ------------------------------------------- scoring-head admission
+
+    def admit_scoring(self, artifact_path: Optional[str] = None,
+                      head=None, overrides: Optional[dict] = None) -> dict:
+        """Admission gate for a LEARNED SCORING HEAD artifact
+        (docs/LEARNED_SCORING.md): same staged machinery as a ruleset
+        rollout — the candidate generation is the live pack with the
+        new head bound, so shadow diffing, the canary ramp, every
+        rollback trigger, and LKG recovery apply unchanged.  Stages:
+
+        1. load    — artifact parse + content-hash verification
+                     (ScoringHead.load rejects corrupt/tampered files)
+        2. schema  — shape/finiteness validation + already-live check
+        3. coverage— rule-id-map coverage against the LIVE pack
+                     (``scorer_min_coverage``)
+        4. compile — candidate pipeline build (shares the live engine:
+                     same pack, same warm executables) + smoke detect
+        5. replay  — golden-corpus diff vs the INCUMBENT scorer
+                     (zero-new-FN / zero-new-benign-block defaults)
+        """
+        if head is None and artifact_path is None:
+            raise ValueError("admit_scoring() needs an artifact path "
+                             "or a ScoringHead")
+        overrides = validate_overrides(overrides or {})
+        with self._lock:
+            if self.state in (SHADOW, CANARY) or self._admitting:
+                raise RolloutRejected(
+                    "admission", "rollout_in_progress",
+                    str(artifact_path or ""),
+                    {"active_candidate": self.candidate_version})
+            self._admitting = True
+            from dataclasses import replace as _dc_replace
+            self.config = _dc_replace(self._base_config, **overrides)
+        try:
+            return self._admit_scoring_inner(artifact_path, head)
+        finally:
+            with self._lock:
+                self._admitting = False
+
+    def _admit_scoring_inner(self, artifact_path, head) -> dict:
+        from ingress_plus_tpu.learn.head import LearnedScorer, ScoringHead
+
+        self.candidate_artifact = str(artifact_path or "")
+        # stage 1: load (content hash verified inside load) -----------------
+        if head is None:
+            try:
+                head = ScoringHead.load(artifact_path)
+            except Exception as e:
+                self._reject("load", "scorer_load",
+                             {"error": "%s: %s" % (type(e).__name__, e)})
+        # stage 2: schema + already-live -------------------------------------
+        try:
+            head.validate()
+        except ValueError as e:
+            self._reject("schema", "scorer_schema", {"error": str(e)})
+        live = self.batcher.pipeline
+        if live.scoring_head is not None \
+                and head.version == live.scoring_head.version:
+            self._reject("load", "already_live",
+                         {"version": head.version})
+        # stage 3: rule-id-map coverage against the live pack ----------------
+        scorer = LearnedScorer(head, live.ruleset)
+        if scorer.coverage < self.config.scorer_min_coverage:
+            self._reject("coverage", "scorer_coverage", {
+                "coverage": round(scorer.coverage, 4),
+                "required": self.config.scorer_min_coverage,
+                "ruleset": live.ruleset.version})
+        # stage 4: candidate build + smoke -----------------------------------
+        try:
+            candidate = self._build_scoring_candidate(head)
+        except Exception as e:
+            self._reject("compile", "compile_smoke",
+                         {"error": "%s: %s" % (type(e).__name__, e)})
+        # stage 5: golden-corpus replay vs the incumbent scorer --------------
+        replay = self._replay_diff(live, candidate)
+        if replay["new_fns"] > self.config.max_new_fn:
+            self._reject("replay", "new_fns", replay)
+        if replay["benign_new_blocks"] > self.config.max_new_benign_blocks:
+            self._reject("replay", "benign_blocks", replay)
+        candidate.reset_detection_observations()
+        candidate.stats = live.stats
+        candidate.load_controller = live.load_controller
+        report = {
+            "state": SHADOW,
+            "kind": "scorer",
+            "candidate": head.version,
+            "incumbent": live.generation_tag,
+            "artifact": self.candidate_artifact,
+            "coverage": round(scorer.coverage, 4),
+            "threshold": round(float(head.threshold), 6),
+            "replay": replay,
+        }
+        self._enter_admitted(candidate, head.version, "scorer",
+                             report, head=head)
+        self._event("admitted", candidate=head.version,
+                    rollout_kind="scorer")
+        return report
+
+    def _build_scoring_candidate(self, head):
+        """Candidate pipeline for a scoring rollout: the LIVE pack with
+        the new head bound.  The engine is SHARED (same ruleset, same
+        device tables, already-warm executables — a scorer changes only
+        the CPU finalize step), so the seen-shape sets are adopted from
+        the incumbent: candidate dispatches must not book phantom
+        recompiles in the efficiency gauges."""
+        from ingress_plus_tpu.models.pipeline import DetectionPipeline
+        from ingress_plus_tpu.utils.corpus import generate_corpus
+
+        live = self.batcher.pipeline
+        candidate = DetectionPipeline(
+            live.ruleset, mode=live.mode,
+            anomaly_threshold=live.anomaly_threshold,
+            fail_open=live.fail_open,
+            acl_store=live.acl_store,
+            tenant_acl=dict(live.tenant_acl),
+            default_acl=live.default_acl,
+            engine=live.engine,
+            scoring_head=head)
+        candidate.tenant_rule_mask = live.tenant_rule_mask
+        candidate.seen_shapes = set(live.seen_shapes)
+        candidate.seen_lane_shapes = set(live.seen_lane_shapes)
+        candidate._seen_exec = set(live._seen_exec)
+        smoke = [lr.request for lr in generate_corpus(n=4, seed=7)]
+        verdicts = candidate.detect_strict(smoke)
+        if len(verdicts) != len(smoke):
+            raise RuntimeError("smoke detect returned %d verdicts for %d "
+                               "requests" % (len(verdicts), len(smoke)))
+        return candidate
+
     # ----------------------------------------------------- shadow phase
 
     def _start_shadow_locked(self) -> None:
@@ -582,8 +747,10 @@ class RolloutController:
         if live_verdict.fail_open or live_verdict.degraded or not gen:
             return
         # canary-served candidate verdicts must not diff against the
-        # candidate itself (generation stamp from models/pipeline.py)
-        if gen == self.candidate_version:
+        # candidate itself (generation stamp from models/pipeline.py;
+        # candidate_generation is the candidate PIPELINE's tag — for a
+        # scoring rollout that is ruleset+head, not the bare head version)
+        if gen == self.candidate_generation:
             return
         try:
             self._shadow_q.put_nowait((request, live_verdict))
@@ -779,8 +946,10 @@ class RolloutController:
             self.rollback("promote_failed:%s" % type(e).__name__)
             return
         self.promotions += 1
-        self._event("live", candidate=self.candidate_version)
+        self._event("live", candidate=self.candidate_version,
+                    rollout_kind=self.candidate_kind)
         cr, self._candidate_cr = self._candidate_cr, None
+        head, self._candidate_head = self._candidate_head, None
         self.candidate = None
         if self.config.lkg_dir and cr is not None:
             try:
@@ -788,6 +957,14 @@ class RolloutController:
                 self._event("lkg_persisted", version=cr.version)
             except OSError as e:
                 # LKG is recovery insurance, not a serving dependency
+                self._event("lkg_persist_failed", error=str(e))
+        if self.config.lkg_dir and head is not None:
+            from ingress_plus_tpu.learn.head import persist_lkg_scorer
+
+            try:
+                persist_lkg_scorer(head, self.config.lkg_dir)
+                self._event("scorer_lkg_persisted", version=head.version)
+            except OSError as e:
                 self._event("lkg_persist_failed", error=str(e))
 
     def rollback(self, reason: str) -> None:
@@ -852,6 +1029,7 @@ class RolloutController:
             return {
                 "state": self.state,
                 "candidate": self.candidate_version or None,
+                "kind": self.candidate_kind or None,
                 "artifact": self.candidate_artifact or None,
                 "incumbent": self.batcher.pipeline.ruleset.version,
                 "step": self.step_idx,

@@ -70,6 +70,10 @@ class Verdict:
     #: fail-open/shed verdicts no generation ever scanned.
     generation: str = ""
     elapsed_us: int = 0
+    #: learned-head margin when a scoring head is installed (the fixed
+    #: CRS anomaly sum stays in ``score`` either way — live divergence
+    #: between the two scorers is observable per verdict, ISSUE 8)
+    learned_score: Optional[float] = None
     #: matched points for the attack export (wallarm "points" analog):
     #: up to 8 dicts {rule_id, var, value} — var is the SecLang variable
     #: ('ARGS:q'), value a bounded post-transform snippet
@@ -113,6 +117,16 @@ class PipelineStats:
     engine_compiles: int = 0
     bucket_rows: Dict[int, int] = field(default_factory=dict)
     bucket_padded_rows: Dict[int, int] = field(default_factory=dict)
+    #: learned-vs-fixed verdict divergence, keyed by direction
+    #: ("learned_flag" = head flags where fixed wouldn't,
+    #: "learned_pass" = head passes where fixed would flag) —
+    #: /metrics ipt_scorer_diff_total{kind=}, /scoring, `dbg scoring`
+    scorer_diff: Dict[str, int] = field(default_factory=dict)
+
+    def count_scorer_diff(self, kind: str) -> None:
+        """Single-writer like count_shed (finalize runs under the
+        batcher's swap lock; library callers are single-threaded)."""
+        self.scorer_diff[kind] = self.scorer_diff.get(kind, 0) + 1
 
     def count_shed(self, reason: str) -> None:
         """One admission shed (dict ops are GIL-atomic enough for the
@@ -288,6 +302,7 @@ class DetectionPipeline:
         tenant_acl: Optional[Dict[int, str]] = None,
         default_acl: str = "",
         engine=None,
+        scoring_head=None,
     ):
         # ``engine``: pre-built engine to serve with (e.g. the batcher
         # hot-swap passing a mesh-backed MeshEngine.rebuilt) — skips
@@ -295,6 +310,13 @@ class DetectionPipeline:
         self.engine = (engine if engine is not None
                        else DetectionEngine(ruleset, scan_impl=scan_impl))
         self.mode = mode
+        # learned scoring lane (ISSUE 8, docs/LEARNED_SCORING.md):
+        # ``scoring_head`` is the portable rule-id-keyed artifact;
+        # _install binds it to THIS pack's rule axis (and re-binds on
+        # every swap — the remap is how a trained head survives a
+        # ruleset rollout).  None = fixed CRS weights, the default.
+        self.scoring_head = scoring_head
+        self.scorer = None
         # wallarm-acl enforcement (VERDICT r03 missing #4): hot-swappable
         # store + per-tenant ACL binding (the annotation is per-Ingress =
         # per-tenant); default_acl applies when a tenant has no binding
@@ -340,6 +362,22 @@ class DetectionPipeline:
 
     def _install(self, ruleset: CompiledRuleset, paranoia_level: int) -> None:
         self.ruleset = ruleset
+        # bind the learned head to this generation's rule axis (rule-id
+        # remap — the sigpack row order changed; the CRS ids did not)
+        if self.scoring_head is not None:
+            from ingress_plus_tpu.learn.head import LearnedScorer
+
+            self.scorer = LearnedScorer(self.scoring_head, ruleset)
+        else:
+            self.scorer = None
+        # the generation stamp verdicts carry: the ruleset version alone
+        # when scoring is fixed-weight, ruleset+head when a learned
+        # scorer is installed — a scoring-head rollout is a generation
+        # change even though the pack is identical (the rollout
+        # machinery's exactly-one-generation invariant rides this)
+        self.generation_tag = (
+            ruleset.version if self.scorer is None
+            else "%s+%s" % (ruleset.version, self.scorer.version))
         self.confirms = [ConfirmRule(m.confirm) for m in ruleset.rules]
         # detection-plane telemetry keyed by THIS generation's rule axis
         # (a swap starts fresh counters; the old ones freeze for drift)
@@ -402,6 +440,23 @@ class DetectionPipeline:
         frozen = self.rule_stats.freeze()
         self._install(ruleset, paranoia_level)
         self.frozen_rule_stats = frozen
+
+    def set_scoring_head(self, head) -> None:
+        """Install (or with ``None`` clear) a learned scoring head on
+        the live generation — same pack, new scorer, new generation
+        tag.  Callers that serve traffic hold the batcher's swap lock
+        (Batcher.set_scoring_head); the staged path swaps whole
+        pipelines instead (control/rollout.py admit_scoring)."""
+        self.scoring_head = head
+        if head is not None:
+            from ingress_plus_tpu.learn.head import LearnedScorer
+
+            self.scorer = LearnedScorer(head, self.ruleset)
+            self.generation_tag = "%s+%s" % (self.ruleset.version,
+                                             self.scorer.version)
+        else:
+            self.scorer = None
+            self.generation_tag = self.ruleset.version
 
     def reset_detection_observations(self) -> None:
         """Zero the detection-plane telemetry (RuleStats counters + the
@@ -733,13 +788,16 @@ class DetectionPipeline:
                 degraded=True,
             ))
         # candidates still feed the per-rule telemetry (nothing
-        # confirmed — an honest zero, not a gap); confirm_us untouched
+        # confirmed — an honest zero, not a gap); confirm_us untouched.
+        # The learned head does NOT score this rung: it is calibrated on
+        # confirmed hits, and candidates over-approximate — fixed
+        # weights keep the degraded path's never-blocks contract simple
         self.rule_stats.observe_finalize(rule_hits[:len(requests)], [], [])
         self.stats.degraded += len(requests)
         elapsed = int((time.perf_counter() - t0) * 1e6)
         for v in verdicts:
             v.elapsed_us = elapsed
-            v.generation = rs.version
+            v.generation = self.generation_tag
         return verdicts
 
     def _build_scan_buckets(self, requests: List[Request]):
@@ -906,7 +964,9 @@ class DetectionPipeline:
         # never confirm-evaluated and must not book as wasted confirms
         all_confirmed: List[int] = []
         all_blocked: List[bool] = []
+        confirmed_rows: List[List[int]] = []
         excl_rows: List[tuple] = []
+        scorer = self.scorer
         for qi, req in enumerate(requests):
             hit_rules = np.nonzero(rule_hits[qi])[0]
             confirmed: List[int] = []
@@ -960,6 +1020,20 @@ class DetectionPipeline:
             classes = sorted(
                 {CLASSES[rs.rule_class[r]] for r in confirmed})
             attack = bool(confirmed) and score >= self.anomaly_threshold
+            learned_score: Optional[float] = None
+            if scorer is not None:
+                # learned scoring lane (docs/LEARNED_SCORING.md): one
+                # dot over the confirmed-hit bitmap decides the attack
+                # flag; the fixed CRS sum above is STILL computed and
+                # exported (Verdict.score) so live divergence between
+                # the scorers is a first-class signal, never a guess
+                learned_score = scorer.score_confirmed(confirmed)
+                fixed_attack = attack
+                attack = bool(confirmed) and \
+                    learned_score >= scorer.threshold
+                if attack != fixed_attack:
+                    stats.count_scorer_diff(
+                        "learned_flag" if attack else "learned_pass")
             deny = any(rs.rule_action[r] == 2 for r in confirmed)
             # --- ACL evaluation (wallarm-acl): longest-prefix decision
             # over the tenant-bound (or default) list.  deny blocks
@@ -995,10 +1069,12 @@ class DetectionPipeline:
                 classes=classes,
                 rule_ids=[int(rs.rule_ids[r]) for r in confirmed],
                 score=score,
+                learned_score=learned_score,
                 matches=points,
             ))
             all_confirmed.extend(confirmed)
             all_blocked.extend([blocked] * len(confirmed))
+            confirmed_rows.append(confirmed)
         if observe_rules:
             cand_hits = rule_hits[:len(requests)]
             if excl_rows:
@@ -1009,12 +1085,13 @@ class DetectionPipeline:
                 for qi, ex in excl_rows:
                     cand_hits[qi, ex] = False
             self.rule_stats.observe_finalize(
-                cand_hits, all_confirmed, all_blocked)
+                cand_hits, all_confirmed, all_blocked,
+                confirmed_rows=confirmed_rows)
         stats.confirm_us += int((time.perf_counter() - tc0) * 1e6)
         stats.confirmed_rule_hits += sum(len(v.rule_ids) for v in verdicts)
 
         elapsed = int((time.perf_counter() - t0) * 1e6)
         for v in verdicts:
             v.elapsed_us = elapsed
-            v.generation = rs.version
+            v.generation = self.generation_tag
         return verdicts

@@ -34,8 +34,9 @@ Prometheus gets per-FAMILY series with a hard label budget
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -47,6 +48,63 @@ def family_of(rule_id: int) -> str:
     Prometheus series use — never the full id set."""
     rid = int(rule_id)
     return str(rid)[:3] if rid >= 100000 else "custom"
+
+
+class BitmapRing:
+    """Opt-in bounded ring of raw per-request activation bitmaps — the
+    shadow-time feature source for the learned scoring lane (ISSUE 8,
+    docs/LEARNED_SCORING.md).
+
+    Each entry is a (candidates, confirmed) pair of ``np.packbits``-
+    packed rows — ~2·⌈R/8⌉ bytes per request, so the default 8 MiB cap
+    holds ~16k requests of a 2k-rule pack.  The cap is HARD: capacity is
+    derived from ``cap_bytes`` up front and the deque evicts oldest on
+    overflow (``dropped`` counts) — capture can never grow the serve
+    plane's memory unboundedly.  Appends happen under the owning
+    RuleStats lock (one packbits per finalize batch, not per request)."""
+
+    def __init__(self, n_rules: int, cap_bytes: int = 8 << 20) -> None:
+        self.n_rules = int(n_rules)
+        self.row_bytes = 2 * ((self.n_rules + 7) // 8)
+        self.capacity = max(1, int(cap_bytes) // self.row_bytes)
+        self.cap_bytes = int(cap_bytes)
+        self._ring: Deque[Tuple[np.ndarray, np.ndarray]] = deque(
+            maxlen=self.capacity)
+        self.appended = 0
+        self.dropped = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def extend(self, cand_packed: np.ndarray,
+               conf_packed: np.ndarray) -> None:
+        """Fold one finalize batch of packed rows ((Q, ⌈R/8⌉) each)."""
+        q = cand_packed.shape[0]
+        self.dropped += max(0, len(self._ring) + q - self.capacity)
+        self.appended += q
+        for i in range(q):
+            self._ring.append((cand_packed[i], conf_packed[i]))
+
+    def clear(self) -> None:
+        self._ring.clear()
+        self.appended = 0
+        self.dropped = 0
+
+    def snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Unpacked ((N, R) candidates, (N, R) confirmed) bool arrays,
+        oldest first."""
+        if not self._ring:
+            z = np.zeros((0, self.n_rules), dtype=bool)
+            return z, z.copy()
+        cand = np.stack([c for c, _ in self._ring])
+        conf = np.stack([f for _, f in self._ring])
+        return (np.unpackbits(cand, axis=1)[:, :self.n_rules].astype(bool),
+                np.unpackbits(conf, axis=1)[:, :self.n_rules].astype(bool))
+
+    def stats(self) -> dict:
+        return {"requests": len(self._ring), "capacity": self.capacity,
+                "cap_bytes": self.cap_bytes, "appended": self.appended,
+                "dropped": self.dropped}
 
 
 @dataclass
@@ -98,24 +156,53 @@ class RuleStats:
                 if reason is not None:
                     self.broken[i] = True
                     self.broken_reason[i] = reason
+        # opt-in raw-bitmap capture (learned-scorer feature source);
+        # None = off, the serve-plane default
+        self.capture: Optional[BitmapRing] = None
         self._lock = threading.Lock()
 
     # ---------------------------------------------------------- update
 
+    def enable_capture(self, cap_bytes: int = 8 << 20) -> BitmapRing:
+        """Turn on the bounded per-request bitmap ring (idempotent when
+        already on with the same cap)."""
+        with self._lock:
+            if self.capture is None or \
+                    self.capture.cap_bytes != int(cap_bytes):
+                self.capture = BitmapRing(len(self.rule_ids), cap_bytes)
+            return self.capture
+
+    def disable_capture(self) -> None:
+        with self._lock:
+            self.capture = None
+
+    def capture_snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
+        with self._lock:
+            if self.capture is None:
+                z = np.zeros((0, len(self.rule_ids)), dtype=bool)
+                return z, z.copy()
+            return self.capture.snapshot()
+
     def reset(self) -> None:
         """Zero the counters (warmup exclusion — see
         DetectionPipeline.reset_detection_observations); the broken-rule
-        mask is structural and survives."""
+        mask is structural and survives.  The capture ring (when on)
+        empties too — warmup traffic must not leak into a training
+        dataset any more than into the hit-rate gauges."""
         with self._lock:
             for a in (self.candidates, self.confirmed,
                       self.confirm_errors, self.score_sum,
                       self.block_hits):
                 a[:] = 0
             self.requests = 0
+            if self.capture is not None:
+                self.capture.clear()
 
     def observe_finalize(self, rule_hits: np.ndarray,
                          confirmed_idx: Sequence[int],
-                         confirmed_blocked: Sequence[bool]) -> None:
+                         confirmed_blocked: Sequence[bool],
+                         confirmed_rows: Optional[
+                             Sequence[Sequence[int]]] = None) -> None:
         """Fold one finalize batch.
 
         ``rule_hits``: the (Q, R) masked candidate matrix the batch
@@ -123,7 +210,11 @@ class RuleStats:
         exclusions first — those rules were never confirm-evaluated);
         ``confirmed_idx``: flat rule indices of every confirmed
         (request, rule) hit across the batch; ``confirmed_blocked``:
-        same length, whether that request's verdict blocked."""
+        same length, whether that request's verdict blocked;
+        ``confirmed_rows``: per-request confirmed index lists (len Q) —
+        only consumed by the opt-in capture ring, which stays silent
+        when the caller cannot provide them (prefilter-only brownout
+        verdicts are not training-grade features)."""
         cand = rule_hits.sum(axis=0, dtype=np.int64)
         # config machinery (ignored mask) is never a detection
         # candidate — suppress on the reduced vector, one place
@@ -131,6 +222,14 @@ class RuleStats:
         with self._lock:
             self.requests += int(rule_hits.shape[0])
             self.candidates += cand
+            if self.capture is not None and confirmed_rows is not None:
+                conf = np.zeros_like(rule_hits, dtype=bool)
+                for qi, row in enumerate(confirmed_rows):
+                    if len(row):
+                        conf[qi, np.asarray(row, dtype=np.int64)] = True
+                self.capture.extend(
+                    np.packbits(rule_hits.astype(bool), axis=1),
+                    np.packbits(conf, axis=1))
             if self.broken.any():
                 self.confirm_errors += np.where(self.broken, cand, 0)
             if len(confirmed_idx):

@@ -370,12 +370,31 @@ class ServeLoop:
             'ipt_ruleset_info{version="%s",rules="%d"} 1'
             % (pipeline.ruleset.version, pipeline.ruleset.n_rules),
         ]
+        # --- learned scoring lane (docs/LEARNED_SCORING.md): whether a
+        # head is installed, which one, and the live fixed-vs-learned
+        # verdict divergence (the signal a bad model shows FIRST)
+        sc = pipeline.scorer
+        lines += [
+            "# TYPE ipt_scorer_active gauge",
+            "ipt_scorer_active %d" % (1 if sc is not None else 0),
+        ]
+        if sc is not None:
+            lines += [
+                "# TYPE ipt_scorer_info gauge",
+                'ipt_scorer_info{version="%s",coverage="%.4f"} 1'
+                % (sc.version, sc.coverage),
+                "# TYPE ipt_scorer_threshold gauge",
+                "ipt_scorer_threshold %s" % round(sc.threshold, 6),
+            ]
         # --- detection-plane telemetry (ISSUE 3): family-level hit
         # series (bounded cardinality — full per-rule detail is
         # JSON-only at /rules/stats) + device-efficiency gauges
         rs = pipeline.rule_stats
         from ingress_plus_tpu.models.rule_stats import device_efficiency
         from ingress_plus_tpu.utils.trace import bounded_counter_series
+        lines.append("# TYPE ipt_scorer_diff_total counter")
+        lines += bounded_counter_series(
+            "ipt_scorer_diff_total", "kind", dict(p.scorer_diff))
         fams = rs.family_totals()
         lines.append("# TYPE ipt_rule_family_hits_total counter")
         lines += bounded_counter_series(
@@ -786,6 +805,98 @@ class ServeLoop:
             # after its FIRST candidate, not at the next audit
             return ("200 OK", "application/json",
                     json.dumps(pipeline.rule_stats.health()).encode())
+        if path.startswith("/scoring") and method == "GET":
+            # learned scoring lane (docs/LEARNED_SCORING.md): the
+            # installed head (version/threshold/coverage/top weights)
+            # and the live fixed-vs-learned divergence counters — the
+            # observable that says what the model is actually changing
+            sc = pipeline.scorer
+            return ("200 OK", "application/json", json.dumps({
+                "active": sc is not None,
+                "generation": pipeline.generation_tag,
+                "anomaly_threshold": pipeline.anomaly_threshold,
+                "head": sc.snapshot() if sc is not None else None,
+                "diff": dict(pipeline.stats.scorer_diff),
+            }).encode())
+        if path.startswith("/configuration/scoring") and method == "POST":
+            # scoring-head delivery: STAGED by default when a rollout
+            # controller is attached (the head rides the same admission
+            # → shadow → canary → LIVE gates as a ruleset swap);
+            # ?mode=force one-shot installs/clears break-glass style.
+            # Payload: {"path": "<artifact>"} or {"clear": true} (force
+            # only — "roll out removing the model" has no gate story).
+            from urllib.parse import parse_qs, urlsplit
+            from ingress_plus_tpu.control.rollout import RolloutRejected
+            from ingress_plus_tpu.learn.head import ScoringHead
+
+            ro = self.batcher.rollout
+            q = parse_qs(urlsplit(path).query, keep_blank_values=True)
+            swap_mode = (q.get("mode")
+                         or ["staged" if ro is not None else "force"])[0]
+            if swap_mode not in ("staged", "force"):
+                return ("400 Bad Request", "application/json",
+                        json.dumps({"error": "mode must be staged|force"}
+                                   ).encode())
+            try:
+                spec = json.loads(payload or b"{}")
+                if not isinstance(spec, dict):
+                    raise ValueError("payload must be a JSON object")
+                clear = bool(spec.get("clear"))
+                art = None if clear else str(spec["path"])
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                return ("400 Bad Request", "application/json",
+                        json.dumps({"error": str(e)}).encode())
+            if swap_mode == "staged":
+                if ro is None:
+                    return ("409 Conflict", "application/json",
+                            json.dumps({"error": "staged rollout "
+                                        "unavailable: no rollout "
+                                        "controller attached "
+                                        "(use ?mode=force)"}).encode())
+                if clear:
+                    return ("400 Bad Request", "application/json",
+                            json.dumps({"error": "clear requires "
+                                        "?mode=force"}).encode())
+                overrides = {k: spec[k]
+                             for k in ("steps", "step_min_requests",
+                                       "shadow_min_requests",
+                                       "shadow_sample") if k in spec}
+                try:
+                    report = await loop.run_in_executor(
+                        None, lambda: ro.admit_scoring(
+                            artifact_path=art, overrides=overrides))
+                except RolloutRejected as e:
+                    return ("422 Unprocessable Entity", "application/json",
+                            json.dumps({"rejected": True,
+                                        **e.report}).encode())
+                except (OSError, ValueError, TypeError) as e:
+                    return ("400 Bad Request", "application/json",
+                            json.dumps({"error": str(e)}).encode())
+                return "200 OK", "application/json", json.dumps(
+                    {"staged": True, **report}).encode()
+
+            def _force_install():
+                head = None
+                if not clear:
+                    head = ScoringHead.load(art)
+                self.batcher.set_scoring_head(head)
+                return head
+
+            try:
+                head = await loop.run_in_executor(None, _force_install)
+            except Exception as e:
+                if ro is not None:
+                    ro.count_rejected("scorer_load")
+                return ("400 Bad Request", "application/json",
+                        json.dumps({"error": "%s: %s"
+                                    % (type(e).__name__, e),
+                                    "stage": "load"}).encode())
+            return "200 OK", "application/json", json.dumps({
+                "scoring": (head.version if head is not None else None),
+                "mode": "force",
+                "generation": self.batcher.pipeline.generation_tag,
+            }).encode()
         if path.startswith("/rules/drift"):
             # hit-rate deltas across the most recent hot reload: the
             # outgoing version's counters freeze at swap; rules that
@@ -1040,7 +1151,8 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
                           lkg_dir: Optional[str] = None,
                           rollout_steps=None,
                           rollout_fail_on: str = "error",
-                          n_lanes: int = 1) -> Batcher:
+                          n_lanes: int = 1,
+                          scoring_head_path: Optional[str] = None) -> Batcher:
     from ingress_plus_tpu.compiler.ruleset import compile_ruleset
     from ingress_plus_tpu.compiler.seclang import load_seclang_dir
     from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
@@ -1117,6 +1229,35 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
         # the detection-plane telemetry so /rules/* and the efficiency
         # gauges describe real traffic from request one
         pipeline.reset_detection_observations()
+    # learned scoring head (docs/LEARNED_SCORING.md): an explicit
+    # --scoring-head artifact wins; otherwise the scorer LKG (the last
+    # head that survived a staged rollout) restores like the pack LKG.
+    # Either failing to load serves fixed weights — never an outage.
+    head = None
+    if scoring_head_path:
+        from ingress_plus_tpu.learn.head import ScoringHead
+
+        try:
+            head = ScoringHead.load(scoring_head_path)
+        except Exception as e:
+            # the contract holds for the explicit flag too: serving
+            # starts on fixed weights, the broken artifact is LOUD
+            print("WARNING: --scoring-head %s unloadable (%s: %s) — "
+                  "serving FIXED CRS weights"
+                  % (scoring_head_path, type(e).__name__, e),
+                  file=sys.stderr)
+    elif lkg_dir:
+        from ingress_plus_tpu.learn.head import load_lkg_scorer
+
+        head = load_lkg_scorer(lkg_dir)
+        if head is not None:
+            print("startup: restoring last-known-good scoring head %s"
+                  % head.version, file=sys.stderr)
+    if head is not None:
+        pipeline.set_scoring_head(head)
+        print("learned scoring: head %s (threshold %.4f, coverage %.3f)"
+              % (head.version, pipeline.scorer.threshold,
+                 pipeline.scorer.coverage), file=sys.stderr)
     batcher = Batcher(pipeline, max_batch=max_batch, max_delay_s=max_delay_s,
                       hard_deadline_s=hard_deadline_s, queue_cap=queue_cap,
                       hang_budget_s=hang_budget_s,
@@ -1283,6 +1424,11 @@ def main(argv=None) -> None:
                          "pack with unsuppressed findings at or above "
                          "this level is rejected before touching "
                          "traffic")
+    ap.add_argument("--scoring-head", default=None,
+                    help="learned scoring-head artifact to serve with "
+                         "(learn/; docs/LEARNED_SCORING.md) — overrides "
+                         "the scorer LKG; omitted = scorer LKG from "
+                         "--lkg-dir, else fixed CRS weights")
     ap.add_argument("--faults", default=None,
                     help="deterministic fault plan, e.g. "
                          "'dispatch_hang:after=100,times=1,delay_s=5'; "
@@ -1317,7 +1463,8 @@ def main(argv=None) -> None:
         rollout_steps=[float(s) for s in
                        args.rollout_steps.split(",") if s.strip()],
         rollout_fail_on=args.rollout_fail_on,
-        n_lanes=_parse_lanes(args.lanes))
+        n_lanes=_parse_lanes(args.lanes),
+        scoring_head_path=args.scoring_head)
 
     post = None
     if args.spool_dir or args.export_url:

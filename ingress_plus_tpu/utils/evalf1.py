@@ -24,8 +24,8 @@ import argparse
 import json
 import sys
 import time
-from dataclasses import dataclass
-from typing import Dict, List
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
 
 
 @dataclass
@@ -46,6 +46,16 @@ class F1Report:
     platform: str
     mode: str
     n_rules: int
+    #: per-CRS-family precision (ISSUE 8 quality leg): of the requests
+    #: each rule family confirmed on, what fraction were labeled
+    #: attacks — the family-resolution FP attribution the aggregate
+    #: precision averages away (recall stays per attack CLASS above:
+    #: ground truth labels classes, verdicts name rule families)
+    per_family: Dict[str, dict] = field(default_factory=dict)
+    #: fixed-weights vs learned-head comparison (present when a scoring
+    #: head was passed): FPs at equal-or-better recall, threshold,
+    #: calibration curve — the ModSec-Learn claim, measured
+    scorer_comparison: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return self.__dict__.copy()
@@ -54,7 +64,7 @@ class F1Report:
 def evaluate(n: int = 2048, mode: str = "monitoring",
              batch: int = 256, seed: int = 20260729,
              pipeline=None, attack_fraction: float = 0.3,
-             warm: bool = True) -> F1Report:
+             warm: bool = True, scoring_head=None) -> F1Report:
     from ingress_plus_tpu.compiler.ruleset import compile_ruleset
     from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
     from ingress_plus_tpu.models.pipeline import DetectionPipeline
@@ -81,7 +91,14 @@ def evaluate(n: int = 2048, mode: str = "monitoring",
     class_hit: Dict[str, int] = {}
     fps: List[str] = []
     fns: List[str] = []
+    from ingress_plus_tpu.models.rule_stats import family_of
+
+    fam_stats: Dict[str, List[int]] = {}  # family → [flagged, attacks]
     for lr, v in zip(corpus, verdicts):
+        for fam in {family_of(rid) for rid in v.rule_ids}:
+            t = fam_stats.setdefault(fam, [0, 0])
+            t[0] += 1
+            t[1] += 1 if lr.is_attack else 0
         if lr.is_attack:
             cls = lr.attack_class or "?"
             class_total[cls] = class_total.get(cls, 0) + 1
@@ -104,6 +121,13 @@ def evaluate(n: int = 2048, mode: str = "monitoring",
 
     precision = tp / (tp + fp) if tp + fp else 1.0
     recall = tp / (tp + fn) if tp + fn else 1.0
+
+    # fixed-vs-learned comparison leg (ISSUE 8): the SAME corpus through
+    # the same pack with the head installed; verdict-level, end to end
+    scorer_cmp = None
+    if scoring_head is not None:
+        scorer_cmp = _scorer_comparison(pipeline, scoring_head, corpus,
+                                        verdicts, batch)
     import jax
 
     return F1Report(
@@ -117,7 +141,50 @@ def evaluate(n: int = 2048, mode: str = "monitoring",
         false_positives=fps, false_negatives=fns,
         req_s=round(len(corpus) / dt, 1),
         platform=jax.default_backend(), mode=pipeline.mode,
-        n_rules=pipeline.ruleset.n_rules)
+        n_rules=pipeline.ruleset.n_rules,
+        per_family={
+            fam: {"flagged": t[0], "attacks": t[1],
+                  "benign_fps": t[0] - t[1],
+                  "precision": round(t[1] / t[0], 4)}
+            for fam, t in sorted(fam_stats.items())},
+        scorer_comparison=scorer_cmp)
+
+
+def _scorer_comparison(fixed_pipeline, scoring_head, corpus,
+                       fixed_verdicts, batch: int) -> dict:
+    """Verdict-level fixed-vs-learned comparison on one labeled corpus
+    (the quality-leg twin of learn.train.compare_scorers, which works
+    on exported feature matrices — this one exercises the full serve
+    finalize path)."""
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+
+    learned = DetectionPipeline(
+        fixed_pipeline.ruleset, mode=fixed_pipeline.mode,
+        anomaly_threshold=fixed_pipeline.anomaly_threshold,
+        engine=fixed_pipeline.engine, scoring_head=scoring_head)
+    lv = []
+    for i in range(0, len(corpus), batch):
+        lv.extend(learned.detect(
+            [lr.request for lr in corpus[i:i + batch]]))
+    out = {"threshold": round(float(scoring_head.threshold), 6),
+           "head_version": scoring_head.version,
+           "fixed": {"fp": 0, "fn": 0, "flagged": 0},
+           "learned": {"fp": 0, "fn": 0, "flagged": 0},
+           "new_fn_vs_fixed": 0, "new_flag_vs_fixed": 0}
+    for lr, fv, nv in zip(corpus, fixed_verdicts, lv):
+        for key, v in (("fixed", fv), ("learned", nv)):
+            if v.attack:
+                out[key]["flagged"] += 1
+                if not lr.is_attack:
+                    out[key]["fp"] += 1
+            elif lr.is_attack:
+                out[key]["fn"] += 1
+        if lr.is_attack and fv.attack and not nv.attack:
+            out["new_fn_vs_fixed"] += 1
+        if nv.attack and not fv.attack:
+            out["new_flag_vs_fixed"] += 1
+    out["fp_reduction"] = out["fixed"]["fp"] - out["learned"]["fp"]
+    return out
 
 
 def main(argv=None) -> int:
@@ -128,13 +195,22 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=20260729)
     ap.add_argument("--attack-fraction", type=float, default=0.3)
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--scoring-head", default=None,
+                    help="learned scoring-head artifact: adds the "
+                         "fixed-vs-learned scorer_comparison block")
     args = ap.parse_args(argv)
     if args.platform:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    head = None
+    if args.scoring_head:
+        from ingress_plus_tpu.learn.head import ScoringHead
+
+        head = ScoringHead.load(args.scoring_head)
     rep = evaluate(n=args.n, mode=args.mode, batch=args.batch,
-                   seed=args.seed, attack_fraction=args.attack_fraction)
+                   seed=args.seed, attack_fraction=args.attack_fraction,
+                   scoring_head=head)
     print(json.dumps(rep.to_dict()))
     return 0
 

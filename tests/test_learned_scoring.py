@@ -1,0 +1,379 @@
+"""Learned scoring lane (ingress_plus_tpu/learn, docs/LEARNED_SCORING.md).
+
+Covers the ISSUE 8 acceptance surface that is unit-testable fast (the
+staged-rollout integration lives in tests/test_rollout.py): trainer
+determinism and artifact-hash stability, matmul-vs-reference scoring
+parity, zero-new-FN threshold calibration, rule-id remap across a pack
+swap, artifact schema/tamper rejection, the pipeline's fixed-vs-learned
+divergence accounting, and the bounded per-request bitmap capture ring.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.control.rollout import (
+    _DRILL_CANDIDATE,
+    _DRILL_INCUMBENT,
+)
+from ingress_plus_tpu.learn.features import FeatureDataset, remap_columns
+from ingress_plus_tpu.learn.head import (
+    LearnedScorer,
+    ScoringHead,
+    load_lkg_scorer,
+    persist_lkg_scorer,
+)
+from ingress_plus_tpu.learn.train import (
+    TrainConfig,
+    calibrate_threshold,
+    compare_scorers,
+    fixed_flags,
+    train_from_dataset,
+    train_head,
+)
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.models.rule_stats import BitmapRing, RuleStats
+from ingress_plus_tpu.serve.normalize import Request
+
+
+@pytest.fixture(scope="module")
+def packs():
+    return {
+        "inc": compile_ruleset(parse_seclang(_DRILL_INCUMBENT)),
+        "cand": compile_ruleset(parse_seclang(_DRILL_CANDIDATE)),
+    }
+
+
+def _synthetic_dataset(n=400, f=24, seed=9):
+    """Separable-ish synthetic activation data: attacks co-activate the
+    first features, benign rows activate a 'prose' feature the fixed
+    weights over-score — the FP class the head must learn away."""
+    rng = np.random.default_rng(seed)
+    x = np.zeros((n, f), dtype=np.uint8)
+    y = np.zeros((n,), dtype=np.uint8)
+    for i in range(n):
+        if i % 3 == 0:
+            y[i] = 1
+            x[i, rng.integers(0, 4)] = 1
+            x[i, 4 + rng.integers(0, 4)] = 1
+        elif i % 7 == 0:
+            x[i, 8] = 1          # benign prose hit (fixed-weight FP)
+    rule_ids = np.arange(942100, 942100 + f, dtype=np.int64)
+    rule_score = np.full((f,), 3, dtype=np.int64)
+    return FeatureDataset(x=x, y=y, rule_ids=rule_ids,
+                          rule_score=rule_score, anomaly_threshold=3)
+
+
+# -------------------------------------------------------------- features
+
+def test_remap_columns_by_rule_id():
+    x = np.array([[1, 2, 3]], dtype=np.float32)
+    out, cov = remap_columns(x, [10, 20, 30], [30, 99, 10])
+    assert out.tolist() == [[3.0, 0.0, 1.0]]
+    assert cov == pytest.approx(2 / 3)
+    # duplicate target ids all receive the source column
+    out2, cov2 = remap_columns(x, [10, 20, 30], [20, 20])
+    assert out2.tolist() == [[2.0, 2.0]]
+    assert cov2 == pytest.approx(1 / 3)
+
+
+def test_feature_dataset_roundtrip_and_tamper(tmp_path):
+    ds = _synthetic_dataset()
+    path = tmp_path / "ds"
+    ds.save(path)
+    back = FeatureDataset.load(path)
+    assert back.fingerprint() == ds.fingerprint()
+    assert (back.x == ds.x).all() and (back.y == ds.y).all()
+    assert back.anomaly_threshold == ds.anomaly_threshold
+    # tampered arrays no longer match the recorded content hash
+    np.savez_compressed(path.with_suffix(".npz"), x=ds.x * 0, y=ds.y,
+                        rule_ids=ds.rule_ids, rule_score=ds.rule_score)
+    with pytest.raises(ValueError, match="hash mismatch"):
+        FeatureDataset.load(path)
+
+
+def test_feature_dataset_remap_to_new_pack(packs):
+    ds = _synthetic_dataset()
+    new_ids = [942100, 999999]       # one shared, one alien
+    ds2 = ds.remap(new_ids)
+    assert ds2.x.shape == (ds.n, 2)
+    assert (ds2.x[:, 0] == ds.x[:, 0]).all()
+    assert not ds2.x[:, 1].any()
+
+
+# --------------------------------------------------------------- trainer
+
+def test_trainer_deterministic_and_hash_stable():
+    ds = _synthetic_dataset()
+    h1 = train_from_dataset(ds, TrainConfig(iters=120))
+    h2 = train_from_dataset(ds, TrainConfig(iters=120))
+    assert (h1.weights == h2.weights).all()
+    assert h1.bias == h2.bias and h1.threshold == h2.threshold
+    assert h1.fingerprint() == h2.fingerprint()
+    assert h1.version == h2.version
+    # a different config IS a different artifact
+    h3 = train_from_dataset(ds, TrainConfig(iters=121))
+    assert h3.fingerprint() != h1.fingerprint()
+
+
+def test_trainer_drops_empty_rows():
+    ds = _synthetic_dataset()
+    w, b = train_head(ds.x, ds.y, TrainConfig(iters=50))
+    assert w.shape == (ds.n_features,)
+    assert np.isfinite(w).all() and np.isfinite(b)
+    with pytest.raises(ValueError, match="no rows"):
+        train_head(np.zeros((4, 8)), np.zeros((4,)), TrainConfig())
+
+
+def test_calibration_zero_new_fn():
+    ds = _synthetic_dataset()
+    head = train_from_dataset(ds, TrainConfig(iters=200))
+    margins = ds.x.astype(np.float64) @ head.weights.astype(np.float64) \
+        + head.bias
+    baseline = fixed_flags(ds)
+    anyhit = ds.x.any(axis=1)
+    learned = (margins >= head.threshold) & anyhit
+    y = ds.y.astype(bool)
+    # every baseline-detected attack stays detected (the constraint)
+    assert not (baseline & y & ~learned).any()
+    # and the learned head drops the benign prose FPs entirely
+    cmp = compare_scorers(ds, head)
+    assert cmp["new_fn_vs_fixed"] == 0
+    assert cmp["fixed"]["fp"] > 0
+    assert cmp["learned"]["fp"] < cmp["fixed"]["fp"]
+    assert cmp["fp_reduction"] > 0
+    assert len(cmp["calibration_curve"]) >= 3
+
+
+def test_calibrate_threshold_degenerate_paths():
+    # no baseline-detected attacks: flag nothing benign
+    m = np.array([1.0, 2.0, 3.0])
+    y = np.array([0, 0, 0])
+    anyhit = np.array([True, True, True])
+    t = calibrate_threshold(m, y, np.zeros(3, bool), anyhit)
+    assert t > 3.0
+    # empty activation space entirely
+    assert calibrate_threshold(m, y, np.zeros(3, bool),
+                               np.zeros(3, bool)) == 0.0
+
+
+# -------------------------------------------------------------- artifact
+
+def test_head_roundtrip_and_tamper_rejection(tmp_path):
+    ds = _synthetic_dataset()
+    head = train_from_dataset(ds, TrainConfig(iters=80))
+    path = tmp_path / "head"
+    head.save(path)
+    back = ScoringHead.load(path)
+    assert back.fingerprint() == head.fingerprint()
+    assert back.threshold == head.threshold
+    assert back.provenance["dataset"] == ds.fingerprint()
+    # tampered weights: content hash mismatch
+    np.savez_compressed(path.with_suffix(".npz"),
+                        rule_ids=head.rule_ids,
+                        weights=head.weights * 2.0)
+    with pytest.raises(ValueError, match="hash mismatch"):
+        ScoringHead.load(path)
+    # wrong kind / schema
+    meta = json.loads(path.with_suffix(".json").read_text())
+    meta["kind"] = "not_a_head"
+    path.with_suffix(".json").write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="kind"):
+        ScoringHead.load(path)
+
+
+def test_head_schema_validation():
+    with pytest.raises(ValueError, match="length mismatch"):
+        ScoringHead(rule_ids=[1, 2], weights=[0.5], bias=0.0,
+                    threshold=1.0).validate()
+    with pytest.raises(ValueError, match="non-finite"):
+        ScoringHead(rule_ids=[1], weights=[np.nan], bias=0.0,
+                    threshold=1.0).validate()
+    with pytest.raises(ValueError, match="non-finite"):
+        ScoringHead(rule_ids=[1], weights=[1.0], bias=0.0,
+                    threshold=float("inf")).validate()
+    with pytest.raises(ValueError, match="empty"):
+        ScoringHead(rule_ids=[], weights=[], bias=0.0,
+                    threshold=1.0).validate()
+
+
+def test_scorer_lkg_roundtrip_and_corruption(tmp_path):
+    ds = _synthetic_dataset()
+    head = train_from_dataset(ds, TrainConfig(iters=60))
+    persist_lkg_scorer(head, tmp_path)
+    back = load_lkg_scorer(tmp_path)
+    assert back is not None and back.version == head.version
+    # corrupt pointer → None, never a crash (startup must serve)
+    (tmp_path / "LKG_SCORER").write_text("{broken json")
+    assert load_lkg_scorer(tmp_path) is None
+    assert load_lkg_scorer(tmp_path / "nonexistent") is None
+
+
+# ------------------------------------------------ scoring parity/serving
+
+def test_matmul_vs_reference_parity(packs):
+    ds = _synthetic_dataset()
+    head = train_from_dataset(ds, TrainConfig(iters=80))
+    # bind to the dataset's own axis via a synthetic ruleset-like shim
+    scorer = LearnedScorer(head, _RulesetShim(ds.rule_ids))
+    rng = np.random.default_rng(4)
+    bitmap = (rng.random((64, ds.n_features)) < 0.1)
+    dense = scorer.score_batch(bitmap)
+    for qi in range(bitmap.shape[0]):
+        sparse = scorer.score_confirmed(list(np.nonzero(bitmap[qi])[0]))
+        assert dense[qi] == pytest.approx(sparse, abs=1e-4)
+    # empty bitmap row scores exactly the bias in both forms
+    assert scorer.score_confirmed([]) == pytest.approx(scorer.bias)
+    assert scorer.score_batch(np.zeros((1, ds.n_features), bool))[0] \
+        == pytest.approx(scorer.bias, abs=1e-6)
+
+
+class _RulesetShim:
+    def __init__(self, rule_ids):
+        self.rule_ids = np.asarray(rule_ids, dtype=np.int64)
+        self.version = "shim"
+
+
+def test_duplicate_rule_id_binding_is_positional():
+    """A multi-row rule repeats one CRS id with distinct per-row
+    weights: binding onto the SAME axis must be bit-exact (the serving
+    score is what calibration gated — reviewer catch: first-occurrence
+    collapse silently re-introduced FNs), and a cross-pack remap pairs
+    duplicate occurrences in order."""
+    ids = np.array([942520, 942520, 941100], dtype=np.int64)
+    head = ScoringHead(rule_ids=ids, weights=[0.1, 2.0, 1.0], bias=0.0,
+                       threshold=1.5, version="dup-1")
+    scorer = LearnedScorer(head, _RulesetShim(ids))
+    assert scorer.coverage == 1.0
+    assert scorer.w.tolist() == pytest.approx([0.1, 2.0, 1.0])
+    assert scorer.score_confirmed([1]) == pytest.approx(2.0)
+    # cross-pack, same duplicate structure in a different order
+    out, cov = remap_columns(np.array([[0.1, 2.0, 1.0]]), ids,
+                             [941100, 942520, 942520])
+    assert out[0].tolist() == pytest.approx([1.0, 0.1, 2.0])
+    assert cov == 1.0
+    # target carries MORE occurrences than the source: extras fall
+    # back to the first source occurrence, never to garbage
+    out2, _ = remap_columns(np.array([[0.1, 2.0]]), [942520, 942520],
+                            [942520, 942520, 942520])
+    assert out2[0].tolist() == pytest.approx([0.1, 2.0, 0.1])
+
+
+def _drill_head(packs, threshold, w_sqli=4.0, w_xss=4.0):
+    """Hand-built head over the drill pack's two CRS ids."""
+    return ScoringHead(rule_ids=[942100, 941100],
+                       weights=[w_sqli, w_xss], bias=0.0,
+                       threshold=threshold, version="t-%s" % threshold)
+
+
+ATTACK = Request(uri="/search?q=1+union+select+password",
+                 request_id="atk-1")
+BENIGN = Request(uri="/benign?q=cats", request_id="ben-1")
+
+
+def test_pipeline_scorer_divergence_and_exports(packs):
+    # fixed weights flag the attack (CRITICAL=5 >= threshold 5); a head
+    # with an unreachable threshold passes it → learned_pass divergence
+    p = DetectionPipeline(packs["inc"], mode="block",
+                          scoring_head=_drill_head(packs, threshold=99.0))
+    assert p.scorer is not None and p.scorer.coverage == 1.0
+    v_atk, v_ben = p.detect([ATTACK, BENIGN])
+    assert not v_atk.attack and not v_atk.blocked
+    assert v_atk.score >= 5                  # fixed score still exported
+    assert v_atk.learned_score == pytest.approx(4.0)
+    assert v_ben.learned_score == pytest.approx(0.0)
+    assert p.stats.scorer_diff == {"learned_pass": 1}
+    assert v_atk.generation == packs["inc"].version + "+t-99.0"
+    # a reachable threshold agrees with the fixed weights: no diff
+    p2 = DetectionPipeline(packs["inc"], mode="block",
+                           scoring_head=_drill_head(packs, threshold=3.0))
+    v_atk2, v_ben2 = p2.detect([ATTACK, BENIGN])
+    assert v_atk2.attack and v_atk2.blocked
+    assert not v_ben2.attack
+    assert p2.stats.scorer_diff == {}
+
+
+def test_pipeline_without_head_unchanged(packs):
+    p = DetectionPipeline(packs["inc"], mode="block")
+    v = p.detect([ATTACK])[0]
+    assert v.attack and v.learned_score is None
+    assert v.generation == packs["inc"].version
+    assert p.stats.scorer_diff == {}
+
+
+def test_rule_id_remap_across_pack_swap(packs):
+    head = _drill_head(packs, threshold=3.0)
+    p = DetectionPipeline(packs["inc"], mode="block", scoring_head=head)
+    w_inc = p.scorer.w.copy()
+    idx_inc = int(np.nonzero(packs["inc"].rule_ids == 942100)[0][0])
+    assert w_inc[idx_inc] == pytest.approx(4.0)
+    # swap to the candidate pack (superset, different row order
+    # possible): the head re-binds by rule id, verdicts keep scoring
+    p.swap_ruleset(packs["cand"])
+    assert p.scorer is not None
+    assert p.scorer.coverage == 1.0
+    idx_cand = int(np.nonzero(packs["cand"].rule_ids == 942100)[0][0])
+    assert p.scorer.w[idx_cand] == pytest.approx(4.0)
+    # the new pack's extra rule carries zero learned weight
+    idx_new = int(np.nonzero(packs["cand"].rule_ids == 955100)[0][0])
+    assert p.scorer.w[idx_new] == 0.0
+    v = p.detect([ATTACK])[0]
+    assert v.attack and v.learned_score == pytest.approx(4.0)
+    assert v.generation == packs["cand"].version + "+" + head.version
+    # set_scoring_head(None) restores the fixed-weight generation
+    p.set_scoring_head(None)
+    assert p.scorer is None
+    assert p.detect([ATTACK])[0].generation == packs["cand"].version
+
+
+# ---------------------------------------------------------- capture ring
+
+def test_capture_ring_bounded_and_reset(packs):
+    rs = RuleStats(packs["inc"])
+    r = int(packs["inc"].n_rules)
+    ring = rs.enable_capture(cap_bytes=8 * (2 * ((r + 7) // 8)))
+    assert ring.capacity == 8
+    hits = np.zeros((4, r), dtype=bool)
+    hits[:, 0] = True
+    for _ in range(4):          # 16 requests through an 8-slot ring
+        rs.observe_finalize(hits, [0], [False],
+                            confirmed_rows=[[0], [], [], []])
+    assert len(ring) == 8
+    assert ring.appended == 16 and ring.dropped == 8
+    cand, conf = rs.capture_snapshot()
+    assert cand.shape == (8, r) and conf.shape == (8, r)
+    assert cand[:, 0].all()
+    assert conf[0, 0] and not conf[1].any()     # row pattern preserved
+    # without per-request confirmed rows the ring stays silent
+    rs.observe_finalize(hits, [0], [False])
+    assert len(ring) == 8 and ring.appended == 16
+    # reset (the warmup hook) empties the ring with the counters
+    rs.reset()
+    assert len(ring) == 0 and ring.appended == 0
+    rs.disable_capture()
+    assert rs.capture is None
+
+
+def test_bitmap_ring_snapshot_empty():
+    ring = BitmapRing(16, cap_bytes=64)
+    cand, conf = ring.snapshot()
+    assert cand.shape == (0, 16) and conf.shape == (0, 16)
+
+
+def test_capture_feeds_feature_export(packs):
+    from ingress_plus_tpu.utils.export_corpus import build_feature_dataset
+
+    ds = build_feature_dataset(n=48, seed=5, ruleset=packs["inc"],
+                               include_fixtures=False, batch=16)
+    assert ds.n == 48
+    assert ds.n_features == packs["inc"].n_rules
+    assert (ds.rule_ids == np.asarray(packs["inc"].rule_ids)).all()
+    assert ds.x_candidates is not None
+    # candidates over-approximate confirms on every row
+    assert (ds.x_candidates.astype(bool) | ~ds.x.astype(bool)).all()
+    assert len(ds.request_ids) == 48
+    # attacks that confirmed carry hits; labels line up with the corpus
+    assert ds.y.sum() > 0

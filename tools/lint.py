@@ -34,6 +34,12 @@ Four gates, one verdict:
              (dead-regex fixture) to REJECTED with zero traffic
              impact, and a forced mid-canary failure auto-rolls back
              to the incumbent — exactly-one-verdict throughout
+  modelgate  the learned scoring lane (docs/LEARNED_SCORING.md): a
+             deterministic seeded retrain on the exported golden-corpus
+             feature dataset must reproduce the artifact hash, replay
+             with zero new false negatives vs the fixed CRS weights,
+             and flag strictly fewer benign requests at the calibrated
+             threshold (reports/MODELGATE.json)
 
 The container policy is "no new installs": when ruff or mypy are not
 present, those gates report SKIPPED (recorded in the CI report so the
@@ -62,7 +68,8 @@ MYPY_SCOPE = ["ingress_plus_tpu/compiler", "ingress_plus_tpu/analysis",
               "ingress_plus_tpu/models/rule_stats.py",
               "ingress_plus_tpu/post/topk.py",
               "ingress_plus_tpu/control/rollout.py",
-              "ingress_plus_tpu/parallel/serve_mesh.py"]
+              "ingress_plus_tpu/parallel/serve_mesh.py",
+              "ingress_plus_tpu/learn"]
 
 
 def _tool_available(module: str, binary: str) -> bool:
@@ -237,13 +244,80 @@ def run_swapdrill(write_report: bool) -> dict:
     return result
 
 
+def run_modelgate(write_report: bool) -> dict:
+    """Learned-scorer gate (ISSUE 8, docs/LEARNED_SCORING.md): a
+    deterministic seeded retrain on the exported golden-corpus feature
+    dataset must (1) reproduce the artifact hash across two trains
+    (determinism + hash stability), (2) replay with ZERO new false
+    negatives vs the fixed CRS weights, and (3) flag strictly fewer
+    benign requests at the calibrated threshold (the ModSec-Learn
+    claim) — the comparison lands in reports/MODELGATE.json."""
+    t0 = time.time()
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+    from ingress_plus_tpu.learn.train import (
+        compare_scorers, train_from_dataset)
+    from ingress_plus_tpu.utils.export_corpus import build_feature_dataset
+
+    ds = build_feature_dataset(n=1024, seed=20260729)
+    head_a = train_from_dataset(ds)
+    head_b = train_from_dataset(ds)
+    violations = []
+    if head_a.fingerprint() != head_b.fingerprint():
+        violations.append(
+            "retrain not deterministic: %s != %s"
+            % (head_a.fingerprint(), head_b.fingerprint()))
+    cmp = compare_scorers(ds, head_a)
+    if cmp["new_fn_vs_fixed"] != 0:
+        violations.append("learned head lost %d attack(s) the fixed "
+                          "weights caught" % cmp["new_fn_vs_fixed"])
+    if cmp["learned"]["fn"] > cmp["fixed"]["fn"]:
+        violations.append("learned fn %d > fixed fn %d"
+                          % (cmp["learned"]["fn"], cmp["fixed"]["fn"]))
+    if cmp["fixed"]["fp"] == 0:
+        violations.append(
+            "fixed weights produced 0 benign flags on this corpus — "
+            "the FP-reduction claim is unmeasurable (corpus drifted?)")
+    elif cmp["learned"]["fp"] >= cmp["fixed"]["fp"]:
+        violations.append("learned fp %d not strictly below fixed fp %d"
+                          % (cmp["learned"]["fp"], cmp["fixed"]["fp"]))
+    report = {
+        "passed": not violations,
+        "violations": violations,
+        "dataset": {"fingerprint": ds.fingerprint(), "rows": ds.n,
+                    "attacks": int(ds.y.sum()),
+                    "ruleset": ds.meta.get("ruleset")},
+        "artifact": {"version": head_a.version,
+                     "threshold": round(float(head_a.threshold), 6),
+                     "retrain_stable":
+                         head_a.fingerprint() == head_b.fingerprint()},
+        "comparison": cmp,
+    }
+    result = {
+        "status": "OK" if report["passed"] else "FAIL",
+        "seconds": round(time.time() - t0, 2),
+        "detail": "; ".join(violations) or
+                  "retrain stable (%s); fixed fp=%d -> learned fp=%d at "
+                  "zero new FNs over %d rows"
+                  % (head_a.version, cmp["fixed"]["fp"],
+                     cmp["learned"]["fp"], ds.n),
+    }
+    if write_report:
+        out = REPO / "reports" / "MODELGATE.json"
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(report, indent=2, default=str) + "\n")
+        result["report"] = str(out.relative_to(REPO))
+    return result
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tools/lint.py")
     ap.add_argument("--ci", action="store_true",
                     help="CI mode: also write reports/RULECHECK.json")
     ap.add_argument("--only",
                     choices=["ruff", "mypy", "rulecheck", "deadrules",
-                             "faultmatrix", "swapdrill"],
+                             "faultmatrix", "swapdrill", "modelgate"],
                     default=None)
     args = ap.parse_args(argv)
 
@@ -260,6 +334,8 @@ def main(argv=None) -> int:
         gates["faultmatrix"] = run_faultmatrix(write_report=args.ci)
     if args.only in (None, "swapdrill"):
         gates["swapdrill"] = run_swapdrill(write_report=args.ci)
+    if args.only in (None, "modelgate"):
+        gates["modelgate"] = run_modelgate(write_report=args.ci)
 
     failed = False
     for name, r in gates.items():
