@@ -488,6 +488,32 @@ def run_mesh_scale(points=(1, 2, 4, 8),
         log("MESHSCALE WARNING: no 1-device baseline point — the "
             "scaling curve is INCOMPLETE this round (budget or point "
             "failure); the efficiency gate was NOT evaluated")
+    # confirm-stage share (docs/CONFIRM_PLANE.md): the serialized-
+    # residue gauge — when the CPU confirm stage dominates the widest
+    # point's pipeline time, more chips cannot raise mesh throughput
+    # (Amdahl); the warning names the knob that can.
+    widest = max((m for m in results if m.get("confirm_share")
+                  is not None), key=lambda m: m["n_lanes"], default=None)
+    if widest is not None:
+        result["confirm_share_widest"] = widest["confirm_share"]
+        if widest["confirm_share"] >= 0.5:
+            log("=" * 64)
+            log("MESHSCALE WARNING: CONFIRM BOUNDS MESH THROUGHPUT — "
+                "the CPU confirm stage is %.0f%% of pipeline time at "
+                "%d lanes (confirm workers: %s).  Adding chips cannot "
+                "help past this point; raise --confirm-workers (the "
+                "parallel confirm plane, docs/CONFIRM_PLANE.md) or "
+                "improve quick-reject coverage."
+                % (widest["confirm_share"] * 100, widest["n_lanes"],
+                   widest.get("confirm_workers")))
+            log("=" * 64)
+        else:
+            log("MESHSCALE: confirm share at %d lanes is %.0f%% "
+                "(bound-warning gate: >= 50%%)"
+                % (widest["n_lanes"], widest["confirm_share"] * 100))
+    else:
+        log("MESHSCALE WARNING: no point carried a confirm_share — "
+            "the confirm-bound check was NOT evaluated this round")
     if out_path is None:
         out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "reports", "MESHSCALE.json")
@@ -879,9 +905,10 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
                 "scaling": ms.get("scaling"),
                 "efficiency_8dev": ms.get("efficiency_8dev"),
                 "host_cpus": ms.get("host_cpus"),
+                "confirm_share_widest": ms.get("confirm_share_widest"),
                 "points": [{kk: p.get(kk) for kk in
                             ("n_lanes", "req_per_s_mesh",
-                             "serve_time_recompiles")}
+                             "serve_time_recompiles", "confirm_share")}
                            for p in ms.get("points", [])],
                 "artifact": "reports/MESHSCALE.json",
             }
@@ -1247,8 +1274,15 @@ def run_latency_leg(cr, scan_impl: str, platform: str,
              "--requests", "384"],
             capture_output=True, timeout=300)
         # the stage histograms must describe ONLY the measured pass —
-        # drop the warmup's first-dispatch XLA compile observations
+        # drop the warmup's first-dispatch XLA compile observations.
+        # The cumulative PipelineStats stage counters have no reset, so
+        # baseline them here for the confirm_plane share (review catch:
+        # lifetime totals would fold the warmup's compile wall into the
+        # denominator and misstate the measured pass's confirm share)
         batcher.reset_latency_observations()
+        _ps = batcher.pipeline.stats
+        stage_base = (_ps.engine_us, _ps.confirm_us, _ps.prep_us,
+                      _ps.confirm_memo_hits, _ps.confirm_memo_misses)
         out = subprocess.run(
             [loadgen, "--socket", side_sock, "--corpus", corpus_path,
              "--connections", "2", "--inflight", "2",
@@ -1332,6 +1366,41 @@ def run_latency_leg(cr, scan_impl: str, platform: str,
                 % (rsb.get("false_candidate_rate"),
                    rsb.get("padding_waste_ratio"),
                    rsb.get("dispatch_fill"), rsb.get("runtime_dead")))
+        # confirm plane (docs/CONFIRM_PLANE.md): the confirm stage's
+        # share of pipeline time plus the work-reduction attribution
+        # (quick-reject skip rate, flood-memo hits) — the serialized
+        # residue the parallel confirm plane exists to shrink.
+        # Missing/None is a LOUD warning like every other block.
+        try:
+            ps = batcher.pipeline.stats
+            d_engine = ps.engine_us - stage_base[0]
+            d_confirm = ps.confirm_us - stage_base[1]
+            d_prep = ps.prep_us - stage_base[2]
+            d_stages = d_engine + d_confirm + d_prep
+            qr = batcher.pipeline.rule_stats.quick_reject_summary()
+            cp = {
+                "confirm_share": (round(d_confirm / d_stages, 4)
+                                  if d_stages > 0 else None),
+                "confirm_us": d_confirm,
+                "confirm_workers":
+                    batcher.pipeline.confirm_pool.n_workers,
+                "quick_reject": qr,
+                "memo_hits": ps.confirm_memo_hits - stage_base[3],
+                "memo_misses": ps.confirm_memo_misses - stage_base[4],
+            }
+        except Exception as e:
+            cp = None
+            log("WARNING: confirm-plane collection raised (%r)" % (e,))
+        if not cp or cp["confirm_share"] is None:
+            log("WARNING: latency leg has NO confirm_plane block — the "
+                "confirm-stage share of e2e is unmeasured this round")
+        else:
+            lat["confirm_plane"] = cp
+            log("confirm plane: share=%.2f qr_skip_rate=%s "
+                "memo_hits=%d workers=%d"
+                % (cp["confirm_share"],
+                   cp["quick_reject"].get("skip_rate"),
+                   cp["memo_hits"], cp["confirm_workers"]))
         # fail-safe plane sanity (docs/ROBUSTNESS.md): the CLEAN latency
         # leg must never shed, degrade, or trip the breaker — any of
         # those here means the fail-safe layer is costing the happy

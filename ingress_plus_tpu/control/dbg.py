@@ -137,14 +137,22 @@ def render_rules(stats: dict, health: dict, top: int = 20) -> str:
                     eff.get("dispatch_fill"),
                     eff.get("engine_recompiles")))
     lines.append("")
-    lines.append("%-8s %-7s %10s %10s %8s %8s %9s"
+    qr = health.get("quick_reject") or {}
+    if qr:
+        lines.append("quick-reject: %s/%s rx rules carry literals "
+                     "(skips=%s regex_evals=%s skip_rate=%s)"
+                     % (qr.get("rules_with_literals"), qr.get("rx_rules"),
+                        qr.get("skips"), qr.get("regex_evals"),
+                        qr.get("skip_rate")))
+    lines.append("%-8s %-7s %10s %10s %8s %8s %9s %10s %9s"
                  % ("rule_id", "family", "cand", "confirmed", "errors",
-                    "fc_rate", "score_sum"))
+                    "fc_rate", "score_sum", "confirm_us", "qr_skips"))
     for r in (stats.get("rules") or [])[:top]:
-        lines.append("%-8d %-7s %10d %10d %8d %8.3f %9d"
+        lines.append("%-8d %-7s %10d %10d %8d %8.3f %9d %10d %9d"
                      % (r["rule_id"], r["family"], r["candidates"],
                         r["confirmed"], r["confirm_errors"],
-                        r["false_candidate_rate"], r["score_sum"]))
+                        r["false_candidate_rate"], r["score_sum"],
+                        r.get("confirm_us", 0), r.get("quick_rejects", 0)))
     dead = health.get("runtime_dead") or []
     lines.append("")
     lines.append("runtime-dead rules (%d):" % len(dead))
@@ -168,6 +176,16 @@ def render_rules(stats: dict, health: dict, top: int = 20) -> str:
                          % (w["rule_id"], w["family"],
                             w["wasted_confirms"],
                             w["false_candidate_rate"]))
+    cost = health.get("top_expensive_confirms") or []
+    if cost:
+        lines.append("")
+        lines.append("top confirm cost (cumulative, docs/CONFIRM_PLANE.md):")
+        for w in cost[:10]:
+            lines.append("  %-8d %-7s confirm_us=%-9d cand=%-6d "
+                         "us/cand=%s qr_skips=%d"
+                         % (w["rule_id"], w["family"], w["confirm_us"],
+                            w["candidates"], w.get("us_per_candidate"),
+                            w.get("quick_rejects", 0)))
     return "\n".join(lines)
 
 

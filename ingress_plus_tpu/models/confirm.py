@@ -18,7 +18,7 @@ from __future__ import annotations
 import base64
 import binascii
 import re
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ingress_plus_tpu.serve.bodyparse import flatten_json, parse_multipart
 from ingress_plus_tpu.serve.normalize import (
@@ -28,6 +28,88 @@ from ingress_plus_tpu.serve.normalize import (
 from ingress_plus_tpu.serve.unpack import SEP as _UNPACK_SEP
 
 _WS = b" \t\n\r\f\v"
+
+# ------------------------------------------------- quick-reject literals
+# (docs/CONFIRM_PLANE.md).  The compiler's mandatory-factor machinery
+# (compiler/factors.py) proves that every match of a regex contains a
+# substring from some alternative group; when every alternative of such
+# a group collapses to a fixed byte literal (singleton classes up to
+# ASCII case), the confirm stage can pre-check `literal in value` —
+# C-level memmem — before paying ``re.search``.  The check runs on the
+# EXACT text the regex would search (post-transform), so it is sound by
+# construction: no literal present ⇒ the regex cannot match ⇒ the
+# operator outcome is exactly False (negation then applies as usual).
+# Case handling: literals are derived LOWERCASED and the haystack is
+# lowercased unless no literal carries an ASCII letter — sound for
+# case-sensitive patterns too (``"SELECT" in v`` ⇒ ``"select" in
+# v.lower()``, so a lowercase miss proves the case-exact miss).
+
+#: weakest usable literal: below this ``lit in value`` fires on nearly
+#: everything and the pre-check is pure overhead
+QR_MIN_LEN = 3
+#: alternative cap: a wide group costs one memmem per alternative per
+#: value — past this the regex is usually cheaper
+QR_MAX_ALTS = 8
+
+
+def _group_literals(group) -> Optional[List[bytes]]:
+    """One mandatory group → lowercased literal alternatives, or None
+    when any alternative has a position that is not a single byte up to
+    ASCII case (or is non-ASCII: the str-level regex AST and the
+    byte-level ``re`` pattern diverge outside ASCII — abstain)."""
+    lits: List[bytes] = []
+    for seq in group:
+        lit = bytearray()
+        for cls in seq:
+            folded = {(b + 0x20 if 0x41 <= b <= 0x5A else b) for b in cls}
+            if len(folded) != 1:
+                return None
+            b = folded.pop()
+            if b > 0x7F:
+                return None
+            lit.append(b)
+        lits.append(bytes(lit))
+    return lits or None
+
+
+def derive_quick_reject(pattern: str,
+                        fold: bool) -> Optional[Tuple[bytes, ...]]:
+    """Case-folded mandatory literals for an ``@rx`` pattern: a tuple of
+    lowercased byte literals such that any match of the pattern contains
+    at least one of them (case-insensitively), or None when no usable
+    literal group exists.  Picks the group whose WEAKEST alternative is
+    longest — the group is only as selective as its weakest literal."""
+    from ingress_plus_tpu.compiler.factors import mandatory_groups
+    from ingress_plus_tpu.compiler.regex_ast import (
+        RegexUnsupported,
+        parse_regex,
+    )
+
+    try:
+        ast = parse_regex(pattern, ignorecase=fold)
+    except (RegexUnsupported, RecursionError):
+        return None
+    best: Optional[Tuple[int, List[bytes]]] = None
+    try:
+        groups = mandatory_groups(ast)
+    except RecursionError:
+        return None
+    for group in groups:
+        if not group or len(group) > QR_MAX_ALTS:
+            continue
+        lits = _group_literals(group)
+        if lits is None:
+            continue
+        weakest = min(len(lit) for lit in lits)
+        if weakest < QR_MIN_LEN:
+            continue
+        if best is None or weakest > best[0]:
+            best = (weakest, lits)
+    if best is None:
+        return None
+    # dedup, longest-first (a long literal missing is the common case;
+    # order does not affect soundness, only which memmem runs first)
+    return tuple(sorted(dict.fromkeys(best[1]), key=len, reverse=True))
 
 
 def t_lowercase(d: bytes) -> bytes:
@@ -171,6 +253,37 @@ def apply_transforms(data: bytes, transforms: List[str]) -> bytes:
         if fn is not None:
             data = fn(data)
     return data
+
+
+# ------------------------------------------- cross-request transform memo
+# Transforms are pure functions, and short confirm values repeat heavily
+# across requests (header values, content types, common parameters) —
+# the per-request cache re-pays urlDecode/htmlEntityDecode for the same
+# "Mozilla/5.0 ..." on every request.  This process-level memo keys on
+# (transform chain, text) for SHORT texts only (long bodies rarely
+# repeat and would dominate the memory bound); at capacity it clears and
+# rebuilds — self-healing under high-cardinality traffic, and the steady
+# serve-plane working set (stable header vocabulary) re-fills in one
+# cycle.  Concurrent confirm workers may duplicate a compute; dict ops
+# are GIL-atomic and the value is identical, so races are harmless.
+
+_TF_MEMO: Dict[tuple, bytes] = {}
+_TF_MEMO_CAP = 1 << 15
+_TF_MEMO_MAXLEN = 512
+
+
+def transform_cached(tkey: tuple, transforms: List[str],
+                     text: bytes) -> bytes:
+    if len(text) > _TF_MEMO_MAXLEN:
+        return apply_transforms(text, transforms)
+    key = (tkey, text)
+    v = _TF_MEMO.get(key)
+    if v is None:
+        v = apply_transforms(text, transforms)
+        if len(_TF_MEMO) >= _TF_MEMO_CAP:
+            _TF_MEMO.clear()
+        _TF_MEMO[key] = v
+    return v
 
 
 def _atoi(text: bytes) -> int:
@@ -474,21 +587,75 @@ class ConfirmRule:
         self.arg: bytes = confirm.get("arg", "").encode(
             "utf-8", "surrogateescape")
         self.compile_error: Optional[str] = None
+        # quick-reject (docs/CONFIRM_PLANE.md): lowercased mandatory
+        # literals derived from the pattern once per install; the
+        # counters are telemetry-grade plain ints (concurrent confirm
+        # workers may lose the odd increment — bounded noise in
+        # observability, never in verdicts)
+        self.qr_literals: Optional[Tuple[bytes, ...]] = None
+        self.qr_caseless = False
+        self.qr_skips = 0
+        self.qr_evals = 0
         if self.op == "rx":
             flags = re.IGNORECASE if self.fold else 0
             try:
                 self.rx = re.compile(self.arg, flags)
             except re.error as e:
                 self.compile_error = str(e)
+            if self.rx is not None:
+                self.qr_literals = derive_quick_reject(
+                    confirm.get("arg", ""), self.fold)
+                if self.qr_literals is not None:
+                    # letter-free literals need no case fold of the
+                    # haystack — the common "../", "<!--" shapes skip
+                    # the per-value lower() entirely
+                    self.qr_caseless = not any(
+                        0x61 <= b <= 0x7A for lit in self.qr_literals
+                        for b in lit)
         self.allowed_bytes: Optional[frozenset] = None
+        self._vbr_delete: bytes = b""
         if self.op == "validateByteRange":
             allowed = set()
             for lo, hi in _parse_byte_ranges(self.arg):
                 allowed.update(range(lo, hi + 1))
             self.allowed_bytes = frozenset(allowed) if allowed else None
+            if self.allowed_bytes is not None:
+                # delete-table for the C-level translate fast path in
+                # _op_match (the set(text) form built a Python set per
+                # value on an always-confirm op — measured hot)
+                self._vbr_delete = bytes(sorted(
+                    b for b in self.allowed_bytes if 0 <= b <= 255))
         self.chain = [ConfirmRule(c) for c in confirm.get("chain", [])]
         self._plan, self._exclusions = self._compile_targets()
         self._matched_spec = self._parse_matched_spec()
+        # hot-path precomputation: the transform-chain key was rebuilt
+        # as tuple(self.transforms) on EVERY _self_match call (measured
+        # in the confirm-plane profile), and the rule-level quick-reject
+        # keys its per-request haystack on (plan, chain) — rules sharing
+        # a CRS target list + transform chain share one haystack build
+        self._tkey = tuple(self.transforms)
+        self._plan_sig = tuple(
+            (count, base, sel) for count, base, sel in self._plan)
+        # rule-level quick-reject eligibility (docs/CONFIRM_PLANE.md):
+        # positive @rx with mandatory literals, no compiled target
+        # exclusions (they narrow the value set per rule — the shared
+        # haystack would over-include, which is sound for REJECT but
+        # the bail keeps the logic obvious), and no count entries
+        # (counts yield numbers, not scannable text)
+        self._qr_rule_ok = (
+            self.op == "rx" and self.rx is not None and not self.negate
+            and self.qr_literals is not None and not self._exclusions
+            and bool(self._plan)
+            and all(not count for count, _b, _s in self._plan))
+
+    def walk_chain(self):
+        """This rule then every chain link, depth-first.  Chain links
+        run ``_op_match`` (and so the quick-reject pre-check) too — the
+        confirm-plane telemetry and the microbench toggle must cover
+        them, not just the top-level rule (review catch)."""
+        yield self
+        for link in self.chain:
+            yield from link.walk_chain()
 
     def dead_reason(self) -> Optional[str]:
         """Why this rule can never fire at runtime, or None.
@@ -667,14 +834,47 @@ class ConfirmRule:
         elif val:
             yield val, True, False, None
 
-    def _op_match(self, text: bytes) -> Optional[bool]:
+    def _op_match(self, text: bytes,
+                  cache: Optional[Dict] = None) -> Optional[bool]:
         """Tri-state: True/False = evaluated; None = ABSTAIN (cannot
         evaluate: macro argument, unsupported operator, broken regex).
         The distinction is load-bearing for negation — a blind boolean
-        would turn every abstain into an always-fire under "!@op"."""
+        would turn every abstain into an always-fire under "!@op".
+
+        ``cache`` is the per-request memo (the same dict the transform
+        layer uses): the quick-reject's lowercased haystack is keyed on
+        the value object there, so one request's uri/blob lowers ONCE
+        across every case-folded rule instead of once per rule (the
+        first cut lowered per (rule, value) and was a measured
+        regression)."""
         if self.op == "rx":
             if self.rx is None:
                 return None   # unmatchable pattern: abstain
+            lits = self.qr_literals
+            if lits is not None:
+                # mandatory-literal quick-reject: no literal in the
+                # exact text the regex would search ⇒ the regex cannot
+                # match — an EXACT False, so negation composes as usual
+                if self.qr_caseless:
+                    hay = text
+                elif cache is None:
+                    hay = text.lower()
+                else:
+                    # bytes keys cannot collide with the cache's other
+                    # (tuple) key families; transform memoization hands
+                    # every rule the SAME value object, so the bytes
+                    # hash is computed once and reused
+                    hay = cache.get(text)
+                    if hay is None:
+                        hay = text.lower()
+                        cache[text] = hay
+                for lit in lits:
+                    if lit in hay:
+                        break
+                else:
+                    self.qr_skips += 1
+                    return False
+                self.qr_evals += 1
             return self.rx.search(text) is not None
         if self.op == "pm":
             low = text.lower()
@@ -708,11 +908,12 @@ class ConfirmRule:
                     "le": val <= ref, "lt": val < ref}[self.op]
         if self.op == "validateByteRange":
             # fires when any byte falls OUTSIDE the allowed ranges;
-            # set(text) keeps the scan in C — this runs on the
-            # always-confirm path for every request with a body
+            # translate-with-delete keeps the whole scan in C with no
+            # per-value set build — this runs on the always-confirm
+            # path for every request with a body
             if self.allowed_bytes is None:
                 return None
-            return bool(set(text) - self.allowed_bytes)
+            return bool(text.translate(None, self._vbr_delete))
         if self.op == "validateUrlEncoding":
             # fires on '%' not followed by two hex digits
             return re.search(rb"%(?![0-9a-fA-F]{2})", text) is not None
@@ -791,6 +992,82 @@ class ConfirmRule:
             name = "%s:%s" % (name, s)
         return ("&" + name) if count else name
 
+    def _entry_vals(self, entry, streams: Dict[str, bytes],
+                    cache: Dict) -> list:
+        """Materialized post-transform value list for one plan entry —
+        ``[(val, exact, is_count, label), ...]`` in ``_iter_entry``
+        order — cached per (entry, transform chain) in the REQUEST
+        cache.  CRS rules cluster heavily on (target list, transform
+        chain), so a request's ~60+ candidate walks share a handful of
+        builds instead of re-iterating the generator and re-keying the
+        per-value transform memo once per rule (measured: the iteration
+        machinery, not ``re``, dominated confirm cost).  Only valid for
+        exclusion-free evaluation — callers with compiled or ctl
+        exclusions take the generator path."""
+        key = ("#vals", entry, self._tkey)
+        vals = cache.get(key)
+        if vals is None:
+            # one copy of the per-value transform dispatch: the cached
+            # form is exactly the generator's output, materialized
+            vals = list(self._transformed_iter(entry, streams, cache,
+                                               None))
+            cache[key] = vals
+        return vals
+
+    def _transformed_iter(self, entry, streams: Dict[str, bytes],
+                          cache: Optional[Dict],
+                          extra_excl: Optional[Dict]):
+        """Generator twin of :meth:`_entry_vals` for evaluations the
+        shared cache cannot serve — compiled ``!VAR:x`` exclusions,
+        runtime ctl target exclusions, or cache-less library callers —
+        yielding the same ``(val, exact, is_count, label)`` shape."""
+        tkey = self._tkey
+        for text, exact, is_count, label in self._iter_entry(
+                entry, streams, cache, extra_excl):
+            if is_count:
+                val = text   # counts are numbers; transforms don't apply
+            elif len(text) <= _TF_MEMO_MAXLEN:
+                val = transform_cached(tkey, self.transforms, text)
+            elif cache is None:
+                val = apply_transforms(text, self.transforms)
+            else:
+                key = (tkey, text)
+                val = cache.get(key)
+                if val is None:
+                    val = apply_transforms(text, self.transforms)
+                    cache[key] = val
+            yield val, exact, is_count, label
+
+    def _build_qr_hay(self, streams: Dict[str, bytes],
+                      cache: Dict) -> bytes:
+        """Build (and cache) the whole-rule quick-reject haystack for
+        this rule's (plan, chain) combo — the batched form of the
+        per-value literal pre-check, consumed by the confirm plane's
+        walk (models/confirm_plane.py confirm_one, where the literal
+        scan itself is inlined; docs/CONFIRM_PLANE.md): every text
+        ``_self_match`` would feed the regex, post-transform,
+        separator-joined and LOWERED once.  Built at most once per
+        request per (target plan, transform chain) — CRS rules cluster
+        heavily on both, so a request's ~60+ candidates share a
+        handful of builds through the request cache.  If no mandatory
+        literal occurs in the haystack, no value can contain one
+        (value ⊆ concat), every per-value check would return the exact
+        False, and the rule's own match fails — chain links never
+        evaluate, detail stays empty, so a reject is bit-identical to
+        the full walk.  Lowered containment is exact for letter-free
+        literals and sound for folded ones.  Only valid for
+        ``_qr_rule_ok`` rules with no per-request ctl exclusions
+        (exclusions shrink the value set; the shared haystack would
+        over-include — sound for a REJECT, but the caller bails to
+        keep the reasoning local)."""
+        parts: List[bytes] = []
+        for entry in self._plan:
+            parts.extend(v for v, _e, _c, _l in
+                         self._entry_vals(entry, streams, cache))
+        hay = b"\x00".join(parts).lower()
+        cache[("#qrh", self._plan_sig, self._tkey)] = hay
+        return hay
+
     def matches_streams(self, streams: Dict[str, bytes],
                         cache: Optional[Dict] = None,
                         extra_excl: Optional[Dict] = None,
@@ -853,24 +1130,27 @@ class ConfirmRule:
         operators on its own targets."""
         hit = False
         restrict = self.negate or self.op in NUMERIC_OPS
-        tkey = tuple(self.transforms)
         matched: list = []
+        # exclusion-free evaluation (the overwhelmingly common case)
+        # iterates the request-cached post-transform value lists —
+        # shared across every rule with the same (target entry,
+        # transform chain); exclusions change the value SET per rule,
+        # so those rules keep the per-rule generator path
+        fast = cache is not None and not self._exclusions \
+            and not extra_excl
+        tkey = self._tkey
         for entry in self._plan:
-            for text, exact, is_count, label in self._iter_entry(
-                    entry, streams, cache, extra_excl):
+            if fast:
+                viter = cache.get(("#vals", entry, tkey))
+                if viter is None:
+                    viter = self._entry_vals(entry, streams, cache)
+            else:
+                viter = self._transformed_iter(entry, streams, cache,
+                                               extra_excl)
+            for val, exact, is_count, label in viter:
                 if restrict and not exact:
                     continue  # abstain: blob values can't drive negation
-                if is_count:
-                    val = text  # counts are numbers; transforms don't apply
-                elif cache is None:
-                    val = apply_transforms(text, self.transforms)
-                else:
-                    key = (tkey, text)
-                    val = cache.get(key)
-                    if val is None:
-                        val = apply_transforms(text, self.transforms)
-                        cache[key] = val
-                m = self._op_match(val)
+                m = self._op_match(val, cache)
                 if m is None:
                     continue   # abstain survives negation: never a hit
                 if m != self.negate:

@@ -30,6 +30,11 @@ from ingress_plus_tpu.compiler.ruleset import (
 from ingress_plus_tpu.compiler.seclang import CLASSES, STREAMS
 from ingress_plus_tpu.models.acl import AclStore
 from ingress_plus_tpu.models.confirm import ConfirmRule, parse_exclusion_token
+from ingress_plus_tpu.models.confirm_plane import (
+    ConfirmPool,
+    launch_confirm,
+    join_confirm,
+)
 from ingress_plus_tpu.models.engine import DetectionEngine
 from ingress_plus_tpu.models.rule_stats import RuleStats
 from ingress_plus_tpu.utils import faults
@@ -122,6 +127,13 @@ class PipelineStats:
     #: "learned_pass" = head passes where fixed would flag) —
     #: /metrics ipt_scorer_diff_total{kind=}, /scoring, `dbg scoring`
     scorer_diff: Dict[str, int] = field(default_factory=dict)
+    # confirm plane (docs/CONFIRM_PLANE.md): wedged confirm-worker
+    # shares failed open within the pool's hang budget, and the
+    # per-cycle flood-memo outcome counters (the memoization half of
+    # the fixed-pack A/B attribution)
+    confirm_hangs: int = 0
+    confirm_memo_hits: int = 0
+    confirm_memo_misses: int = 0
 
     def count_scorer_diff(self, kind: str) -> None:
         """Single-writer like count_shed (finalize runs under the
@@ -162,6 +174,17 @@ class _ScanJob:
     busy_us: int = 0
     pending: object = None
     result: Optional[np.ndarray] = None
+
+
+@dataclass
+class _FinishJob:
+    """In-flight finish phase of one lane share (detect_collect_launch
+    → detect_collect_join): either immediate ``verdicts`` (empty share,
+    brownout rungs) or a pending confirm-plane job ``cjob``."""
+
+    verdicts: Optional[List["Verdict"]] = None
+    cjob: object = None
+    t0: float = 0.0
 
 
 def warm_sizes(max_batch: int) -> List[int]:
@@ -303,6 +326,9 @@ class DetectionPipeline:
         default_acl: str = "",
         engine=None,
         scoring_head=None,
+        confirm_workers: int = 1,
+        confirm_hang_budget_s: float = 30.0,
+        confirm_memo_entries: int = 4096,
     ):
         # ``engine``: pre-built engine to serve with (e.g. the batcher
         # hot-swap passing a mesh-backed MeshEngine.rebuilt) — skips
@@ -334,6 +360,16 @@ class DetectionPipeline:
             paranoia_level = getattr(ruleset, "paranoia_hint", None) or 2
         self.fail_open = fail_open
         self.stats = PipelineStats()
+        # parallel confirm plane (docs/CONFIRM_PLANE.md): workers == 1
+        # (the default) runs the classic serial walk inline — no
+        # threads, no handoff; the serve plane sizes the pool via
+        # --confirm-workers.  The batcher carries ONE pool across hot
+        # swaps like the stats object, so a replacement pipeline's own
+        # (inline, thread-free) pool is simply dropped.
+        self.confirm_pool = ConfirmPool(n_workers=confirm_workers,
+                                        hang_budget_s=confirm_hang_budget_s)
+        #: per-cycle flood-memo capacity; 0 disables memoization
+        self.confirm_memo_entries = int(confirm_memo_entries)
         # brownout ladder (docs/ROBUSTNESS.md): the serve batcher feeds
         # queue-delay observations and detect() consults the level; a
         # hot-swap carries the controller over with the stats object so
@@ -707,27 +743,32 @@ class DetectionPipeline:
             job.result = _dispatch()
         return job
 
-    def detect_collect(self, job,
-                       timeout: Optional[float] = None) -> List[Verdict]:
-        """Second half of :meth:`detect_launch`: bound-wait the device
-        result, then mask + confirm + score exactly as ``detect``
-        would.  Raises ``DeviceHang`` (lane wedged past ``timeout``) or
-        the dispatch's own error — ``detect_strict`` semantics, so the
-        batcher's per-lane breaker can count failures before producing
-        the fail-open verdicts itself."""
+    def detect_collect_launch(self, job,
+                              timeout: Optional[float] = None):
+        """First half of :meth:`detect_collect` (docs/CONFIRM_PLANE.md):
+        bound-wait the DEVICE result, mask, and LAUNCH the confirm
+        phase on the pool — without joining it.  Raises ``DeviceHang``
+        (lane wedged past ``timeout``) or the dispatch's own error,
+        exactly like ``detect_collect`` did, so the batcher's per-lane
+        breaker accounting is unchanged.  Returns a ``_FinishJob`` for
+        :meth:`detect_collect_join`; degenerate paths (empty share,
+        brownout rungs) resolve to verdicts immediately inside it."""
         requests = job.requests
+        fin = _FinishJob()
         if not requests:
-            return []
+            fin.verdicts = []
+            return fin
         st = self.stats
         if job.level >= 2:
             st.fail_open += len(requests)
             st.degraded += len(requests)
-            return [
+            fin.verdicts = [
                 Verdict(request_id=r.request_id, blocked=False,
                         attack=False, classes=[], rule_ids=[], score=0,
                         fail_open=True, degraded=True)
                 for r in requests
             ]
+            return fin
         Q = len(requests)
         rule_hits = np.zeros((self._pad_q(Q), self.ruleset.n_rules),
                              dtype=bool)
@@ -740,8 +781,33 @@ class DetectionPipeline:
         masked = self.mask_hits(requests, rule_hits[:Q])
         st.prefilter_rule_hits += int(masked.sum())
         if job.level == 1:
-            return self._finalize_prefilter_only(requests, masked, job.t0)
-        return self.finalize(requests, masked, job.t0)
+            fin.verdicts = self._finalize_prefilter_only(requests, masked,
+                                                         job.t0)
+            return fin
+        fin.t0 = job.t0
+        fin.cjob = self.finalize_launch(requests, masked)
+        return fin
+
+    def detect_collect_join(self, fin) -> List[Verdict]:
+        """Second half of :meth:`detect_collect`: bounded-join the
+        confirm shares and fold verdicts.  With ``--confirm-workers``
+        > 1 the batcher's mesh loop calls this one drain later than
+        the launch, so cycle N's confirm overlaps cycle N+1's scan
+        dispatch (docs/CONFIRM_PLANE.md)."""
+        if fin.verdicts is not None:
+            return fin.verdicts
+        return self.finalize_join(fin.cjob, fin.t0)
+
+    def detect_collect(self, job,
+                       timeout: Optional[float] = None) -> List[Verdict]:
+        """Second half of :meth:`detect_launch`: bound-wait the device
+        result, then mask + confirm + score exactly as ``detect``
+        would.  Raises ``DeviceHang`` (lane wedged past ``timeout``) or
+        the dispatch's own error — ``detect_strict`` semantics, so the
+        batcher's per-lane breaker can count failures before producing
+        the fail-open verdicts itself."""
+        return self.detect_collect_join(
+            self.detect_collect_launch(job, timeout))
 
     def _detect_inner(self, requests: List[Request], t0: float) -> List[Verdict]:
         self.stats.requests += len(requests)
@@ -945,77 +1011,76 @@ class DetectionPipeline:
             rule_hits = rule_hits & self.tenant_rule_mask[tenants]
         return rule_hits & self.paranoia_mask[None, :]
 
+    def finalize_launch(self, requests: List[Request],
+                        rule_hits: np.ndarray):
+        """Start the confirm phase for one batch of already-masked
+        prefilter hits (docs/CONFIRM_PLANE.md): the per-request
+        candidate walks run on the confirm pool — inline (the classic
+        serial path) at ``--confirm-workers 1``, as round-robin request
+        shares on the worker threads otherwise.  Returns the job for
+        :meth:`finalize_join`."""
+        return launch_confirm(self, requests, rule_hits)
+
     def finalize(self, requests: List[Request], rule_hits: np.ndarray,
                  t0: float, observe_rules: bool = True) -> List[Verdict]:
         """Confirm + scoring stage on already-masked prefilter hits.
         ``observe_rules=False`` skips the per-rule telemetry fold —
         the CPU-fallback path passes a synthetic full candidate matrix
         that must not book as prefilter statistics."""
+        return self.finalize_join(self.finalize_launch(requests, rule_hits),
+                                  t0, observe_rules=observe_rules)
+
+    def finalize_join(self, cjob, t0: float,
+                      observe_rules: bool = True) -> List[Verdict]:
+        """Bounded-join the confirm shares, then the SINGLE-THREADED
+        fold: telemetry, scoring, ACL, Verdict assembly.  A request
+        whose confirm share wedged past the pool's hang budget fails
+        open HERE (only that share — siblings' verdicts are exact);
+        everything else is the pre-pool serial finalize, verdict for
+        verdict."""
         stats = self.stats
-        # CPU confirm: exact semantics, only on (request, rule) hits
         tc0 = time.perf_counter()
-        faults.sleep_if("slow_confirm")
+        results = join_confirm(self, cjob)
+        requests, rule_hits = cjob.requests, cjob.rule_hits
         verdicts: List[Verdict] = []
         rs = self.ruleset
         # per-rule telemetry accumulators for this batch (folded into
         # RuleStats in ONE vectorized update after the loop);
         # excl_rows: requests where a matched runtime-ctl rule removed
         # rules before confirm — those (request, rule) candidates were
-        # never confirm-evaluated and must not book as wasted confirms
+        # never confirm-evaluated and must not book as wasted confirms;
+        # failed_rows: requests whose confirm share wedged — nothing
+        # about them was evaluated, so they book as neither candidates
+        # nor wasted confirms
         all_confirmed: List[int] = []
         all_blocked: List[bool] = []
         confirmed_rows: List[List[int]] = []
         excl_rows: List[tuple] = []
+        failed_rows: List[int] = []
+        ridx_all: List[int] = []
+        rns_all: List[int] = []
         scorer = self.scorer
         for qi, req in enumerate(requests):
-            hit_rules = np.nonzero(rule_hits[qi])[0]
-            confirmed: List[int] = []
-            streams = req.confirm_streams() if len(hit_rules) else {}
-            cache: Dict = {}   # per-request transform memo across rules
-            # pass 1 — runtime ctl exclusions: a matched exclusion rule
-            # (ctl:ruleRemoveById / ruleRemoveTargetById / ruleEngine=
-            # Off) removes rules or target subfields for THIS request
-            # before detection rules are confirmed (ModSecurity's
-            # request-scoped ctl semantics, resolved statically at
-            # compile time — compiler/ruleset.py _resolve_ctls)
-            excluded = None          # (R,) bool or None
-            extra_excl: Dict = {}    # rule index → {kind: {selector}}
-            detection_only = False   # ctl:ruleEngine=DetectionOnly matched
-            for ci, remove_mask, target_excl, engine in self.ctl_rules:
-                if not rule_hits[qi, ci]:
-                    continue
-                if not self.confirms[ci].matches_streams(streams, cache):
-                    continue
-                if engine == "off":
-                    excluded = np.ones(rule_hits.shape[1], dtype=bool)
-                    break
-                if engine == "detection_only":
-                    detection_only = True
-                if remove_mask.any():
-                    excluded = (remove_mask if excluded is None
-                                else excluded | remove_mask)
-                for idx, excl_map in target_excl.items():
-                    merged = extra_excl.setdefault(idx, {})
-                    for kind, sels in excl_map.items():
-                        merged.setdefault(kind, set()).update(sels)
-            if excluded is not None:
-                excl_rows.append((qi, excluded))
-            points: List[dict] = []
-            for r in hit_rules:
-                r = int(r)
-                if r in self._ctl_pass_idx:
-                    continue   # config machinery, never a detection hit
-                if excluded is not None and excluded[r]:
-                    continue
-                det: list = []
-                if self.confirms[r].matches_streams(
-                        streams, cache, extra_excl.get(r),
-                        detail_out=det if len(points) < 8 else None):
-                    confirmed.append(r)
-                    if det:
-                        points.append({"rule_id": int(rs.rule_ids[r]),
-                                       "var": det[0][0],
-                                       "value": det[0][1]})
+            res = results[qi]
+            if res is None:
+                # this request's confirm share wedged: fail open, the
+                # wallarm-fallback answer — detection degrades for the
+                # wedged worker's share only, traffic does not
+                failed_rows.append(qi)
+                stats.fail_open += 1
+                confirmed_rows.append([])
+                verdicts.append(Verdict(
+                    request_id=req.request_id, blocked=False,
+                    attack=False, classes=[], rule_ids=[], score=0,
+                    fail_open=True))
+                continue
+            confirmed = res.confirmed
+            points = res.points
+            detection_only = res.detection_only
+            if res.excluded is not None:
+                excl_rows.append((qi, res.excluded))
+            ridx_all.extend(res.rule_idx)
+            rns_all.extend(res.rule_ns)
             score = int(rs.rule_score[confirmed].sum()) if confirmed else 0
             classes = sorted(
                 {CLASSES[rs.rule_class[r]] for r in confirmed})
@@ -1077,17 +1142,30 @@ class DetectionPipeline:
             confirmed_rows.append(confirmed)
         if observe_rules:
             cand_hits = rule_hits[:len(requests)]
-            if excl_rows:
+            if excl_rows or failed_rows:
                 # copy only when a runtime ctl exclusion actually
-                # matched (rare); ctl-pass config rules are suppressed
-                # inside observe_finalize via the RuleStats.ignored mask
+                # matched or a confirm share wedged (both rare);
+                # ctl-pass config rules are suppressed inside
+                # observe_finalize via the RuleStats.ignored mask
                 cand_hits = cand_hits.copy()
                 for qi, ex in excl_rows:
                     cand_hits[qi, ex] = False
+                for qi in failed_rows:
+                    cand_hits[qi, :] = False
             self.rule_stats.observe_finalize(
                 cand_hits, all_confirmed, all_blocked,
-                confirmed_rows=confirmed_rows)
-        stats.confirm_us += int((time.perf_counter() - tc0) * 1e6)
+                confirmed_rows=confirmed_rows,
+                rule_ns=(ridx_all, rns_all) if ridx_all else None)
+        if cjob.memo is not None:
+            stats.confirm_memo_hits += cjob.memo.hits
+            stats.confirm_memo_misses += cjob.memo.misses
+        # confirm stage wall = launch window + this join (share waits +
+        # fold).  On the overlapped mesh path the wall BETWEEN launch
+        # and join is the double buffer's window, not confirm cost —
+        # excluded by construction; the per-rule confirm_ns telemetry
+        # (RuleStats) carries the true CPU cost either way.
+        stats.confirm_us += cjob.launch_us + int(
+            (time.perf_counter() - tc0) * 1e6)
         stats.confirmed_rule_hits += sum(len(v.rule_ids) for v in verdicts)
 
         elapsed = int((time.perf_counter() - t0) * 1e6)

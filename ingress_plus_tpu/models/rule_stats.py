@@ -141,6 +141,12 @@ class RuleStats:
         self.confirm_errors = np.zeros((R,), dtype=np.int64)
         self.score_sum = np.zeros((R,), dtype=np.int64)
         self.block_hits = np.zeros((R,), dtype=np.int64)
+        # per-rule cumulative confirm cost (docs/CONFIRM_PLANE.md):
+        # nanoseconds spent in this rule's candidate walks, sampled per
+        # (request, rule) by the confirm plane and folded here in one
+        # vectorized add per batch — /rules/health ranks the top-
+        # expensive confirms from it
+        self.confirm_ns = np.zeros((R,), dtype=np.int64)
         self.requests = 0
         # config machinery (ctl-carrying pass-action rules): never a
         # detection hit by design, excluded from the never-hit /
@@ -156,6 +162,11 @@ class RuleStats:
                 if reason is not None:
                     self.broken[i] = True
                     self.broken_reason[i] = reason
+        # the ConfirmRule closures themselves: quick-reject counters
+        # (qr_skips/qr_evals — telemetry-grade plain ints maintained by
+        # the confirm plane) are gathered from them at snapshot time,
+        # so the per-generation reset convention covers them too
+        self._confirms: List = list(confirms) if confirms is not None else []
         # opt-in raw-bitmap capture (learned-scorer feature source);
         # None = off, the serve-plane default
         self.capture: Optional[BitmapRing] = None
@@ -192,9 +203,13 @@ class RuleStats:
         with self._lock:
             for a in (self.candidates, self.confirmed,
                       self.confirm_errors, self.score_sum,
-                      self.block_hits):
+                      self.block_hits, self.confirm_ns):
                 a[:] = 0
             self.requests = 0
+            for c in self._confirms:
+                for r in c.walk_chain():
+                    r.qr_skips = 0
+                    r.qr_evals = 0
             if self.capture is not None:
                 self.capture.clear()
 
@@ -202,7 +217,10 @@ class RuleStats:
                          confirmed_idx: Sequence[int],
                          confirmed_blocked: Sequence[bool],
                          confirmed_rows: Optional[
-                             Sequence[Sequence[int]]] = None) -> None:
+                             Sequence[Sequence[int]]] = None,
+                         rule_ns: Optional[Tuple[Sequence[int],
+                                                 Sequence[int]]] = None
+                         ) -> None:
         """Fold one finalize batch.
 
         ``rule_hits``: the (Q, R) masked candidate matrix the batch
@@ -214,7 +232,10 @@ class RuleStats:
         ``confirmed_rows``: per-request confirmed index lists (len Q) —
         only consumed by the opt-in capture ring, which stays silent
         when the caller cannot provide them (prefilter-only brownout
-        verdicts are not training-grade features)."""
+        verdicts are not training-grade features);
+        ``rule_ns``: per-(request, rule) confirm cost samples from the
+        confirm plane as parallel (rule_index, nanoseconds) sequences —
+        folded into ``confirm_ns`` in one vectorized add."""
         cand = rule_hits.sum(axis=0, dtype=np.int64)
         # config machinery (ignored mask) is never a detection
         # candidate — suppress on the reduced vector, one place
@@ -239,6 +260,10 @@ class RuleStats:
                 bidx = idx[np.asarray(confirmed_blocked, dtype=bool)]
                 if len(bidx):
                     np.add.at(self.block_hits, bidx, 1)
+            if rule_ns is not None and len(rule_ns[0]):
+                np.add.at(self.confirm_ns,
+                          np.asarray(rule_ns[0], dtype=np.int64),
+                          np.asarray(rule_ns[1], dtype=np.int64))
 
     # -------------------------------------------------------- snapshot
 
@@ -247,6 +272,47 @@ class RuleStats:
             return (self.requests, self.candidates.copy(),
                     self.confirmed.copy(), self.confirm_errors.copy(),
                     self.score_sum.copy(), self.block_hits.copy())
+
+    def _snap_confirm(self):
+        """Confirm-plane columns: (confirm_ns, qr_skips, qr_evals) —
+        the quick-reject counters gather from the ConfirmRule closures
+        (plain ints; a racing confirm worker may cost an increment,
+        never a crash)."""
+        R = len(self.rule_ids)
+        with self._lock:
+            ns = self.confirm_ns.copy()
+            skips = np.zeros((R,), dtype=np.int64)
+            evals = np.zeros((R,), dtype=np.int64)
+            for i, c in enumerate(self._confirms[:R]):
+                # chain links evaluate (and quick-reject) too — their
+                # counters book against the parent rule's row
+                skips[i] = sum(r.qr_skips for r in c.walk_chain())
+                evals[i] = sum(r.qr_evals for r in c.walk_chain())
+            return ns, skips, evals
+
+    def quick_reject_summary(self) -> dict:
+        """Pack-level quick-reject coverage + hit rate: how many rx
+        rules carry mandatory literals, and what fraction of candidate
+        evaluations the literal pre-check resolved without ``re``."""
+        _ns, skips, evals = self._snap_confirm()
+        rules = [r for c in self._confirms for r in c.walk_chain()]
+        rx_rules = sum(1 for c in rules
+                       if getattr(c, "op", None) == "rx"
+                       and c.rx is not None)
+        covered = sum(1 for c in rules
+                      if getattr(c, "qr_literals", None) is not None)
+        total_skips = int(skips.sum())
+        total_evals = int(evals.sum())
+        checked = total_skips + total_evals
+        return {
+            "rx_rules": rx_rules,
+            "rules_with_literals": covered,
+            "coverage": round(covered / rx_rules, 4) if rx_rules else None,
+            "skips": total_skips,
+            "regex_evals": total_evals,
+            "skip_rate": (round(total_skips / checked, 4)
+                          if checked else None),
+        }
 
     def freeze(self) -> FrozenRuleStats:
         requests, cand, conf, _err, _sc, _bl = self._snap()
@@ -258,6 +324,7 @@ class RuleStats:
         """Per-rule records, candidates-descending (full detail is
         JSON-only by the cardinality policy); ``limit`` 0 = all."""
         _req, cand, conf, err, score, block = self._snap()
+        ns, skips, _evals = self._snap_confirm()
         order = np.argsort(-cand, kind="stable")
         if limit:
             order = order[:limit]
@@ -276,6 +343,8 @@ class RuleStats:
                     round((c - int(conf[i])) / c, 4) if c else 0.0,
                 "score_sum": int(score[i]),
                 "block_hits": int(block[i]),
+                "confirm_us": int(ns[i] // 1000),
+                "quick_rejects": int(skips[i]),
             }
             if i in self.broken_reason:
                 rec["dead_reason"] = self.broken_reason[i]
@@ -295,13 +364,16 @@ class RuleStats:
         return out
 
     def health(self, never_hit_cap: int = 50,
-               top_waste: int = 20) -> dict:
+               top_waste: int = 20, top_cost: int = 20) -> dict:
         """The /rules/health body: runtime-dead rules (confirm can never
         evaluate AND candidates reached it), latent-dead rules (broken
-        but not yet candidated), never-hit rules, and the top false-
+        but not yet candidated), never-hit rules, the top false-
         candidate rules ranked by wasted confirm evaluations (the
-        confirm-CPU cost of prefilter over-approximation)."""
+        confirm-CPU cost of prefilter over-approximation), the top
+        rules by cumulative confirm cost, and the quick-reject coverage
+        summary (docs/CONFIRM_PLANE.md)."""
         requests, cand, conf, err, _score, _block = self._snap()
+        ns, skips, _evals = self._snap_confirm()
         runtime_dead, latent_dead = [], []
         for i in np.nonzero(self.broken)[0]:
             i = int(i)
@@ -330,11 +402,30 @@ class RuleStats:
                         "wasted_confirms": int(waste[i]),
                         "false_candidate_rate":
                             round(int(waste[i]) / int(cand[i]), 4)})
+        corder = np.argsort(-ns, kind="stable")[:top_cost]
+        expensive = []
+        for i in corder:
+            i = int(i)
+            if ns[i] <= 0:
+                break
+            expensive.append({
+                "rule_id": int(self.rule_ids[i]),
+                "family": self.families[i],
+                "confirm_us": int(ns[i] // 1000),
+                "candidates": int(cand[i]),
+                "confirmed": int(conf[i]),
+                "quick_rejects": int(skips[i]),
+                "us_per_candidate":
+                    round(int(ns[i]) / 1000.0 / int(cand[i]), 2)
+                    if cand[i] else None,
+            })
         return {
             "version": self.version,
             "requests": requests,
             "runtime_dead": runtime_dead,
             "latent_dead": latent_dead,
+            "top_expensive_confirms": expensive,
+            "quick_reject": self.quick_reject_summary(),
             "never_hit": {
                 "count": int(len(never)),
                 "total_rules": int(len(self.rule_ids)),

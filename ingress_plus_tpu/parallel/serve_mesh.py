@@ -113,10 +113,20 @@ def run_lane_measurement(cr: CompiledRuleset, n_lanes: int,
         batcher.reset_latency_observations()
         # measured pass: the full admission→split→scan→confirm→verdict
         # chain, wall-clocked from first submit to last resolved future
+        ps = pipeline.stats
+        c0, e0, p0 = ps.confirm_us, ps.engine_us, ps.prep_us
         t0 = time.perf_counter()
         futs = [batcher.submit(r) for r in requests]
         verdicts = [f.result(timeout=600) for f in futs]
         wall = time.perf_counter() - t0
+        # confirm-stage share of the measured window's pipeline time
+        # (docs/CONFIRM_PLANE.md): the serialized-residue gauge the
+        # mesh-scale leg warns on — when confirm bounds mesh
+        # throughput, more chips cannot help
+        d_confirm = ps.confirm_us - c0
+        d_stages = d_confirm + (ps.engine_us - e0) + (ps.prep_us - p0)
+        confirm_share = (round(d_confirm / d_stages, 4)
+                         if d_stages > 0 else None)
         fail_open = sum(1 for v in verdicts if v.fail_open)
         attacks = sum(1 for v in verdicts if v.attack)
         lanes = batcher.lanes.snapshot()
@@ -139,6 +149,9 @@ def run_lane_measurement(cr: CompiledRuleset, n_lanes: int,
                            "dispatch_fill", "hangs", "errors", "busy_us")}
                          for ln in lanes],
             "serve_time_recompiles": pipeline.stats.engine_compiles,
+            "confirm_share": confirm_share,
+            "confirm_us": d_confirm,
+            "confirm_workers": pipeline.confirm_pool.n_workers,
             "ruleset": {"rules": int(cr.n_rules),
                         "words": int(cr.tables.n_words)},
         }

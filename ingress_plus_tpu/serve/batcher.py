@@ -102,6 +102,13 @@ class _MeshCycle:
         "max_queue_delay_us", "engine_us0", "confirm_us0", "prep_us0",
         "compiles0", "launch_d_engine", "launch_d_prep",
         "launch_d_compiles", "overlap_drain_s",
+        # confirm-overlap phase state (docs/CONFIRM_PLANE.md): shares
+        # whose scan collected and confirm launched, the verdicts
+        # already resolved during collection, and the collection
+        # window's stage deltas (folded into the trace at resolve)
+        "pending_fins", "done", "cand_verdicts",
+        "collect_d_engine", "collect_d_confirm", "collect_d_prep",
+        "collect_d_compiles",
     )
 
     def __init__(self):
@@ -228,7 +235,14 @@ class Batcher:
         # fail-open when it blows past its grace (the double-buffered
         # mesh loop keeps up to two armed at once)
         self._active_guards: List[_CycleGuard] = []
-        self._watch_grace = 2.0 * hang_budget_s + hard_deadline_s + 1.0
+        # a pooled confirm phase adds its own bounded wait to a cycle's
+        # worst-case life (join_confirm's shared deadline) — the
+        # monitor's grace must cover it or a merely-slow confirm would
+        # read as a wedged dispatcher; inline pools add nothing
+        confirm_grace = (pipeline.confirm_pool.hang_budget_s
+                         if pipeline.confirm_pool.n_workers > 1 else 0.0)
+        self._watch_grace = (2.0 * hang_budget_s + hard_deadline_s + 1.0
+                             + confirm_grace)
         self._stop = threading.Event()
         self._swap_lock = threading.Lock()
         # guarded-rollout controller (control/rollout.py), attached by
@@ -553,6 +567,12 @@ class Batcher:
         # the brownout ladder's pressure signal also spans swaps — a
         # reload under load must not reset the ladder to full detection
         new.load_controller = old.load_controller
+        # the confirm pool spans swaps too (docs/CONFIRM_PLANE.md): it
+        # is ruleset-free, and the replacement pipeline's own default
+        # (inline, thread-free) pool is simply dropped — a hot swap
+        # must not orphan N worker threads per reload
+        new.confirm_pool = old.confirm_pool
+        new.confirm_memo_entries = old.confirm_memo_entries
         # break-glass force swap during a staged rollout: the candidate
         # generation is aborted (quarantined, reason exported) BEFORE the
         # new pack installs — after the fault site and the build, so a
@@ -632,6 +652,7 @@ class Batcher:
         self._oversized_thread.join(timeout=5)
         self._watchdog.join(timeout=5)
         self.lanes.close()
+        self.pipeline.confirm_pool.close()
         # requests still queued at shutdown would strand their
         # connection handlers until the client times out — resolve them
         # fail-open, the same contract the oversized side lane had
@@ -985,10 +1006,19 @@ class Batcher:
         crunch, and only then finalizes N (bounded per-lane waits,
         confirm, verdict futures).  Under load the host prep and the
         device scan fully overlap; idle, the pending cycle finalizes
-        after at most one batch window."""
-        pending: Optional[_MeshCycle] = None
+        after at most one batch window.
+
+        With ``--confirm-workers`` > 1 the pipeline deepens one more
+        stage (docs/CONFIRM_PLANE.md): collecting cycle N launches its
+        confirm on the pool workers and the verdicts resolve one drain
+        later — so cycle N's CPU confirm overlaps cycle N+1's device
+        scan, exactly the move that overlapped host→device transfer in
+        PR 7.  The extra stage only engages while a next cycle is in
+        flight; an idle tail resolves immediately."""
+        pending: Optional[_MeshCycle] = None     # scan in flight
+        confirming: Optional[_MeshCycle] = None  # confirm in flight
         while not self._stop.is_set():
-            if pending is None:
+            if pending is None and confirming is None:
                 batch = self._drain()
                 if not batch:
                     # idle drain: decay the brownout ladder's signal
@@ -998,26 +1028,47 @@ class Batcher:
                 td0 = time.perf_counter()
                 batch = self._drain(first_timeout=self.max_delay_s)
                 # the interleaved drain wait is the double buffer's
-                # idle window, not cycle N's service time — excluded
-                # from its clock so the queue-math EWMA and the
-                # deadline-overrun accounting describe real work
+                # idle window, not the in-flight cycles' service time —
+                # excluded from their clocks so the queue-math EWMA and
+                # the deadline-overrun accounting describe real work
                 # (reviewer catch)
-                pending.overlap_drain_s += time.perf_counter() - td0
+                dt = time.perf_counter() - td0
+                if pending is not None:
+                    pending.overlap_drain_s += dt
+                if confirming is not None:
+                    confirming.overlap_drain_s += dt
             cycle = self._launch_cycle(batch) if batch else None
+            if confirming is not None:
+                # cycle N-1's confirm ran while N launched above —
+                # resolve its futures before blocking on N's lanes
+                self._resolve_cycle(confirming)
+                confirming = None
             if pending is not None:
-                self._finalize_cycle(pending)
+                self._collect_cycle(pending)
+                if cycle is not None and \
+                        self.pipeline.confirm_pool.n_workers > 1:
+                    # hold the confirm open: it crunches on the pool
+                    # workers while the freshly launched cycle's scan
+                    # crunches on the chips
+                    confirming = pending
+                else:
+                    self._resolve_cycle(pending)
             pending = cycle
-        if pending is not None:
-            # shutdown with a cycle in flight: its futures must still
-            # resolve (exactly-one-verdict outlives the loop)
+        # shutdown with cycles in flight: their futures must still
+        # resolve (exactly-one-verdict outlives the loop)
+        for c, full in ((confirming, False), (pending, True)):
+            if c is None:
+                continue
             try:
-                self._finalize_cycle(pending)
+                if full:
+                    self._collect_cycle(c)
+                self._resolve_cycle(c)
             except Exception:
-                for rid, fut in pending.guard.items:
+                for rid, fut in c.guard.items:
                     if not fut.done():
                         self.pipeline.stats.fail_open += 1
                         _safe_set(fut, _fail_open_verdict(rid))
-                self._clear_guard(pending.guard)
+                self._clear_guard(c.guard)
 
     def _launch_cycle(self, batch: List) -> "_MeshCycle":
         """Phase A of a mesh cycle: classify the drained batch, run the
@@ -1125,11 +1176,13 @@ class Batcher:
             c.launch_d_compiles = ps.engine_compiles - c.compiles0
         return c
 
-    def _finalize_cycle(self, c: "_MeshCycle") -> None:
-        """Phase B of a mesh cycle: bounded per-lane collection (wait,
-        mask, confirm, score), per-lane breaker accounting, the global
-        CPU fallback share, the canary candidate share, verdict
-        resolution, rollout hooks and the cycle's observability."""
+    def _collect_cycle(self, c: "_MeshCycle") -> None:
+        """Phase B1 of a mesh cycle: bounded per-lane SCAN collection
+        (wait, mask) + confirm LAUNCH on the pool, per-lane breaker
+        accounting, the global CPU fallback share, and the canary
+        candidate share.  Shares whose lane wedged or raised resolve
+        fail-open here; everything else's verdicts land in
+        :meth:`_resolve_cycle` once the confirm shares join."""
         done: List = []   # (submit_ts, request, verdict, lane_idx)
         p = c.pipeline
         # ONE hang budget for the whole collection: the lanes dispatched
@@ -1139,21 +1192,24 @@ class Batcher:
         # healthy lane that finished long ago returns instantly
         # regardless of what its siblings burned
         collect_deadline = time.perf_counter() + self.hang_budget_s
+        fins: List = []   # (lane, part, _FinishJob)
         with self._swap_lock:
             ps = p.stats
             e0, cf0 = ps.engine_us, ps.confirm_us
             pp0, cp0 = ps.prep_us, ps.engine_compiles
             for lane, lroute, part, job in c.lane_parts:
                 try:
-                    verdicts = p.detect_collect(
+                    fin = p.detect_collect_launch(
                         job, timeout=max(
                             collect_deadline - time.perf_counter(),
                             0.001))
-                    lane.breaker.record_success()
+                    # success is recorded in _resolve_cycle AFTER the
+                    # confirm join: recording here would reset the
+                    # breaker's consecutive-failure count every cycle
+                    # and a persistent confirm-phase error could never
+                    # trip it (review catch)
                     lane.stats.busy_us += job.busy_us
-                    for (ts, r, fut), v in zip(part, verdicts):
-                        _safe_set(fut, v)
-                        done.append((ts, r, v, lane.index))
+                    fins.append((lane, part, fin))
                 except DeviceHang:
                     # THIS chip wedged: its share fails open, its
                     # breaker trips, its zombie worker is abandoned —
@@ -1194,17 +1250,56 @@ class Batcher:
                 for (ts, r, fut), v in zip(c.cand_items, cand_verdicts):
                     _safe_set(fut, v)
                     done.append((ts, r, v, cand_lane.index))
-            d_engine = c.launch_d_engine + ps.engine_us - e0
-            d_confirm = ps.confirm_us - cf0   # confirm runs only here
-            d_prep = c.launch_d_prep + ps.prep_us - pp0
-            d_compiles = c.launch_d_compiles + ps.engine_compiles - cp0
+            c.collect_d_engine = ps.engine_us - e0
+            c.collect_d_confirm = ps.confirm_us - cf0
+            c.collect_d_prep = ps.prep_us - pp0
+            c.collect_d_compiles = ps.engine_compiles - cp0
+        c.pending_fins = fins
+        c.done = done
+        c.cand_verdicts = cand_verdicts
+
+    def _resolve_cycle(self, c: "_MeshCycle") -> None:
+        """Phase B2 of a mesh cycle: bounded-join the confirm shares,
+        resolve the remaining verdict futures, rollout hooks, and the
+        cycle's observability.  With an inline confirm pool this runs
+        back-to-back with B1 (the confirm already completed inside the
+        launch — the classic PR 7 loop); with pool workers it runs one
+        drain later, the confirm having overlapped the next cycle's
+        scan dispatch."""
+        done = c.done
+        p = c.pipeline
+        with self._swap_lock:
+            ps = p.stats
+            e0, cf0 = ps.engine_us, ps.confirm_us
+            pp0, cp0 = ps.prep_us, ps.engine_compiles
+            for lane, part, fin in c.pending_fins:
+                try:
+                    verdicts = p.detect_collect_join(fin)
+                    lane.breaker.record_success()
+                    for (ts, r, fut), v in zip(part, verdicts):
+                        _safe_set(fut, v)
+                        done.append((ts, r, v, lane.index))
+                except Exception:
+                    # a confirm-phase error is a batch-level failure of
+                    # this share, same accounting as the serial path
+                    # (the pool already degraded a wedged WORKER to
+                    # fail-open per share without raising)
+                    lane.stats.errors += 1
+                    lane.breaker.record_failure()
+                    done += self._fail_open_part(p, part, lane.index)
+            d_engine = (c.launch_d_engine + c.collect_d_engine
+                        + ps.engine_us - e0)
+            d_confirm = c.collect_d_confirm + ps.confirm_us - cf0
+            d_prep = c.launch_d_prep + c.collect_d_prep + ps.prep_us - pp0
+            d_compiles = (c.launch_d_compiles + c.collect_d_compiles
+                          + ps.engine_compiles - cp0)
         ro = c.ro
         if ro is not None:
             if ro.shadow_active:
                 for _ts, r, v, _lane in done:
                     ro.mirror(r, v)
             if c.cand_items:
-                ro.observe_canary(len(c.cand_items), cand_verdicts)
+                ro.observe_canary(len(c.cand_items), c.cand_verdicts)
             ro.tick()
         self._clear_guard(c.guard)
         t_end = time.perf_counter()

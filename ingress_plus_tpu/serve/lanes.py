@@ -90,11 +90,18 @@ class LaneWorker:
             target=self._run, daemon=True, name="%s-%d" % (name, seq))
         self._thread.start()
 
-    def _run(self) -> None:
-        # lane-targeted fault injection (utils/faults.py ``lane=``):
-        # sites fired from this thread attribute to this lane
+    def _setup(self) -> None:
+        """Thread-local attribution stamped once at worker startup —
+        lane-targeted fault injection (utils/faults.py ``lane=``): sites
+        fired from this thread attribute to this lane.  Subclasses that
+        reuse the bounded-call machinery for non-device work (the
+        confirm plane's workers, models/confirm_plane.py) override this
+        with their own attribution."""
         if self.lane_index is not None:
             faults.set_current_lane(self.lane_index)
+
+    def _run(self) -> None:
+        self._setup()
         while True:
             item = self._q.get()
             if item is None:

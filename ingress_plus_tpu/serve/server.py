@@ -549,6 +549,31 @@ class ServeLoop:
             "# TYPE ipt_confirm_us_sum counter",
             "ipt_confirm_us_sum %d" % p.confirm_us,
         ]
+        # confirm plane (docs/CONFIRM_PLANE.md): pool geometry, wedged-
+        # worker shares, flood-memo outcome counters, and the
+        # generation-scoped quick-reject totals (they reset at swap
+        # like confirm_errors — the version label makes that an honest
+        # counter reset)
+        pool = pipeline.confirm_pool
+        qr = pipeline.rule_stats.quick_reject_summary()
+        lines += [
+            "# TYPE ipt_confirm_workers gauge",
+            "ipt_confirm_workers %d" % pool.n_workers,
+            "# TYPE ipt_confirm_workers_replaced_total counter",
+            "ipt_confirm_workers_replaced_total %d" % pool.workers_replaced,
+            "# TYPE ipt_confirm_hangs_total counter",
+            "ipt_confirm_hangs_total %d" % p.confirm_hangs,
+            "# TYPE ipt_confirm_memo_hits_total counter",
+            "ipt_confirm_memo_hits_total %d" % p.confirm_memo_hits,
+            "# TYPE ipt_confirm_memo_misses_total counter",
+            "ipt_confirm_memo_misses_total %d" % p.confirm_memo_misses,
+            "# TYPE ipt_confirm_quick_reject_total counter",
+            'ipt_confirm_quick_reject_total{version="%s"} %d'
+            % (pipeline.rule_stats.version, qr["skips"]),
+            "# TYPE ipt_confirm_regex_evals_total counter",
+            'ipt_confirm_regex_evals_total{version="%s"} %d'
+            % (pipeline.rule_stats.version, qr["regex_evals"]),
+        ]
         if self.post is not None:
             lines += [
                 "# TYPE ipt_post_queue_depth gauge",
@@ -656,6 +681,13 @@ class ServeLoop:
                     # per-device lane plane (docs/MESH_SERVING.md);
                     # `dbg breaker` renders the lane table from here
                     "lanes": self.batcher.lanes.snapshot(),
+                    # parallel confirm plane (docs/CONFIRM_PLANE.md):
+                    # pool geometry + wedged-worker accounting
+                    "confirm_plane": {
+                        **pipeline.confirm_pool.snapshot(),
+                        "hangs": pipeline.stats.confirm_hangs,
+                        "memo_entries": pipeline.confirm_memo_entries,
+                    },
                 },
             }).encode()
         if path.startswith("/readyz"):
@@ -1152,7 +1184,8 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
                           rollout_steps=None,
                           rollout_fail_on: str = "error",
                           n_lanes: int = 1,
-                          scoring_head_path: Optional[str] = None) -> Batcher:
+                          scoring_head_path: Optional[str] = None,
+                          confirm_workers: int = 1) -> Batcher:
     from ingress_plus_tpu.compiler.ruleset import compile_ruleset
     from ingress_plus_tpu.compiler.seclang import load_seclang_dir
     from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
@@ -1204,7 +1237,14 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
         n_lanes = max(1, len(jax.devices()))
         print("lane serving: auto -> %d per-device lanes" % n_lanes,
               file=sys.stderr)
-    pipeline = DetectionPipeline(cr, mode=mode, engine=engine)
+    if confirm_workers == 0:   # --confirm-workers auto: one per host core
+        import os as _os
+
+        confirm_workers = max(1, min(8, _os.cpu_count() or 1))
+        print("confirm plane: auto -> %d confirm workers"
+              % confirm_workers, file=sys.stderr)
+    pipeline = DetectionPipeline(cr, mode=mode, engine=engine,
+                                 confirm_workers=confirm_workers)
     if mesh_spec:
         if scan_impl == "pallas":
             # the byte kernel has no sharded variant; the class-pair
@@ -1320,16 +1360,27 @@ def warmup_pipeline(pipeline, max_batch: int) -> None:
           file=sys.stderr)
 
 
-def _parse_lanes(value: str) -> int:
-    """--lanes: 'auto' → the internal 0 sentinel (one lane per local
-    device); integers must be >= 1 — an explicit 0 must not silently
-    collide with the sentinel and fan out per-device."""
+def _parse_auto_count(value: str, flag: str) -> int:
+    """Shared N|'auto' flag parser (--lanes, --confirm-workers):
+    'auto' → the internal 0 sentinel (resolved per flag: one lane per
+    local device / one confirm worker per host core); integers must be
+    >= 1 — an explicit 0 must not silently collide with the sentinel
+    and fan out."""
     if value == "auto":
         return 0
     n = int(value)
     if n < 1:
-        raise SystemExit("--lanes must be >= 1 or 'auto', got %r" % value)
+        raise SystemExit("%s must be >= 1 or 'auto', got %r"
+                         % (flag, value))
     return n
+
+
+def _parse_confirm_workers(value: str) -> int:
+    return _parse_auto_count(value, "--confirm-workers")
+
+
+def _parse_lanes(value: str) -> int:
+    return _parse_auto_count(value, "--lanes")
 
 
 def main(argv=None) -> None:
@@ -1359,6 +1410,15 @@ def main(argv=None) -> None:
                          "watchdog + circuit breaker; a sick chip "
                          "degrades capacity, not the service.  "
                          "Mutually exclusive with --mesh")
+    ap.add_argument("--confirm-workers", default="1",
+                    help="parallel confirm plane (docs/CONFIRM_PLANE.md)"
+                         ": worker threads the CPU confirm stage shards "
+                         "each cycle's requests across — an integer, or "
+                         "'auto' = one per host core (capped at 8).  1 "
+                         "(default) runs the classic serial confirm "
+                         "inline.  A wedged worker fails only its "
+                         "request share open; with the mesh loop, "
+                         "confirm overlaps the next cycle's scan")
     ap.add_argument("--scan-impl", default="auto",
                     choices=["auto", "pair", "take", "pallas", "pallas2"],
                     help="TPU scan implementation; auto = startup "
@@ -1464,7 +1524,8 @@ def main(argv=None) -> None:
                        args.rollout_steps.split(",") if s.strip()],
         rollout_fail_on=args.rollout_fail_on,
         n_lanes=_parse_lanes(args.lanes),
-        scoring_head_path=args.scoring_head)
+        scoring_head_path=args.scoring_head,
+        confirm_workers=_parse_confirm_workers(args.confirm_workers))
 
     post = None
     if args.spool_dir or args.export_url:

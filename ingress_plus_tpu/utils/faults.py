@@ -40,7 +40,12 @@ site                      injected where / what it does when it fires
                           spool bounding
 ``slow_confirm``          pipeline confirm stage sleeps ``delay_s`` per batch
                           (pathological regex / CPU contention) — exercises
-                          deadline shedding and the brownout ladder
+                          deadline shedding and the brownout ladder.  Fires
+                          inside the confirm plane's share execution
+                          (models/confirm_plane.py), so ``worker=K`` targets
+                          ONE confirm worker of a multi-worker pool — a
+                          wedged worker must fail only its request share
+                          open (docs/CONFIRM_PLANE.md)
 ========================  ====================================================
 
 A plan is a set of per-site rules ``site:after=N,times=M,delay_s=X,
@@ -92,7 +97,11 @@ class FaultRule:
     other lanes' dispatch threads neither count nor fire, so a plan
     like ``dispatch_hang:lane=1,times=1`` wedges exactly one chip
     while its siblings keep serving (the lane-isolation fault
-    matrix)."""
+    matrix); ``worker``: the confirm-plane twin of ``lane``
+    (docs/CONFIRM_PLANE.md) — restricts the site to ONE confirm
+    worker's share execution, so ``slow_confirm:worker=1,times=1``
+    wedges exactly one confirm worker while its pool siblings keep
+    confirming."""
 
     site: str
     after: int = 0
@@ -100,6 +109,7 @@ class FaultRule:
     delay_s: float = 1.0
     prob: float = 1.0
     lane: Optional[int] = None
+    worker: Optional[int] = None
 
     @classmethod
     def parse(cls, text: str) -> "FaultRule":
@@ -112,7 +122,8 @@ class FaultRule:
         for part in filter(None, (p.strip() for p in argstr.split(","))):
             k, _, v = part.partition("=")
             k = k.strip()
-            if k not in ("after", "times", "delay_s", "prob", "lane"):
+            if k not in ("after", "times", "delay_s", "prob", "lane",
+                         "worker"):
                 raise ValueError("unknown fault arg %r in %r" % (k, text))
             kw[k] = float(v)
         return cls(site=site,
@@ -120,7 +131,8 @@ class FaultRule:
                    times=int(kw["times"]) if "times" in kw else None,
                    delay_s=float(kw.get("delay_s", 1.0)),
                    prob=float(kw.get("prob", 1.0)),
-                   lane=int(kw["lane"]) if "lane" in kw else None)
+                   lane=int(kw["lane"]) if "lane" in kw else None,
+                   worker=int(kw["worker"]) if "worker" in kw else None)
 
 
 class FaultPlan:
@@ -157,6 +169,12 @@ class FaultPlan:
             # it neither counts toward ``after`` nor consumes ``times``
             # (per-lane arrival order is deterministic, so replays hold)
             return None
+        if rule.worker is not None \
+                and rule.worker != current_confirm_worker():
+            # confirm-worker-targeted rule: same invisibility contract
+            # as lane targeting, keyed on the confirm plane's
+            # thread-local worker id (models/confirm_plane.py)
+            return None
         with self._lock:
             n = self.arrivals[site]
             self.arrivals[site] = n + 1
@@ -176,7 +194,7 @@ class FaultPlan:
                 "rules": [
                     {"site": r.site, "after": r.after, "times": r.times,
                      "delay_s": r.delay_s, "prob": r.prob,
-                     "lane": r.lane,
+                     "lane": r.lane, "worker": r.worker,
                      "arrivals": self.arrivals[r.site],
                      "fired": self.fired[r.site]}
                     for r in self.rules.values()
@@ -204,6 +222,18 @@ def set_current_lane(index: Optional[int]) -> None:
 
 def current_lane() -> Optional[int]:
     return getattr(_lane_local, "lane", None)
+
+
+# thread-local confirm-worker attribution (models/confirm_plane.py):
+# each confirm POOL worker thread stamps its index at startup, and the
+# inline (single-worker) pool stamps 0 around its share execution — so
+# ``worker=``-targeted rules see the same ids either way.
+def set_current_confirm_worker(index: Optional[int]) -> None:
+    _lane_local.confirm_worker = index
+
+
+def current_confirm_worker() -> Optional[int]:
+    return getattr(_lane_local, "confirm_worker", None)
 
 
 def install(plan: Optional[FaultPlan]) -> None:
@@ -282,12 +312,15 @@ def _matrix_ruleset():
     return compile_ruleset(parse_seclang(_MATRIX_RULES))
 
 
-def _mk_batcher(cr=None, **kw):
+def _mk_batcher(cr=None, confirm_workers: int = 1,
+                confirm_hang_budget_s: float = 30.0, **kw):
     from ingress_plus_tpu.models.pipeline import DetectionPipeline
     from ingress_plus_tpu.serve.batcher import Batcher
 
     pipeline = DetectionPipeline(cr if cr is not None else _matrix_ruleset(),
-                                 mode="block")
+                                 mode="block",
+                                 confirm_workers=confirm_workers,
+                                 confirm_hang_budget_s=confirm_hang_budget_s)
     kw.setdefault("max_batch", 16)
     kw.setdefault("max_delay_s", 0.001)
     b = Batcher(pipeline, **kw)
@@ -569,6 +602,64 @@ def _scenario_slow_confirm(install_plan) -> dict:
         _check_verdicts(verdicts, violations, 32)
         return {"ok": not violations, "violations": violations,
                 "verdicts": len(verdicts)}
+    finally:
+        b.close()
+
+
+def _scenario_confirm_worker_hang(install_plan) -> dict:
+    """slow_confirm targeted at confirm worker 1 of a 2-worker pool
+    (docs/CONFIRM_PLANE.md): the wedged worker's request share fails
+    open within the confirm hang budget, its pool sibling's verdicts
+    are untouched (real detection continues in the same cycle), the
+    device breaker never trips (a CPU confirm wedge is not a chip
+    fault), and the pool recovers by replacing the worker — the next
+    wave serves clean verdicts end to end."""
+    b = _mk_batcher(confirm_workers=2, confirm_hang_budget_s=0.5)
+    install_plan(FaultPlan.from_spec(
+        "slow_confirm:worker=1,times=1,delay_s=8.0"))
+    try:
+        violations: List[str] = []
+        # attack_every=3: attack positions land on BOTH round-robin
+        # share parities whatever the cycle offset — every-4 could put
+        # every attack in the wedged worker's share (observed flake
+        # shape in the lane scenarios)
+        futs = [b.submit(r) for r in _requests(16, attack_every=3,
+                                               tag="cw")]
+        verdicts, viol = _collect(futs, timeout_s=60)
+        _check_verdicts(verdicts, viol, 16)
+        violations += viol
+        if not any(v.fail_open for v in verdicts):
+            violations.append("wedged confirm worker's share did not "
+                              "fail open")
+        if not any(v.attack and not v.fail_open for v in verdicts):
+            violations.append("sibling confirm worker served no real "
+                              "verdicts during the wedge")
+        if all(v.fail_open for v in verdicts):
+            violations.append("the whole cycle failed open — the wedge "
+                              "was not isolated to one worker's share")
+        if b.breaker.trips:
+            violations.append("device breaker tripped on a CPU confirm "
+                              "wedge")
+        if b.pipeline.stats.confirm_hangs < 1:
+            violations.append("confirm_hangs counter never moved")
+        pool = b.pipeline.confirm_pool
+        if pool.workers_replaced < 1:
+            violations.append("wedged confirm worker was never replaced")
+        # recovery: fault exhausted, the replaced worker serves clean
+        futs = [b.submit(r) for r in _requests(16, attack_every=3,
+                                               tag="cwr")]
+        verdicts, viol = _collect(futs, timeout_s=60)
+        _check_verdicts(verdicts, viol, 16)
+        violations += viol
+        if any(v.fail_open for v in verdicts):
+            violations.append("pool did not recover: post-fault wave "
+                              "still failing open")
+        if not any(v.attack for v in verdicts):
+            violations.append("detection lost after confirm-worker "
+                              "recovery")
+        return {"ok": not violations, "violations": violations,
+                "confirm_hangs": b.pipeline.stats.confirm_hangs,
+                "workers_replaced": pool.workers_replaced}
     finally:
         b.close()
 
@@ -860,6 +951,7 @@ SCENARIOS = {
     "swap_fail": _scenario_swap_fail,
     "export_5xx": _scenario_export_5xx,
     "slow_confirm": _scenario_slow_confirm,
+    "confirm_worker_hang": _scenario_confirm_worker_hang,
     "rollout_promote_fail": _scenario_rollout_promote_fail,
     "rollout_shadow_diverge": _scenario_rollout_shadow_diverge,
     "lkg_corrupt": _scenario_lkg_corrupt,

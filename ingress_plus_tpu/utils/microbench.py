@@ -154,6 +154,89 @@ def bench_pallas(tables: ScanTables, batch: int, length: int,
     return batch * length / per_scan / 1e6
 
 
+def bench_confirm(n_req: int = 1024, iters: int = 5,
+                  flood_dup: int = 4) -> dict:
+    """Confirm-stage microbench (docs/CONFIRM_PLANE.md): full CPU
+    ``pipeline.detect`` over the deterministic corpus with the
+    quick-reject literals and the flood memo toggled independently, so
+    the work-reduction win is reproducible in isolation from the serve
+    plane.  Two corpora: the standard mixed corpus (quick-reject's
+    home turf — unique requests, candidate-but-no-hit walks) and a
+    flood corpus (each request repeated ``flood_dup`` times, shuffled —
+    the replayed-flood shape the per-cycle memo exists for).  One
+    pipeline serves every config — toggling attributes instead of
+    rebuilding keeps the XLA executables warm, so config deltas are
+    confirm-stage deltas."""
+    import random
+
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.utils.corpus import generate_corpus
+
+    cr = compile_ruleset(load_bundled_rules())
+    corpus = generate_corpus(n=n_req, attack_fraction=0.2, seed=42)
+    reqs = [lr.request for lr in corpus]
+    flood = [lr.request for lr in corpus[:max(1, n_req // flood_dup)]
+             ] * flood_dup
+    random.Random(7).shuffle(flood)
+
+    pipe = DetectionPipeline(cr, mode="block")
+    # chain links quick-reject too — the toggle must strip them as
+    # well or the "off" baseline under-reports the qr win
+    rules = [r for c in pipe.confirms for r in c.walk_chain()]
+    saved = [(c.qr_literals, c._qr_rule_ok) for c in rules]
+
+    def set_qr(on: bool) -> None:
+        for c, (lits, ok) in zip(rules, saved):
+            c.qr_literals = lits if on else None
+            c._qr_rule_ok = ok if on else False
+
+    # warm every compile tier + the cross-request transform memo once;
+    # later configs all start from the same warm state
+    pipe.detect(reqs[:256])
+    pipe.detect(reqs)
+    pipe.detect(flood)
+
+    out: dict = {"n_req": n_req, "iters": iters, "flood_dup": flood_dup}
+    for corpus_tag, batch in (("mixed", reqs), ("flood", flood)):
+        base_rps = None
+        for tag, qr, memo in (("off", False, False),
+                              ("qr", True, False),
+                              ("memo", False, True),
+                              ("qr+memo", True, True)):
+            set_qr(qr)
+            pipe.confirm_memo_entries = 4096 if memo else 0
+            best, conf_us, memo_hits = float("inf"), 0, 0
+            for _ in range(iters):
+                c0 = pipe.stats.confirm_us
+                m0 = pipe.stats.confirm_memo_hits
+                t0 = time.perf_counter()
+                pipe.detect(batch)
+                dt = time.perf_counter() - t0
+                if dt < best:
+                    best = dt
+                    conf_us = pipe.stats.confirm_us - c0
+                    memo_hits = pipe.stats.confirm_memo_hits - m0
+            rps = len(batch) / best
+            if tag == "off":
+                base_rps = rps
+            rec = {"req_per_s": round(rps, 1),
+                   "confirm_ms": round(conf_us / 1e3, 1),
+                   "memo_hits": memo_hits,
+                   "speedup_vs_off": round(rps / base_rps, 3)}
+            out["%s/%s" % (corpus_tag, tag)] = rec
+            print("corpus=%-5s config=%-8s %8.1f req/s  confirm=%7.1f ms"
+                  "  memo_hits=%-6d speedup=%.3fx"
+                  % (corpus_tag, tag, rps, rec["confirm_ms"], memo_hits,
+                     rec["speedup_vs_off"]))
+    set_qr(True)
+    qr_summary = pipe.rule_stats.quick_reject_summary()
+    out["quick_reject"] = qr_summary
+    print("quick-reject coverage: %s/%s rx rules, skip_rate=%s"
+          % (qr_summary["rules_with_literals"], qr_summary["rx_rules"],
+             qr_summary["skip_rate"]))
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=256)
@@ -167,12 +250,25 @@ def main() -> None:
                     help="force CPU in-process (JAX_PLATFORMS env alone "
                          "does not work on this machine — see "
                          "utils/platform.py)")
+    ap.add_argument("--confirm", action="store_true",
+                    help="confirm-stage microbench instead of the scan "
+                         "sweep: quick-reject / flood-memo toggles over "
+                         "full pipeline.detect (docs/CONFIRM_PLANE.md); "
+                         "always CPU")
+    ap.add_argument("--reqs", type=int, default=1024,
+                    help="corpus size for --confirm")
     args = ap.parse_args()
 
-    if args.platform == "cpu":
+    if args.platform == "cpu" or args.confirm:
         from ingress_plus_tpu.utils.platform import force_cpu_devices
 
         force_cpu_devices(1)
+
+    if args.confirm:
+        # --iters defaults are tuned for the K-chained scan; a confirm
+        # pass is a full corpus detect, so clamp to a sane wall budget
+        bench_confirm(n_req=args.reqs, iters=max(2, min(args.iters, 5)))
+        return
 
     cr = compile_ruleset(load_bundled_rules())
     tables = ScanTables.from_bitap(cr.tables)

@@ -1,0 +1,424 @@
+"""Parallel confirm plane (models/confirm_plane.py, docs/CONFIRM_PLANE.md).
+
+Covers the ISSUE 9 acceptance criteria: N confirm workers produce
+byte-identical verdicts to the serial walk over a shuffled corpus
+(runtime-ctl-exclusion requests, streams, and the oversized side lane
+included), the mandatory-literal quick-reject and the per-cycle flood
+memo are differentially fuzzed to never change a confirm outcome, the
+memo's size bound holds under adversarial cardinality, and a wedged
+confirm worker fails only its own request share open (the CI fault
+matrix carries the full scenario; here the pool units).
+"""
+
+import random
+import re
+import string
+import time
+
+import pytest
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.models.confirm import (
+    ConfirmRule,
+    apply_transforms,
+    derive_quick_reject,
+    transform_cached,
+)
+from ingress_plus_tpu.models import confirm as confirm_mod
+from ingress_plus_tpu.models.confirm_plane import (
+    ConfirmMemo,
+    ConfirmPool,
+    streams_digest,
+)
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.batcher import Batcher
+from ingress_plus_tpu.serve.normalize import Request
+from ingress_plus_tpu.utils import faults
+from ingress_plus_tpu.utils.faults import FaultPlan
+
+RULES = """
+SecRule ARGS|REQUEST_BODY "@rx (?i)union\\s+select" "id:942100,phase:2,block,t:urlDecodeUni,t:lowercase,severity:CRITICAL,tag:'attack-sqli'"
+SecRule ARGS|REQUEST_BODY "@rx (?i)<script[^>]*>" "id:941100,phase:2,block,t:urlDecodeUni,t:htmlEntityDecode,severity:CRITICAL,tag:'attack-xss'"
+SecRule REQUEST_URI|ARGS "@rx /etc/(?:passwd|shadow)" "id:930120,phase:2,block,severity:CRITICAL,tag:'attack-lfi'"
+SecRule ARGS "@pm sleep( benchmark( xp_cmdshell" "id:942150,phase:2,block,severity:ERROR,tag:'attack-sqli'"
+SecRule REQUEST_URI "@beginsWith /internal/" \\
+    "id:10001,phase:1,pass,nolog,ctl:ruleRemoveById=942100"
+SecRule REQUEST_URI "@beginsWith /profile" \\
+    "id:10002,phase:1,pass,nolog,ctl:ruleRemoveTargetById=942100;ARGS:bio"
+SecRule REQUEST_URI "@streq /healthz" \\
+    "id:10003,phase:1,pass,nolog,ctl:ruleEngine=Off"
+"""
+
+
+@pytest.fixture(scope="module")
+def cr():
+    return compile_ruleset(parse_seclang(RULES))
+
+
+def _corpus(n=64, seed=17):
+    """Shuffled mixed corpus: attacks, benign traffic, runtime-ctl
+    requests (removed-rule, removed-target, engine-off paths), and
+    near-duplicate flood segments that exercise the per-cycle memo."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        kind = i % 8
+        if kind == 0:
+            r = Request(uri="/p?q=1%27%20UNION%20SELECT%20x%20FROM%20t",
+                        headers={}, body=b"", request_id="atk-sqli-%d" % i)
+        elif kind == 1:
+            r = Request(uri="/x?v=<script>alert(1)</script>", headers={},
+                        body=b"", request_id="atk-xss-%d" % i)
+        elif kind == 2:
+            # runtime ctl: 942100 removed on /internal/ — the SQLi
+            # payload must pass there and only there
+            r = Request(uri="/internal/p?q=1 union select x",
+                        headers={}, body=b"", request_id="ctl-rm-%d" % i)
+        elif kind == 3:
+            # runtime ctl: ARGS:bio excluded from 942100 on /profile
+            r = Request(uri="/profile?bio=union select creds",
+                        headers={}, body=b"", request_id="ctl-tgt-%d" % i)
+        elif kind == 4:
+            r = Request(uri="/healthz", headers={}, body=b"",
+                        request_id="ctl-off-%d" % i)
+        elif kind == 5:
+            # flood shape: identical streams across many request ids —
+            # the memo's second-occurrence gate engages on these
+            r = Request(uri="/flood?q=1 union select pw from users",
+                        headers={}, body=b"", request_id="flood-%d" % i)
+        else:
+            r = Request(uri="/index.html?page=%d" % i,
+                        headers={"content-type":
+                                 "application/x-www-form-urlencoded"},
+                        body=b"user=a&pass=" + bytes(
+                            rng.randrange(97, 123) for _ in
+                            range(rng.randrange(4, 80))),
+                        request_id="benign-%d" % i)
+        out.append(r)
+    rng.shuffle(out)
+    return out
+
+
+def _vt(v):
+    return (v.attack, v.blocked, tuple(v.rule_ids), v.score,
+            tuple(v.classes), v.fail_open, v.degraded,
+            tuple((m["rule_id"], m["var"], m["value"])
+                  for m in v.matches))
+
+
+def _serve_all(batcher, requests, timeout=60):
+    futs = [batcher.submit(r) for r in requests]
+    return {r.request_id: f.result(timeout=timeout)
+            for r, f in zip(requests, futs)}
+
+
+def _mk(cr, workers, memo=4096, **kw):
+    kw.setdefault("max_batch", 16)
+    kw.setdefault("max_delay_s", 0.001)
+    p = DetectionPipeline(cr, mode="block", confirm_workers=workers,
+                          confirm_memo_entries=memo)
+    return Batcher(p, **kw)
+
+
+# ----------------------------------------------------------- parity
+
+def test_nworker_verdict_parity_with_serial(cr):
+    """The tentpole property: N confirm workers + quick-reject + memo
+    produce byte-identical verdicts (matches included) to the serial
+    pre-pool walk — over a shuffled corpus with runtime-ctl requests
+    and an oversized side-lane request."""
+    reqs = _corpus(64)
+    big = (b"x=" + b"A" * (Batcher.OVERSIZE_THRESHOLD + 512)
+           + b"&q=1 union select passwords")
+    reqs.append(Request(uri="/upload", headers={}, body=big,
+                        request_id="atk-oversized"))
+
+    # serial reference: one worker, memo and quick-reject DISABLED —
+    # the pre-PR confirm path, literal for literal
+    b1 = _mk(cr, workers=1, memo=0)
+    for c in b1.pipeline.confirms:
+        c.qr_literals = None
+        c._qr_rule_ok = False
+    try:
+        want = {rid: _vt(v) for rid, v in _serve_all(b1, reqs).items()}
+    finally:
+        b1.close()
+    # the corpus genuinely exercises every lane of the fold
+    assert any(w[0] for w in want.values())
+    assert not all(w[0] for w in want.values())
+    assert want["atk-oversized"][0]
+    assert any(rid.startswith("ctl-rm") and not w[0]
+               for rid, w in want.items())
+
+    shuffled = list(reqs)
+    random.Random(3).shuffle(shuffled)
+    b3 = _mk(cr, workers=3)
+    try:
+        got = {rid: _vt(v) for rid, v in _serve_all(b3, shuffled).items()}
+        assert not b3.pipeline.confirm_pool.inline
+    finally:
+        b3.close()
+    assert got == want
+
+
+def test_detect_parity_memo_and_quick_reject(cr):
+    """Library-level differential: pipeline.detect with quick-reject +
+    memo enabled vs both disabled, byte-identical verdicts over a
+    corpus heavy in duplicate (flood) segments."""
+    reqs = _corpus(96, seed=23)
+    ref = DetectionPipeline(cr, mode="block", confirm_memo_entries=0)
+    for c in ref.confirms:
+        c.qr_literals = None
+        c._qr_rule_ok = False
+    want = [_vt(v) for v in ref.detect(reqs)]
+
+    opt = DetectionPipeline(cr, mode="block", confirm_memo_entries=4096)
+    got = [_vt(v) for v in opt.detect(reqs)]
+    assert got == want
+    # the flood duplicates actually drove the memo
+    assert opt.stats.confirm_memo_hits > 0
+
+
+# ------------------------------------------------- differential fuzz
+
+def _rand_pattern(rng):
+    """Random regex from a CRS-shaped grammar: literal keywords,
+    alternations, classes, quantifiers — biased toward shapes that
+    yield mandatory literals but including ones that must abstain."""
+    words = ["select", "union", "script", "passwd", "../", "eval(",
+             "<!--", "sleep", "0x", "etc"]
+    parts = []
+    for _ in range(rng.randrange(1, 4)):
+        kind = rng.randrange(5)
+        if kind == 0:
+            parts.append(re.escape(rng.choice(words)))
+        elif kind == 1:
+            parts.append("(?:%s|%s)" % (re.escape(rng.choice(words)),
+                                        re.escape(rng.choice(words))))
+        elif kind == 2:
+            parts.append("[a-z0-9]%s" % rng.choice(["*", "+", "?"]))
+        elif kind == 3:
+            parts.append("\\s%s" % rng.choice(["*", "+"]))
+        else:
+            parts.append(re.escape(rng.choice(string.punctuation)))
+    return "".join(parts)
+
+
+def _rand_text(rng, words):
+    chunks = []
+    for _ in range(rng.randrange(1, 6)):
+        if rng.random() < 0.5:
+            chunks.append(rng.choice(words))
+        chunks.append("".join(rng.choice(
+            string.ascii_letters + string.digits + " /<>%&=.-")
+            for _ in range(rng.randrange(0, 12))))
+    return "".join(chunks)
+
+
+def test_quick_reject_literal_soundness_fuzz():
+    """The load-bearing property of derive_quick_reject: for ANY text
+    the pattern matches, at least one derived literal occurs in the
+    lowercased text.  500 random patterns x 40 random texts — a
+    counterexample means the quick-reject would veto a true match."""
+    rng = random.Random(99)
+    words = ["select", "union", "script", "passwd", "../", "eval(",
+             "<!--", "sleep", "0x", "etc", "SELECT", "UniOn"]
+    checked = 0
+    for _ in range(500):
+        pat = _rand_pattern(rng)
+        fold = rng.random() < 0.5
+        try:
+            rx = re.compile(pat.encode(),
+                            re.IGNORECASE if fold else 0)
+        except re.error:
+            continue
+        lits = derive_quick_reject(pat, fold)
+        if lits is None:
+            continue   # abstained: no claim to verify
+        for _ in range(40):
+            text = _rand_text(rng, words).encode()
+            if rx.search(text) is not None:
+                low = text.lower()
+                assert any(lit in low for lit in lits), \
+                    (pat, fold, lits, text)
+                checked += 1
+    assert checked > 50   # the fuzz actually exercised the property
+
+
+def test_quick_reject_never_changes_rule_outcome_fuzz():
+    """Differential fuzz at the ConfirmRule level: matches_streams with
+    the derived literals active vs stripped must agree on every
+    (rule, streams) pair — including transform chains, negation being
+    ineligible by construction (_qr_rule_ok)."""
+    rng = random.Random(7)
+    words = ["union select", "<script>", "/etc/passwd", "benign text",
+             "UNION%20SELECT", "../..", "eval(x)"]
+    pats = [("(?i)union\\s+select", ["lowercase"]),
+            ("<script[^>]*>", ["urlDecodeUni"]),
+            ("/etc/(?:passwd|shadow)", []),
+            ("(?:eval|assert)\\(", ["urlDecodeUni", "lowercase"])]
+    for pat, transforms in pats:
+        spec = {"op": "rx", "arg": pat, "fold": True,
+                "targets": ["args"], "transforms": transforms}
+        on = ConfirmRule(spec)
+        off = ConfirmRule(spec)
+        off.qr_literals = None
+        off._qr_rule_ok = False
+        if on.qr_literals is None:
+            continue
+        for _ in range(300):
+            streams = {"args": _rand_text(rng, words).encode()}
+            assert on.matches_streams(streams, {}) == \
+                off.matches_streams(streams, {}), (pat, streams)
+
+
+def test_memo_differential_on_identical_streams(cr):
+    """The memo's purity claim, directly: a flood of identical segments
+    through one detect cycle yields per-request outcomes identical to
+    the memo-free walk — confirmed rules, scores, AND detail points
+    (the memoized path re-derives detail for every request)."""
+    reqs = [Request(uri="/f?q=1 union select pw", headers={}, body=b"",
+                    request_id="f-%d" % i) for i in range(24)]
+    ref = DetectionPipeline(cr, mode="block", confirm_memo_entries=0)
+    want = [_vt(v) for v in ref.detect(reqs)]
+    assert all(w[0] for w in want)   # the flood payload really hits
+    memo = DetectionPipeline(cr, mode="block", confirm_memo_entries=256)
+    got = [_vt(v) for v in memo.detect(reqs)]
+    assert got == want
+    # N identical requests: 2 walks (see-gate + first memoized), the
+    # rest served from the memo
+    assert memo.stats.confirm_memo_hits > 0
+
+
+# ----------------------------------------------------- memo mechanics
+
+def test_memo_eviction_bound():
+    """The memo refuses inserts at capacity instead of evicting — high-
+    cardinality traffic cannot grow it past cap, and suppressed inserts
+    are counted (the bound is observable, never silent)."""
+    m = ConfirmMemo(cap=8)
+    for i in range(50):
+        m.put((i, b"d%d" % i), (False, ()))
+    assert len(m) == 8
+    assert m.misses == 8
+    assert m.suppressed == 42
+    # the seen-set honors the same cap
+    for i in range(50):
+        m.see(b"digest-%d" % i)
+    assert len(m._seen) <= 8
+    # over-cap digests still answer consistently (False = not seen)
+    assert m.see(b"digest-49") is False
+
+
+def test_streams_digest_framing():
+    """Key/value framing is unambiguous: moving a byte across the
+    key/value boundary or reordering keys must not collide."""
+    a = streams_digest({"ab": b"c", "x": b"y"})
+    b = streams_digest({"a": b"bc", "x": b"y"})
+    c = streams_digest({"x": b"y", "ab": b"c"})
+    assert a != b
+    assert a == c   # dict order is irrelevant, key order is canonical
+
+
+def test_transform_memo_parity_and_bound():
+    """The cross-request transform memo returns exactly
+    apply_transforms for every (chain, text), stays bounded (clears at
+    cap), and never caches long texts."""
+    rng = random.Random(5)
+    chains = [["lowercase"], ["urlDecodeUni", "lowercase"],
+              ["htmlEntityDecode"], []]
+    for _ in range(400):
+        tf = rng.choice(chains)
+        text = bytes(rng.randrange(32, 127)
+                     for _ in range(rng.randrange(0, 64)))
+        assert transform_cached(tuple(tf), tf, text) == \
+            apply_transforms(text, tf)
+    long = b"A%41" * 300   # > _TF_MEMO_MAXLEN
+    assert transform_cached(("urlDecodeUni",), ["urlDecodeUni"],
+                            long) == apply_transforms(
+                                long, ["urlDecodeUni"])
+    assert (("urlDecodeUni",), long) not in confirm_mod._TF_MEMO
+    assert len(confirm_mod._TF_MEMO) <= confirm_mod._TF_MEMO_CAP
+
+
+# ------------------------------------------------------ pool / faults
+
+def test_pool_inline_vs_workers_lifecycle():
+    pool = ConfirmPool(n_workers=1)
+    assert pool.inline
+    assert pool.snapshot()["workers"] == 1
+    pool.close()   # no threads to close
+
+    pool = ConfirmPool(n_workers=3, hang_budget_s=1.0)
+    try:
+        assert not pool.inline
+        got = [pool.submit(i, lambda i=i: i * 10).wait(5.0)
+               for i in range(3)]
+        assert got == [0, 10, 20]
+        pool.replace(1)
+        assert pool.workers_replaced == 1
+        assert pool.submit(1, lambda: "fresh").wait(5.0) == "fresh"
+    finally:
+        pool.close()
+
+
+def test_fault_plan_confirm_worker_targeting():
+    """worker= rules fire only on the targeted confirm worker's thread
+    and are invisible (neither count nor consume) elsewhere — the lane-
+    targeting contract, keyed on the confirm plane's thread-local."""
+    plan = FaultPlan.from_spec("slow_confirm:worker=1,times=2")
+    try:
+        faults.set_current_confirm_worker(0)
+        assert plan.fire("slow_confirm") is None
+        faults.set_current_confirm_worker(1)
+        assert plan.fire("slow_confirm") is not None
+        assert plan.fire("slow_confirm") is not None
+        assert plan.fire("slow_confirm") is None   # times exhausted
+        snap = plan.snapshot()
+        assert snap["rules"][0]["worker"] == 1
+        assert snap["rules"][0]["fired"] == 2
+    finally:
+        faults.set_current_confirm_worker(None)
+
+
+def test_wedged_worker_fails_only_its_share_open(cr):
+    """A slow_confirm wedge pinned to worker 1 of 2: its share fails
+    open within the pool hang budget, sibling verdicts stay exact, the
+    worker is replaced, and the next batch is clean — the library-level
+    twin of the CI fault-matrix scenario."""
+    p = DetectionPipeline(cr, mode="block", confirm_workers=2,
+                          confirm_hang_budget_s=0.5)
+    reqs = _corpus(16, seed=31)
+    want = {r.request_id: _vt(v)
+            for r, v in zip(reqs, p.detect(reqs))}
+    faults.install(FaultPlan.from_spec(
+        "slow_confirm:worker=1,times=1,delay_s=8.0"))
+    try:
+        t0 = time.perf_counter()
+        got = {r.request_id: v for r, v in zip(reqs, p.detect(reqs))}
+        assert time.perf_counter() - t0 < 5.0   # bounded by the budget
+    finally:
+        faults.install(None)
+    open_share = {rid for rid, v in got.items() if v.fail_open}
+    assert open_share and len(open_share) < len(reqs)
+    for rid, v in got.items():
+        if rid not in open_share:
+            assert _vt(v) == want[rid]   # siblings' verdicts exact
+    assert p.stats.confirm_hangs == 1
+    assert p.confirm_pool.workers_replaced == 1
+    # recovery: the replaced worker serves the next batch clean
+    got2 = {r.request_id: _vt(v) for r, v in zip(reqs, p.detect(reqs))}
+    assert got2 == want
+    p.confirm_pool.close()
+
+
+def test_confirm_workers_cli_parsing():
+    from ingress_plus_tpu.serve.server import _parse_confirm_workers
+
+    assert _parse_confirm_workers("auto") == 0
+    assert _parse_confirm_workers("4") == 4
+    with pytest.raises(SystemExit):
+        _parse_confirm_workers("0")
+    with pytest.raises(SystemExit):
+        _parse_confirm_workers("-2")

@@ -43,6 +43,8 @@ def stack(tmp_path_factory):
         [sys.executable, "-m", "ingress_plus_tpu.serve",
          "--socket", serve_sock, "--rules-dir", str(rules_dir),
          "--platform", "cpu", "--max-delay-us", "1000", "--no-warmup",
+         # CI-host ladder desensitization (see test_serve_e2e fixture)
+         "--hard-deadline-ms", "5000",
          "--http-port", "0"],
         cwd=str(REPO), env=env, stderr=subprocess.PIPE, text=True)
     for _ in range(600):
@@ -181,6 +183,8 @@ def harness_stack(tmp_path_factory):
         [sys.executable, "-m", "ingress_plus_tpu.serve",
          "--socket", serve_sock, "--rules-dir", str(rules_dir),
          "--platform", "cpu", "--max-delay-us", "1000", "--no-warmup",
+         # CI-host ladder desensitization (see test_serve_e2e fixture)
+         "--hard-deadline-ms", "5000",
          "--http-port", "19907"],
         cwd=str(REPO), env=env, stderr=subprocess.PIPE, text=True)
     for _ in range(600):

@@ -76,7 +76,9 @@ def server(tmp_path_factory, binaries):
     proc = subprocess.Popen(
         [sys.executable, "-m", "ingress_plus_tpu.serve",
          "--socket", sock, "--rules-dir", str(rules_dir),
-         "--platform", "cpu", "--max-delay-us", "1000", "--no-warmup"],
+         "--platform", "cpu", "--max-delay-us", "1000", "--no-warmup",
+         # CI-host ladder desensitization (see test_serve_e2e fixture)
+         "--hard-deadline-ms", "5000"],
         cwd=str(REPO), env=env, stderr=subprocess.PIPE, text=True)
     _wait_socket(sock, proc, "serve loop")
     yield sock
@@ -329,6 +331,8 @@ def two_servers(tmp_path_factory, binaries):
             [sys.executable, "-m", "ingress_plus_tpu.serve",
              "--socket", sock, "--rules-dir", str(rules_dir),
              "--platform", "cpu", "--max-delay-us", "1000", "--no-warmup",
+         # CI-host ladder desensitization (see test_serve_e2e fixture)
+         "--hard-deadline-ms", "5000",
              "--http-port", "0"],
             cwd=str(REPO), env=env, stderr=subprocess.PIPE, text=True)
         socks.append(sock)

@@ -66,6 +66,7 @@ if str(REPO) not in sys.path:  # script execution puts tools/ first
 MYPY_SCOPE = ["ingress_plus_tpu/compiler", "ingress_plus_tpu/analysis",
               "ingress_plus_tpu/serve",   # includes serve/lanes.py
               "ingress_plus_tpu/models/rule_stats.py",
+              "ingress_plus_tpu/models/confirm_plane.py",
               "ingress_plus_tpu/post/topk.py",
               "ingress_plus_tpu/control/rollout.py",
               "ingress_plus_tpu/parallel/serve_mesh.py",
