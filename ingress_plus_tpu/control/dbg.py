@@ -31,6 +31,12 @@ recent hot reload (``/rules/drift``), went-quiet rules flagged.
 /debug/slow exemplar ring as terminal tables; ``--sidecar`` adds the
 native sidecar's per-upstream EWMA hop timing from its --status-port.
 
+``tenants`` renders the tenant-isolation plane (docs/ROBUSTNESS.md
+"Tenant isolation") from ``/tenants``: fair-queue depths, per-tenant
+admitted/shed/degraded counters, quarantine state and the top-offender
+sketch; ``--set`` still pushes a tenant→tags table to
+``/configuration/tenants``.
+
 ``breaker`` renders the fail-safe serve plane (docs/ROBUSTNESS.md):
 circuit-breaker state/trips, the brownout ladder rung + queue-delay
 EWMA, admission queue depth and shed counters (from ``/healthz``);
@@ -240,6 +246,65 @@ def render_breaker(health: dict) -> str:
                    ln.get("hangs"), ln.get("errors"),
                    ln.get("requests"),
                    ("%.3f" % fill) if fill is not None else "-"))
+    return "\n".join(lines)
+
+
+def render_tenants(st: dict) -> str:
+    """Terminal view for `dbg tenants`: the tenant-isolation plane out
+    of /tenants (docs/ROBUSTNESS.md "Tenant isolation") — fair-queue
+    depths, per-tenant admission counters, quarantine state, and the
+    top offenders sketch."""
+    q = st.get("queue") or {}
+    g = st.get("guard")
+    lines = [
+        "queue: depth=%s/%s  tenant_cap=%s  active_tenants=%s"
+        % (q.get("depth"), q.get("cap"), q.get("tenant_cap"),
+           q.get("active_tenants")),
+    ]
+    weights = q.get("weights") or {}
+    if weights:
+        lines.append("weights: %s"
+                     % ", ".join("%s=%s" % kv
+                                 for kv in sorted(weights.items())))
+    if g is None:
+        lines.append("tenant guard: DISABLED (--tenant-guard off) — "
+                     "fair admission still applies")
+        return "\n".join(lines)
+    lines.append(
+        "guard: policy=%s  tracked=%s/%s  quarantined=%s  "
+        "(quarantines=%s releases=%s)"
+        % (g.get("policy"), g.get("tracked"), g.get("max_tracked"),
+           g.get("quarantined") or "-", g.get("quarantines"),
+           g.get("releases")))
+    lines.append(
+        "budget: share>%s of a %ss window (min %s arrivals), "
+        "%s window(s) confirm, dwell %ss, depth trigger %s"
+        % (g.get("max_share"), g.get("window_s"),
+           g.get("min_window_arrivals"), g.get("up_confirm_windows"),
+           g.get("dwell_s"), g.get("depth_trigger")))
+    rows = g.get("tenants") or []
+    if rows:
+        lines.append("")
+        lines.append("%-8s %10s %8s %9s %9s %9s  %s"
+                     % ("tenant", "admitted", "shed", "degraded",
+                        "rate_rps", "shed_rps", "state"))
+        depths = q.get("depths") or {}
+        for r in rows[:20]:
+            lines.append(
+                "%-8s %10d %8d %9d %9.1f %9.1f  %s"
+                % (r["tenant"], r["admitted"], r["shed"], r["degraded"],
+                   r.get("rate_rps", 0.0), r.get("shed_rps", 0.0),
+                   ("QUARANTINED" if r.get("quarantined") else
+                    "q=%s" % depths.get(str(r["tenant"]), 0))))
+    top = st.get("top_offenders") or []
+    if top:
+        sk = st.get("sketch") or {}
+        lines.append("")
+        lines.append("top offenders (shed+degraded; sketch %s/%s keys):"
+                     % (sk.get("tracked"), sk.get("capacity")))
+        for e in top[:10]:
+            lines.append("  tenant %-8s count=%-8d (max_error=%d)"
+                         % (e["key"], e["count"], e["max_error"]))
     return "\n".join(lines)
 
 
@@ -467,7 +532,11 @@ def main(argv=None) -> int:
                 out = _call(args.server, "/configuration/tenants",
                             json.loads(args.set_json))
             else:
-                out = _call(args.server, "/configuration")
+                # the tenant-isolation plane (fair queue + flood
+                # guard), not just the mask count — /configuration
+                # still carries the latter
+                out = render_tenants(json.loads(_call(args.server,
+                                                      "/tenants")))
         elif args.cmd == "acl":
             if args.set_json:
                 # push: {"acls": {name: {allow/deny/greylist: [cidr]}},

@@ -345,6 +345,13 @@ def launch_confirm(pl, requests, rule_hits: np.ndarray) -> ConfirmJob:
     memo = job.memo
     pool = pl.confirm_pool
     t0 = time.perf_counter()
+    # tenant-targeted slow_confirm (docs/ROBUSTNESS.md "Tenant
+    # isolation"): the per-request arrival points below exist ONLY when
+    # the active plan targets a tenant — untargeted plans never reach
+    # them, so their site arrival counts (and replays) are unchanged;
+    # the share-level sleep_if above/below is invisible to a
+    # tenant-targeted rule (no tenant stamped there).
+    tt = faults.tenant_targeted("slow_confirm")
     if pool.inline:
         # worker id 0 stamped around the inline walk so worker-targeted
         # fault plans behave identically at --confirm-workers 1
@@ -352,8 +359,13 @@ def launch_confirm(pl, requests, rule_hits: np.ndarray) -> ConfirmJob:
         try:
             faults.sleep_if("slow_confirm")
             for qi, req in enumerate(requests):
+                if tt:
+                    faults.set_current_tenant(req.tenant)
+                    faults.sleep_if("slow_confirm")
                 job.results[qi] = confirm_one(pl, req, rule_hits[qi], memo)
         finally:
+            if tt:
+                faults.set_current_tenant(None)
             faults.set_current_confirm_worker(None)
     else:
         n = pool.n_workers
@@ -362,10 +374,20 @@ def launch_confirm(pl, requests, rule_hits: np.ndarray) -> ConfirmJob:
             if not idxs:
                 continue
 
-            def _share(idxs=idxs):
+            def _share(idxs=idxs, tt=tt):
                 faults.sleep_if("slow_confirm")
-                return [(i, confirm_one(pl, requests[i], rule_hits[i],
-                                        memo)) for i in idxs]
+                out = []
+                try:
+                    for i in idxs:
+                        if tt:
+                            faults.set_current_tenant(requests[i].tenant)
+                            faults.sleep_if("slow_confirm")
+                        out.append((i, confirm_one(pl, requests[i],
+                                                   rule_hits[i], memo)))
+                finally:
+                    if tt:
+                        faults.set_current_tenant(None)
+                return out
 
             job.pending.append((wi, idxs, pool.submit(wi, _share)))
     job.launch_us = int((time.perf_counter() - t0) * 1e6)

@@ -638,6 +638,37 @@ class DetectionPipeline:
             return []
         return self._detect_inner(requests, t0)
 
+    def detect_tenant_degraded(self,
+                               requests: Sequence[Request]) -> List[Verdict]:
+        """Per-tenant brownout rung (models/tenant_guard.py,
+        docs/ROBUSTNESS.md "Tenant isolation"): a quarantined tenant's
+        admitted traffic is served prefilter-only — sound candidates
+        score and flag, ``Verdict.degraded=True``, never blocks — while
+        every other tenant keeps full detection.  The global ladder's
+        rung 1, scoped to one tenant; the confirm lane (the dominant
+        CPU cost a flood would monopolize) is skipped entirely.
+        Counts requests but not batches: the admission cycle it rides
+        already counted."""
+        t0 = time.perf_counter()
+        requests = list(requests)
+        if not requests:
+            return []
+        self.stats.requests += len(requests)
+        try:
+            return self._finalize_prefilter_only(
+                requests, self.prefilter(requests), t0)
+        except Exception:
+            if not self.fail_open:
+                raise
+            self.stats.fail_open += len(requests)
+            self.stats.degraded += len(requests)
+            return [
+                Verdict(request_id=r.request_id, blocked=False,
+                        attack=False, classes=[], rule_ids=[], score=0,
+                        fail_open=True, degraded=True)
+                for r in requests
+            ]
+
     def detect_cpu_only(self, requests: Sequence[Request]) -> List[Verdict]:
         """Breaker-open fallback (docs/ROBUSTNESS.md): exact confirm
         semantics with ZERO device dispatch — every masked (request,

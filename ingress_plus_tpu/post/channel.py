@@ -48,6 +48,10 @@ class PostChannel:
         # distinct URI (a scanner sweep has unbounded distinct paths)
         self.top_paths = SpaceSaving(capacity=32)
         self.top_tenants = SpaceSaving(capacity=32)
+        # admission-level abuse (ISSUE 10): which tenants draw the
+        # shed/degraded verdicts — the tenant-guard's quarantine and
+        # fair-admission decisions, visible to postanalytics
+        self.top_shed_tenants = SpaceSaving(capacity=32)
         self.exporter = Exporter(
             self.queue, spool_dir=spool_dir, http_url=http_url,
             interval_s=interval_s,
@@ -57,13 +61,17 @@ class PostChannel:
             on_export=self.counters.record_export_events)
 
     def record(self, request: Request, verdict) -> None:
+        degraded = bool(getattr(verdict, "degraded", False))
         self.counters.record(
             attack=verdict.attack, blocked=verdict.blocked,
             fail_open=verdict.fail_open, classes=verdict.classes,
-            tenant=request.tenant, mode=request.mode)
+            tenant=request.tenant, mode=request.mode,
+            degraded=degraded)
         if verdict.attack:
             self.top_paths.offer(request.uri.split("?", 1)[0][:128])
             self.top_tenants.offer(str(request.tenant))
+        if verdict.fail_open or degraded:
+            self.top_shed_tenants.offer(str(request.tenant))
         # every request is queued (brute-detect needs clean-request rates);
         # the aggregator ignores non-attacks for attack export
         self.queue.put(Hit(
@@ -102,6 +110,10 @@ class PostChannel:
         d["top_attacked"] = {
             "paths": self.top_paths.items(10),
             "tenants": self.top_tenants.items(10),
+            # admission-level abuse (ISSUE 10): shed/degraded verdict
+            # heavy hitters — the serve plane's tenant-isolation
+            # decisions, aggregated under the same sketch bound
+            "shed_tenants": self.top_shed_tenants.items(10),
             "note": "space-saving sketch: count may over-estimate by "
                     "up to max_error",
         }

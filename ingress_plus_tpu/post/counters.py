@@ -47,6 +47,13 @@ class NodeCounters:
         self.fail_open = 0
         self.by_class: Dict[str, int] = {}
         self.by_tenant: Dict[int, int] = {}   # attacks per tenant
+        #: admission-level abuse visibility (ISSUE 10): which tenants'
+        #: verdicts came back shed/fail-open or degraded (tenant-guard
+        #: quarantine, overload) — postanalytics' view of the serve
+        #: plane's tenant-isolation decisions.  Same cardinality cap +
+        #: -1 overflow bucket as by_tenant.
+        self.shed_by_tenant: Dict[int, int] = {}
+        self.degraded_by_tenant: Dict[int, int] = {}
         #: EXPORTED ATTACK RECORDS by class (unit: aggregated attacks,
         #: not requests — by_class above counts per-request verdicts).
         #: This is the only place brute/dirbust rate detections appear:
@@ -55,11 +62,19 @@ class NodeCounters:
         self.export_events: Dict[str, int] = {}
 
     def record(self, *, attack: bool, blocked: bool, fail_open: bool,
-               classes, tenant: int, mode: int) -> None:
+               classes, tenant: int, mode: int,
+               degraded: bool = False) -> None:
         with self._lock:
             self.requests += 1
             if fail_open:
                 self.fail_open += 1
+                _bump(self.shed_by_tenant, tenant,
+                      self.MAX_TENANT_KEYS, -1)
+            if degraded and not fail_open:
+                # fail-open already counted above; a degraded-but-served
+                # verdict (prefilter-only rung) books here
+                _bump(self.degraded_by_tenant, tenant,
+                      self.MAX_TENANT_KEYS, -1)
             if attack:
                 self.attacks += 1
                 if blocked:
@@ -94,5 +109,9 @@ class NodeCounters:
                 "fail_open": self.fail_open,
                 "by_class": dict(self.by_class),
                 "by_tenant": {str(k): v for k, v in self.by_tenant.items()},
+                "shed_by_tenant": {str(k): v for k, v
+                                   in self.shed_by_tenant.items()},
+                "degraded_by_tenant": {str(k): v for k, v
+                                       in self.degraded_by_tenant.items()},
                 "export_events": dict(self.export_events),
             }

@@ -56,6 +56,16 @@ class SpaceSaving:
             self._counts[key] = floor + inc
             self._error[key] = floor
 
+    def summary(self) -> dict:
+        """Sketch occupancy metadata — capacity vs tracked keys and the
+        total tracked mass — so status views (``/tenants``,
+        ``/wallarm-status``) can render ``items()`` next to the bound
+        they were computed under instead of implying exactness."""
+        with self._lock:
+            return {"capacity": self.capacity,
+                    "tracked": len(self._counts),
+                    "total": sum(self._counts.values())}
+
     def items(self, n: Optional[int] = None) -> List[dict]:
         """Tracked keys, count-descending: ``{key, count, max_error}``
         — ``count`` may over-estimate by up to ``max_error``."""

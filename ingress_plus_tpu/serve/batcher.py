@@ -34,6 +34,17 @@ chip degrades CAPACITY (its share fails open once, its breaker trips,
 the splitter routes around it, the half-open canary brings it back),
 never the service; the CPU confirm-only fallback engages only when
 every lane is down.
+
+Tenant isolation (docs/ROBUSTNESS.md "Tenant isolation"): admission is
+TENANT-FAIR — the queue is per-tenant sub-queues drained by deficit
+round robin with byte-weighted quanta (``_TenantFairQueue``), deadline
+shedding charges each tenant its OWN backlog (a flooding tenant sheds
+its own tail while victims' requests admit), a per-tenant flood guard
+(models/tenant_guard.py) quarantines a budget-breaching tenant into its
+own brownout (prefilter-only or fail-open per policy), and the GLOBAL
+brownout ladder receives a tenant-fair pressure signal so it is
+reachable only under aggregate — not single-tenant — overload.  With
+one tenant on the box all of this collapses to the PR 4 behavior.
 """
 
 from __future__ import annotations
@@ -41,11 +52,16 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, replace
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ingress_plus_tpu.models.pipeline import DetectionPipeline, Verdict
+from ingress_plus_tpu.models.tenant_guard import (
+    TenantGuard,
+    TenantGuardConfig,
+)
 from ingress_plus_tpu.serve.lanes import (
     CircuitBreaker,
     DeviceHang,
@@ -91,13 +107,188 @@ def _fail_open_verdict(request_id: str) -> Verdict:
                    classes=[], rule_ids=[], score=0, fail_open=True)
 
 
+class TenantFull(queue.Full):
+    """A tenant's own sub-queue hit its cap (the global cap has room):
+    shed reason "tenant_queue_full" — the flooding tenant's loss, not
+    the box's."""
+
+
+#: DRR cost normalization: one small request ≈ 1 unit, a body adds its
+#: scan bytes in units of this divisor — a 16KB body costs ~2 units, so
+#: byte-heavy tenants drain proportionally fewer requests per round
+QUANTUM_BYTES = 16384
+
+
+class _TenantFairQueue:
+    """Per-tenant admission sub-queues drained by deficit round robin
+    (docs/ROBUSTNESS.md "Tenant isolation").
+
+    Each tenant owns a FIFO deque (stream begin/chunk/finish items ride
+    their tenant's deque, so per-stream ordering is preserved — streams
+    are single-tenant by construction).  ``get`` serves the tenant at
+    the head of the active ring while its deficit covers the head
+    item's cost (``1 + scan_bytes/QUANTUM_BYTES``); an exhausted tenant
+    rotates to the back and the next head earns one quantum x its
+    configured weight.  Small requests therefore interleave ~one per
+    tenant per round, large bodies consume multiple rounds — byte-
+    weighted fairness at request granularity.
+
+    Caps: ``cap`` bounds the whole queue (queue.Full, the PR 4
+    contract); ``tenant_cap`` bounds each sub-queue (TenantFull) so one
+    tenant cannot own the shared budget.  With a single tenant ever
+    seen the structure degenerates to one deque popped FIFO with no
+    deficit bookkeeping — the pre-tenant fast path, byte-identical
+    drain order.
+
+    Locking mirrors queue.Queue: one lock + a not-empty condition."""
+
+    def __init__(self, cap: int, tenant_cap: int = 0,
+                 weights: Optional[Dict[int, float]] = None,
+                 quantum: float = 1.0):
+        self.cap = cap
+        self.tenant_cap = tenant_cap or cap
+        self.weights = dict(weights or {})
+        self.quantum = quantum
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._qs: Dict[int, deque] = {}
+        self._ring: deque = deque()          # active tenant ids, DRR order
+        self._deficit: Dict[int, float] = {}
+        self._size = 0
+        #: sticky: a second DISTINCT tenant has been seen — consumers
+        #: (ladder-signal fast path) key their single-tenant shortcut
+        #: on this, never on a transiently-empty sub-queue set
+        self.seen_multi = False
+        self._first_tenant: Optional[int] = None
+
+    def qsize(self) -> int:
+        return self._size
+
+    def tenant_depth(self, tenant: int) -> int:
+        q = self._qs.get(tenant)
+        return len(q) if q is not None else 0
+
+    def depths(self) -> Dict[int, int]:
+        with self._lock:
+            return {t: len(q) for t, q in self._qs.items()}
+
+    def effective_depth(self, tenant: int, exclude=()) -> int:
+        """Queue-math depth for a NEW arrival of ``tenant`` under DRR:
+        its own backlog plus the slice of other tenants' backlog the
+        round robin will interleave before it drains — bounded both by
+        what those tenants actually have queued and by their fair share
+        against ``own + 1`` items.  ``exclude`` names tenants whose
+        backlog should not count against this arrival (quarantined
+        tenants: their items are served prefilter-only, a fraction of a
+        full-detection item's service time — charging them at full
+        weight shed victims the flood never actually delayed).  Single
+        tenant: exactly the global depth, exactly the PR 4 queue
+        math."""
+        with self._lock:
+            q = self._qs.get(tenant)
+            own = len(q) if q is not None else 0
+            n_active = len(self._qs)
+            if not own or n_active <= 1:
+                return own
+            others = self._size - own
+            n_others = n_active - 1
+            for t in exclude:
+                if t == tenant:
+                    continue
+                oq = self._qs.get(t)
+                if oq is not None:
+                    others -= len(oq)
+                    n_others -= 1
+            if others <= 0 or n_others <= 0:
+                return own
+            return own + min(others, (own + 1) * n_others)
+
+    def _weight(self, tenant: int) -> float:
+        return self.weights.get(tenant, 1.0)
+
+    def put_nowait(self, item, tenant: int = 0, cost_bytes: int = 0) -> None:
+        cost = 1.0 + cost_bytes / QUANTUM_BYTES
+        with self._not_empty:
+            if self._size >= self.cap:
+                raise queue.Full
+            q = self._qs.get(tenant)
+            if q is None:
+                if self._first_tenant is None:
+                    self._first_tenant = tenant
+                elif tenant != self._first_tenant:
+                    self.seen_multi = True
+                q = self._qs[tenant] = deque()
+                self._ring.append(tenant)
+                # a newly active tenant starts with one round's quantum
+                # so light traffic never waits out a full rotation
+                self._deficit[tenant] = self.quantum * self._weight(tenant)
+            elif len(q) >= self.tenant_cap:
+                raise TenantFull
+            q.append((item, cost))
+            self._size += 1
+            self._not_empty.notify()
+
+    def _pop_locked(self):
+        if len(self._ring) == 1:
+            # single active tenant: plain FIFO, no deficit bookkeeping
+            t = self._ring[0]
+            q = self._qs[t]
+            item, _cost = q.popleft()
+            self._size -= 1
+            if not q:
+                self._ring.clear()
+                del self._qs[t]
+                self._deficit.pop(t, None)
+            return item
+        while True:
+            t = self._ring[0]
+            q = self._qs[t]
+            cost = q[0][1]
+            if self._deficit[t] >= cost:
+                self._deficit[t] -= cost
+                item, _cost = q.popleft()
+                self._size -= 1
+                if not q:
+                    self._ring.popleft()
+                    del self._qs[t]
+                    del self._deficit[t]
+                return item
+            # head exhausted its round: rotate, grant the next tenant
+            # its quantum (weights are floored positive at parse — the
+            # rotation always terminates)
+            self._ring.rotate(-1)
+            nt = self._ring[0]
+            self._deficit[nt] += self.quantum * self._weight(nt)
+
+    def get(self, timeout: Optional[float] = None):
+        with self._not_empty:
+            if not self._size:
+                if timeout is None:
+                    while not self._size:
+                        self._not_empty.wait()
+                else:
+                    endtime = time.monotonic() + timeout
+                    while not self._size:
+                        remaining = endtime - time.monotonic()
+                        if remaining <= 0:
+                            raise queue.Empty
+                        self._not_empty.wait(remaining)
+            return self._pop_locked()
+
+    def get_nowait(self):
+        with self._not_empty:
+            if not self._size:
+                raise queue.Empty
+            return self._pop_locked()
+
+
 class _MeshCycle:
     """One in-flight mesh dispatch cycle: launched on the lanes,
     finalized one drain later (the double buffer)."""
 
     __slots__ = (
         "t0", "guard", "route", "pipeline", "ro", "cand_items",
-        "lane_parts", "fallback_items", "finish_verdicts",
+        "lane_parts", "fallback_items", "finish_verdicts", "deg_done",
         "n_reqs", "n_finishes", "n_stream_items", "min_ts",
         "max_queue_delay_us", "engine_us0", "confirm_us0", "prep_us0",
         "compiles0", "launch_d_engine", "launch_d_prep",
@@ -185,6 +376,9 @@ class Batcher:
         breaker_cooldown_s: float = 5.0,
         n_lanes: int = 1,
         lane_devices=None,
+        tenant_queue_cap: int = 0,
+        tenant_weights: Optional[Dict[int, float]] = None,
+        tenant_guard="prefilter_only",
     ):
         self.pipeline = pipeline
         self.stream_engine = StreamEngine(pipeline)
@@ -211,7 +405,24 @@ class Batcher:
         # divides by), brownout ladder thresholds derived from the serve
         # deadline, watchdogged device lane + circuit breaker, and a
         # monitor thread backstopping the dispatch thread itself
-        self._q: "queue.Queue" = queue.Queue(maxsize=queue_cap)
+        # tenant-fair admission (docs/ROBUSTNESS.md "Tenant isolation"):
+        # per-tenant DRR sub-queues + the flood guard.  tenant_queue_cap
+        # 0 = the global cap (single-tenant behavior unchanged);
+        # tenant_guard accepts a policy string ("prefilter_only" |
+        # "fail_open"), a TenantGuardConfig, or None/"off"
+        self._q = _TenantFairQueue(queue_cap, tenant_cap=tenant_queue_cap,
+                                   weights=tenant_weights)
+        if tenant_guard in (None, "off"):
+            self.tenant_guard: Optional[TenantGuard] = None
+        elif isinstance(tenant_guard, TenantGuardConfig):
+            self.tenant_guard = TenantGuard(tenant_guard)
+        elif isinstance(tenant_guard, TenantGuard):
+            self.tenant_guard = tenant_guard
+        else:
+            self.tenant_guard = TenantGuard(
+                TenantGuardConfig(policy=str(tenant_guard)))
+        if self.tenant_guard is not None:
+            self.tenant_guard.configure_depth(self._q.tenant_cap)
         self._batch_ewma = Ewma(alpha=0.2)
         self._batch_ewma_n = 0   # samples seen; shedding needs a floor
         self.pipeline.load_controller.configure_deadline(hard_deadline_s)
@@ -257,6 +468,13 @@ class Batcher:
         # request in that batch cycle).  Bounded: a flood of oversized
         # bodies fails open instead of queueing unbounded inflate work.
         self._oversized_q: "queue.Queue" = queue.Queue(maxsize=8)
+        # per-tenant occupancy of the side queue (tenant isolation,
+        # docs/ROBUSTNESS.md): one tenant may hold at most half the
+        # slots, so an oversized-body flood cannot fail-open another
+        # tenant's oversized request.  Lock shared by the dispatch
+        # thread (submit side) and the oversized worker (release side).
+        self._oversized_by_tenant: Dict[int, int] = {}
+        self._oversized_lock = threading.Lock()
         self._oversized_thread = threading.Thread(
             target=self._run_oversized, daemon=True, name="ipt-oversized")
         self._oversized_thread.start()
@@ -312,16 +530,19 @@ class Batcher:
         return (batches_ahead + 1) * per_batch
 
     def _shed(self, request: Request, fut: "Future[Verdict]",
-              reason: str) -> "Future[Verdict]":
+              reason: str, tenant: Optional[int] = None) -> "Future[Verdict]":
         """Fail a request open AT ADMISSION (no queue slot, no device
         time): the wallarm-fallback answer to overload — detection
         degrades, traffic does not.  Shed verdicts carry
         ``degraded=True`` and count in stats.degraded alongside the
-        ladder's verdicts (Verdict.degraded contract)."""
+        ladder's verdicts (Verdict.degraded contract).  ``tenant``
+        charges the shed to that tenant's guard counters."""
         st = self.pipeline.stats
         st.fail_open += 1
         st.degraded += 1
         st.count_shed(reason)
+        if tenant is not None and self.tenant_guard is not None:
+            self.tenant_guard.on_shed(tenant, reason)
         v = _fail_open_verdict(request.request_id)
         v.degraded = True
         _safe_set(fut, v)
@@ -331,20 +552,45 @@ class Batcher:
         fut: "Future[Verdict]" = Future()
         self.stats.submitted += 1
         lc = self.pipeline.load_controller
+        tenant = request.tenant
+        g = self.tenant_guard
+        glevel = 0
+        if g is not None:
+            # arrival accounting BEFORE any shed decision: the guard's
+            # share math must see the whole offered load, not just what
+            # admission accepted
+            glevel = g.observe_arrival(tenant,
+                                       depth=self._q.tenant_depth(tenant))
         if lc.level >= 2:
             # brownout floor: the ladder already decided no scan work
             # is affordable — don't even take a queue slot
-            return self._shed(request, fut, "brownout")
-        depth = self._q.qsize()
+            return self._shed(request, fut, "brownout", tenant)
+        if glevel >= 2:
+            # tenant-guard fail-open policy: the quarantined tenant's
+            # traffic sheds at admission, everyone else unaffected
+            return self._shed(request, fut, "tenant_flood", tenant)
+        depth = self._q.effective_depth(
+            tenant, exclude=g.quarantined_ids() if g is not None else ())
         if depth and self._est_wait_s(depth) > self.hard_deadline_s:
             # would miss the deadline by queue math: shed NOW, not
             # after wasting a dispatch slot on a verdict nobody waits
-            # for (the client side has long since failed open)
-            return self._shed(request, fut, "deadline")
+            # for (the client side has long since failed open).  The
+            # depth is the TENANT's own DRR backlog (+ fair-share
+            # interleave), so a flooding tenant sheds its own tail
+            # while a victim with an empty sub-queue always admits.
+            return self._shed(request, fut, "deadline", tenant)
+        kind = "req_deg" if glevel == 1 else "req"
         try:
-            self._q.put_nowait(("req", time.perf_counter(), request, fut))
+            self._q.put_nowait((kind, time.perf_counter(), request, fut),
+                               tenant=tenant,
+                               cost_bytes=len(request.body)
+                               + len(request.uri))
+        except TenantFull:
+            return self._shed(request, fut, "tenant_queue_full", tenant)
         except queue.Full:
-            return self._shed(request, fut, "queue_full")
+            return self._shed(request, fut, "queue_full", tenant)
+        if g is not None:
+            g.on_admit(tenant)
         return fut
 
     # ------------------------------------------- oversized-body reroute
@@ -383,17 +629,42 @@ class Batcher:
                           fut: "Future[Verdict]") -> None:
         """Hand one oversized request to the side worker; a full side
         queue fails open immediately (bounded memory under a flood of
-        maximum-size bodies).  ``ts`` is the original submit time — the
-        side lane's verdicts feed the e2e histogram and slow ring like
-        everyone else's (the likeliest slowest requests in the system
-        must not be invisible to /debug/slow)."""
-        try:
-            self._oversized_q.put_nowait((ts, request, plan, fut))
-        except queue.Full:
-            self.pipeline.stats.fail_open += 1
+        maximum-size bodies), as does a tenant already holding half the
+        side slots — the side lane is a shared scarce resource and one
+        tenant's oversized flood must not fail-open a sibling's
+        oversized request (tenant isolation).  ``ts`` is the original
+        submit time — the side lane's verdicts feed the e2e histogram
+        and slow ring like everyone else's (the likeliest slowest
+        requests in the system must not be invisible to /debug/slow)."""
+        tenant = request.tenant
+        tenant_cap = max(1, self._oversized_q.maxsize // 2)
+        ok = False
+        with self._oversized_lock:
+            if self._oversized_by_tenant.get(tenant, 0) < tenant_cap:
+                try:
+                    self._oversized_q.put_nowait((ts, request, plan, fut))
+                    ok = True
+                    self._oversized_by_tenant[tenant] = \
+                        self._oversized_by_tenant.get(tenant, 0) + 1
+                except queue.Full:
+                    pass
+        if not ok:
+            st = self.pipeline.stats
+            st.fail_open += 1
+            st.count_shed("oversized_overload")
+            if self.tenant_guard is not None:
+                self.tenant_guard.on_shed(tenant, "oversized_overload")
             _safe_set(fut, Verdict(
                 request_id=request.request_id, blocked=False, attack=False,
                 classes=[], rule_ids=[], score=0, fail_open=True))
+
+    def _release_oversized_slot(self, tenant: int) -> None:
+        with self._oversized_lock:
+            n = self._oversized_by_tenant.get(tenant, 0) - 1
+            if n > 0:
+                self._oversized_by_tenant[tenant] = n
+            else:
+                self._oversized_by_tenant.pop(tenant, None)
 
     def _run_oversized(self) -> None:
         while not self._stop.is_set():
@@ -401,7 +672,10 @@ class Batcher:
                 ts, request, plan, fut = self._oversized_q.get(timeout=0.1)
             except queue.Empty:
                 continue
-            self._detect_oversized(ts, request, plan, fut)
+            try:
+                self._detect_oversized(ts, request, plan, fut)
+            finally:
+                self._release_oversized_slot(request.tenant)
 
     def _detect_oversized(self, ts: float, request: Request, plan,
                           fut: "Future[Verdict]") -> None:
@@ -467,15 +741,40 @@ class Batcher:
         now (prefilter), body arrives via feed_chunk."""
         handle = self.stream_engine.begin(request)
         self.stats.streams += 1
+        g = self.tenant_guard
+        if g is not None:
+            # streams count toward the tenant's arrival share — a
+            # flood sent as MODE_STREAM requests must not be invisible
+            # to the guard's budget math
+            glevel = g.observe_arrival(
+                request.tenant,
+                depth=self._q.tenant_depth(request.tenant))
+            if glevel >= 1:
+                # a quarantined tenant's NEW streams fail open at
+                # finish (both policies: the chunk-scan + confirm cost
+                # is exactly what the quarantine exists to shed;
+                # state-carried prefilter-only streaming is not a
+                # thing).  In-flight streams complete normally.
+                handle.error = True
+                self.pipeline.stats.count_shed("tenant_flood")
+                g.on_shed(request.tenant, "tenant_flood")
+                return handle
         try:
-            self._q.put_nowait(("begin", time.perf_counter(), handle, None))
+            self._q.put_nowait(("begin", time.perf_counter(), handle, None),
+                               tenant=request.tenant)
         except queue.Full:
-            # bounded admission for streams too: a lost begin means the
-            # prefilter never ran — poison the handle so finish resolves
-            # fail-open (exactly-one-verdict invariant, no blocking put
-            # on the event-loop thread)
+            # bounded admission for streams too (TenantFull included):
+            # a lost begin means the prefilter never ran — poison the
+            # handle so finish resolves fail-open (exactly-one-verdict
+            # invariant, no blocking put on the event-loop thread)
             handle.error = True
-            self.pipeline.stats.count_shed("stream_overload")
+            self._count_stream_shed(request.tenant)
+            return handle
+        if g is not None:
+            # an enqueued begin IS an admission — without this a
+            # stream-only tenant shows admitted=0 next to nonzero
+            # shed/quarantine in /tenants (arrival/admit mismatch)
+            g.on_admit(request.tenant)
         return handle
 
     def feed_chunk(self, handle: StreamState, data: bytes) -> None:
@@ -485,22 +784,30 @@ class Batcher:
             return
         try:
             self._q.put_nowait(("chunk", time.perf_counter(),
-                                (handle, data), None))
+                                (handle, data), None),
+                               tenant=handle.request.tenant,
+                               cost_bytes=len(data))
         except queue.Full:
             # a dropped chunk would silently unscan part of the body:
             # poison instead, surface as fail-open at finish
             handle.error = True
-            self.pipeline.stats.count_shed("stream_overload")
+            self._count_stream_shed(handle.request.tenant)
+
+    def _count_stream_shed(self, tenant: int) -> None:
+        self.pipeline.stats.count_shed("stream_overload")
+        if self.tenant_guard is not None:
+            self.tenant_guard.on_shed(tenant, "stream_overload")
 
     def finish_stream(self, handle: StreamState) -> "Future[Verdict]":
         fut: "Future[Verdict]" = Future()
         try:
-            self._q.put_nowait(("finish", time.perf_counter(), handle, fut))
+            self._q.put_nowait(("finish", time.perf_counter(), handle, fut),
+                               tenant=handle.request.tenant)
         except queue.Full:
             st = self.pipeline.stats
             st.fail_open += 1
             st.degraded += 1
-            st.count_shed("stream_overload")
+            self._count_stream_shed(handle.request.tenant)
             v = _fail_open_verdict(handle.request.request_id)
             v.degraded = True
             _safe_set(fut, v)
@@ -637,10 +944,18 @@ class Batcher:
             if kind == "chunk":
                 obj[0].error = True
                 continue
-            rid = (obj.request_id if kind == "req"
-                   else obj.request.request_id)
+            if kind in ("req", "req_deg"):
+                rid, tenant = obj.request_id, obj.tenant
+            else:
+                rid = obj.request.request_id
+                tenant = obj.request.tenant
             st.fail_open += 1
             st.count_shed(reason)
+            if self.tenant_guard is not None:
+                # the per-tenant sub-queues drain fail-open at shutdown
+                # exactly like the main queue did (PR 4 stranded-handler
+                # contract, one dimension deeper) — attributed per tenant
+                self.tenant_guard.on_shed(tenant, reason)
             _safe_set(fut, _fail_open_verdict(rid))
             n += 1
 
@@ -832,19 +1147,111 @@ class Batcher:
         """Shared cycle prologue (single-lane loop AND mesh launch —
         one copy, not two drifting ones): split the drained items by
         kind, book the admission counters, arm the watchdog guard.
-        Returns (reqs, begins, chunks, finishes, guard)."""
+        Returns (reqs, deg_reqs, begins, chunks, finishes, guard) —
+        ``deg_reqs`` are quarantined tenants' requests ("req_deg"),
+        served prefilter-only off the full-detection path."""
         self.stats.batches += 1
         reqs = [(ts, r, fut) for k, ts, r, fut in batch if k == "req"]
+        deg_reqs = [(ts, r, fut) for k, ts, r, fut in batch
+                    if k == "req_deg"]
         begins = [h for k, _, h, _ in batch if k == "begin"]
         chunks = [pair for k, _, pair, _ in batch if k == "chunk"]
         finishes = [(h, fut) for k, _, h, fut in batch if k == "finish"]
         self.stats.max_batch_seen = max(self.stats.max_batch_seen,
-                                        len(reqs))
+                                        len(reqs) + len(deg_reqs))
         for ts, _, _ in reqs:
             self.stats.queue_delay_us_sum += int((t0 - ts) * 1e6)
+        for ts, _, _ in deg_reqs:
+            self.stats.queue_delay_us_sum += int((t0 - ts) * 1e6)
         items = [(r.request_id, fut) for _ts, r, fut in reqs]
+        items += [(r.request_id, fut) for _ts, r, fut in deg_reqs]
         items += [(h.request.request_id, fut) for h, fut in finishes]
-        return reqs, begins, chunks, finishes, self._arm_guard(t0, items)
+        return (reqs, deg_reqs, begins, chunks, finishes,
+                self._arm_guard(t0, items))
+
+    @staticmethod
+    def _item_tenant(kind: str, obj) -> int:
+        if kind == "chunk":
+            return obj[0].request.tenant
+        if kind in ("begin", "finish"):
+            return obj.request.tenant
+        return obj.tenant
+
+    def _ladder_signal(self, batch: List, t0: float) -> float:
+        """Queue-delay pressure (µs) for the GLOBAL brownout ladder.
+
+        Single tenant ever seen: the oldest item's wait — exactly the
+        PR 4 signal.  Multi-tenant: the MIN over non-quarantined
+        tenants of each tenant's own max wait.  Under fair admission a
+        flooding tenant delays only its OWN sub-queue, so the global
+        ladder sees pressure only when EVERY (non-quarantined) tenant
+        is delayed — i.e. aggregate overload; a single-tenant flood can
+        no longer brown out the box (pinned by test).  The fair min
+        does NOT depend on the guard — ``--tenant-guard off`` disables
+        quarantining, not fairness.  A cycle whose items all belong to
+        quarantined tenants contributes zero: their delay is the
+        guard's business, not the ladder's."""
+        if not self._q.seen_multi:
+            return max(((t0 - ts) * 1e6 for _, ts, _, _ in batch),
+                       default=0.0)
+        g = self.tenant_guard
+        per: Dict[int, float] = {}
+        for k, ts, obj, _f in batch:
+            t = self._item_tenant(k, obj)
+            d = (t0 - ts) * 1e6
+            if d > per.get(t, -1.0):
+                per[t] = d
+        eligible = [d for t, d in per.items()
+                    if g is None or not g.is_quarantined(t)]
+        if not eligible:
+            return 0.0
+        return min(eligible)
+
+    def _detect_tenant_degraded(self, deg_reqs: List, done: List,
+                                route: str = "device",
+                                lane: Optional[Lane] = None) -> None:
+        """Serve quarantined tenants' admitted requests prefilter-only
+        (the guard's per-tenant brownout rung — caller holds the swap
+        lock).  The prefilter still dispatches to the device, so the
+        work rides a watchdogged lane exactly like the stream step: a
+        hang fails only this share open and trips THAT lane's breaker;
+        breaker-open cycles skip the device outright (a quarantined
+        tenant does not get to probe a wedged chip).  Resolves the
+        futures, appends done-entries, books per-tenant degraded
+        counters."""
+        if not deg_reqs:
+            return
+        dreqs = [r for _, r, _ in deg_reqs]
+        p = self.pipeline
+        verdicts: Optional[List[Verdict]] = None
+        if route != "fallback":
+            if lane is None:
+                lane = self.lanes.primary
+            try:
+                verdicts = lane.call(
+                    lambda: p.detect_tenant_degraded(dreqs),
+                    self.hang_budget_s)
+            except DeviceHang:
+                self.stats.hangs += 1
+                lane.stats.hangs += 1
+                lane.breaker.trip("hang")
+            except Exception:
+                lane.stats.errors += 1
+                lane.breaker.record_failure()
+        if verdicts is None:
+            p.stats.fail_open += len(dreqs)
+            p.stats.degraded += len(dreqs)
+            verdicts = []
+            for r in dreqs:
+                v = _fail_open_verdict(r.request_id)
+                v.degraded = True
+                verdicts.append(v)
+        g = self.tenant_guard
+        for (ts, r, fut), v in zip(deg_reqs, verdicts):
+            _safe_set(fut, v)
+            done.append((ts, r, v, 0))
+            if g is not None:
+                g.on_degraded(r.tenant)
 
     def _clear_guard(self, guard: _CycleGuard) -> None:
         try:
@@ -867,7 +1274,7 @@ class Batcher:
             t0 = time.perf_counter()
             # prologue + arm the monitor: if THIS cycle wedges past
             # every budget, the watchdog releases its futures fail-open
-            reqs, begins, chunks, finishes, guard = \
+            reqs, deg_reqs, begins, chunks, finishes, guard = \
                 self._classify_batch(batch, t0)
             # one breaker decision per cycle: requests AND stream scan
             # work follow it (a wedged device must not be probed twice)
@@ -884,6 +1291,11 @@ class Batcher:
                 compiles0 = ps.engine_compiles
                 finish_verdicts = self._stream_step_guarded(
                     begins, chunks, finishes, route)
+                # quarantined tenants' share: prefilter-only, before
+                # the canary split (the candidate generation must never
+                # serve tenant-degraded traffic — its rollback triggers
+                # key on verdict quality)
+                self._detect_tenant_degraded(deg_reqs, done, route)
                 # partition: oversized bodies go through the stream
                 # engine inline; everything else batches as usual
                 normal = []
@@ -967,16 +1379,16 @@ class Batcher:
                     min(took, 2.0 * self.hard_deadline_s))
                 self._batch_ewma_n += 1
                 self.pipeline.load_controller.observe(
-                    max(((t0 - ts) * 1e6 for _, ts, _, _ in batch),
-                        default=0.0))
+                    self._ladder_signal(batch, t0))
             self.stats.batch_us_sum += int(took * 1e6)
+            n_served = len(reqs) + len(deg_reqs) + len(finishes)
             if took > self.hard_deadline_s:
-                self.stats.deadline_overruns += len(reqs) + len(finishes)
-            self.stats.completed += len(reqs) + len(finishes)
+                self.stats.deadline_overruns += n_served
+            self.stats.completed += n_served
             batch_us = int(took * 1e6)
             trace = BatchTrace(
                 ts=time.time(),
-                n_requests=len(reqs),
+                n_requests=len(reqs) + len(deg_reqs),
                 n_stream_items=len(begins) + len(chunks) + len(finishes),
                 queue_delay_us=int((t0 - min(ts for _, ts, _, _ in batch))
                                    * 1e6),
@@ -985,12 +1397,14 @@ class Batcher:
                 confirm_us=d_confirm,
                 prep_us=d_prep,
                 # only requests this batch actually scanned (`normal` +
-                # stream finishes): an oversized-rerouted id here would
-                # make /traces/request attribute the side lane's work to
+                # the tenant-degraded prefilter-only share + stream
+                # finishes): an oversized-rerouted id here would make
+                # /traces/request attribute the side lane's work to
                 # this batch's spans — those ids resolve via their
                 # /debug/slow exemplar instead
                 request_ids=[r.request_id for _, r, _ in normal]
                 + [r.request_id for _, r, _ in cand_items]
+                + [r.request_id for _, r, _ in deg_reqs]
                 + [h.request.request_id for h, _ in finish_verdicts])
             self.traces.record(trace)
             self._observe(trace, done, finish_verdicts, t0, t_end)
@@ -1081,14 +1495,16 @@ class Batcher:
         t0 = time.perf_counter()
         c = _MeshCycle()
         c.t0 = t0
-        reqs, begins, chunks, finishes, c.guard = \
+        reqs, deg_reqs, begins, chunks, finishes, c.guard = \
             self._classify_batch(batch, t0)
-        c.n_reqs = len(reqs)
+        c.n_reqs = len(reqs) + len(deg_reqs)
         c.n_finishes = len(finishes)
         c.n_stream_items = len(begins) + len(chunks) + len(finishes)
         c.min_ts = min(ts for _, ts, _, _ in batch)
-        c.max_queue_delay_us = max(
-            ((t0 - ts) * 1e6 for _, ts, _, _ in batch), default=0.0)
+        # tenant-fair pressure for the global ladder (observed at
+        # resolve): min over non-quarantined tenants, PR 4 max signal
+        # on the single-tenant fast path
+        c.max_queue_delay_us = self._ladder_signal(batch, t0)
         # one breaker decision per lane per cycle; no serving lane at
         # all ⇒ the whole cycle rides the global CPU fallback
         targets = self.lanes.routes()
@@ -1116,6 +1532,13 @@ class Batcher:
                             else "fallback")   # primary down ⇒ poison
             c.finish_verdicts = self._stream_step_guarded(
                 begins, chunks, finishes, stream_route, lane=primary)
+            # quarantined tenants' share: prefilter-only on the primary
+            # lane (the prefilter rides the default device, like stream
+            # work), resolved at launch — never a lane share, never the
+            # canary split (same contract as the single-lane loop)
+            c.deg_done = []
+            self._detect_tenant_degraded(deg_reqs, c.deg_done,
+                                         stream_route, lane=primary)
             # the stream step may just have tripped the primary's
             # breaker: drop newly-OPEN lanes from this cycle's targets
             # so no share dispatches to a known-wedged worker
@@ -1183,7 +1606,9 @@ class Batcher:
         candidate share.  Shares whose lane wedged or raised resolve
         fail-open here; everything else's verdicts land in
         :meth:`_resolve_cycle` once the confirm shares join."""
-        done: List = []   # (submit_ts, request, verdict, lane_idx)
+        # (submit_ts, request, verdict, lane_idx); seeded with the
+        # tenant-degraded share already resolved at launch
+        done: List = list(c.deg_done)
         p = c.pipeline
         # ONE hang budget for the whole collection: the lanes dispatched
         # concurrently at launch, so they share the deadline — k

@@ -492,6 +492,39 @@ class ServeLoop:
         lines.append("# TYPE ipt_shed_total counter")
         lines += bounded_counter_series(
             "ipt_shed_total", "reason", dict(p.shed))
+        # --- tenant isolation (docs/ROBUSTNESS.md "Tenant isolation"):
+        # per-tenant admission counters + guard state, bounded series
+        # with the standard "other" fold (tenant="-1" is the guard's
+        # tracking-overflow bucket); full per-tenant detail is
+        # JSON-only at /tenants, same cardinality policy as /rules/*
+        tg = self.batcher.tenant_guard
+        # fair-queue depths are guard-INDEPENDENT (--tenant-guard off
+        # disables quarantining, not fairness) — the gauge must not
+        # vanish on a guard-off deployment
+        lines.append("# TYPE ipt_tenant_queue_depth gauge")
+        lines += bounded_counter_series(
+            "ipt_tenant_queue_depth", "tenant",
+            {str(t): d for t, d in self.batcher._q.depths().items()})
+        if tg is not None:
+            tc = tg.counters()
+            lines.append("# TYPE ipt_tenant_admitted_total counter")
+            lines += bounded_counter_series(
+                "ipt_tenant_admitted_total", "tenant", tc["admitted"])
+            lines.append("# TYPE ipt_tenant_shed_total counter")
+            lines += bounded_counter_series(
+                "ipt_tenant_shed_total", "tenant", tc["shed"])
+            lines.append("# TYPE ipt_tenant_degraded_total counter")
+            lines += bounded_counter_series(
+                "ipt_tenant_degraded_total", "tenant", tc["degraded"])
+            brief = tg.brief()
+            lines += [
+                "# TYPE ipt_tenant_tracked gauge",
+                "ipt_tenant_tracked %d" % brief["tracked"],
+                "# TYPE ipt_tenant_quarantined gauge",
+                "ipt_tenant_quarantined %d" % len(brief["quarantined"]),
+                "# TYPE ipt_tenant_quarantines_total counter",
+                "ipt_tenant_quarantines_total %d" % brief["quarantines"],
+            ]
         lines.append("# TYPE ipt_bucket_rows_total counter")
         # dict() first: atomic copy vs the dispatch thread inserting a
         # new L tier mid-scrape (see rule_stats.device_efficiency)
@@ -688,6 +721,13 @@ class ServeLoop:
                         "hangs": pipeline.stats.confirm_hangs,
                         "memo_entries": pipeline.confirm_memo_entries,
                     },
+                    # tenant isolation (docs/ROBUSTNESS.md): guard
+                    # policy + who is quarantined right now; the full
+                    # per-tenant table is /tenants
+                    "tenant_guard": (
+                        self.batcher.tenant_guard.brief()
+                        if self.batcher.tenant_guard is not None
+                        else None),
                 },
             }).encode()
         if path.startswith("/readyz"):
@@ -807,6 +847,42 @@ class ServeLoop:
                       else {"postanalytics": "disabled"})
             return ("200 OK", "application/json",
                     json.dumps(status).encode())
+        if path.startswith("/tenants"):
+            # tenant-isolation view (docs/ROBUSTNESS.md "Tenant
+            # isolation"): per-tenant admitted/shed/degraded/queue-
+            # depth, guard/quarantine state, and the top offenders via
+            # the bounded SpaceSaving sketch.  ?n= caps the per-tenant
+            # rows (busiest first); full cardinality never leaves the
+            # process — the same policy as /rules/stats.
+            from urllib.parse import parse_qs, urlsplit
+            q = parse_qs(urlsplit(path).query, keep_blank_values=True)
+            try:
+                n = int((q.get("n") or ["64"])[0])
+            except ValueError:
+                n = 64
+            tg = self.batcher.tenant_guard
+            depths = self.batcher._q.depths()
+            body = {
+                "enabled": tg is not None,
+                "queue": {
+                    "depth": self.batcher.queue_depth(),
+                    "cap": self.batcher.queue_cap,
+                    "tenant_cap": self.batcher._q.tenant_cap,
+                    "active_tenants": len(depths),
+                    "depths": {str(t): d
+                               for t, d in sorted(depths.items())},
+                    "weights": {str(t): w for t, w in
+                                sorted(self.batcher._q.weights.items())},
+                },
+                "guard": tg.snapshot(top=max(n, 1)) if tg is not None
+                else None,
+                "top_offenders": (tg.top_offenders.items(10)
+                                  if tg is not None else []),
+                "sketch": (tg.top_offenders.summary()
+                           if tg is not None else None),
+            }
+            return ("200 OK", "application/json",
+                    json.dumps(body).encode())
         if path.startswith("/rules/stats"):
             # per-rule runtime accounting (ISSUE 3) — full detail is
             # JSON-only here by the cardinality policy (Prometheus gets
@@ -946,21 +1022,16 @@ class ServeLoop:
                              pipeline.rule_stats,
                              min_new_requests=max(mn, 1))).encode())
         if path == "/configuration/tenants" and method == "POST":
-            # EP tenant table push: {"<tenant>": ["tag", ...], ...}
-            from ingress_plus_tpu.control.sync import MAX_TENANTS
+            # EP tenant table push: {"<tenant>": ["tag", ...], ...}.
+            # Validation is the shared control/sync.py validator — a
+            # payload that would silently truncate the mask table
+            # (> MAX_TENANTS entries) or silently collapse rows
+            # (non-canonical ids like "01") is a structured 4xx, never
+            # a partial install (ISSUE 10 satellite).
+            from ingress_plus_tpu.control.sync import validate_tenant_tags
             try:
                 raw = json.loads(payload or b"{}")
-                for v in raw.values():
-                    # a bare string would iterate per-character into tags
-                    # that match no rule → all-False mask → scan bypass
-                    if not isinstance(v, (list, tuple)) or not all(
-                            isinstance(t, str) for t in v):
-                        raise ValueError(
-                            "tag values must be lists of strings")
-                tags = {int(k): tuple(v) for k, v in raw.items()}
-                if any(t < 0 or t >= MAX_TENANTS for t in tags):
-                    raise ValueError(
-                        "tenant ids must be in [0, %d)" % MAX_TENANTS)
+                tags = validate_tenant_tags(raw)
             except (ValueError, TypeError, AttributeError,
                     json.JSONDecodeError) as e:
                 return ("400 Bad Request", "application/json",
@@ -1185,7 +1256,10 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
                           rollout_fail_on: str = "error",
                           n_lanes: int = 1,
                           scoring_head_path: Optional[str] = None,
-                          confirm_workers: int = 1) -> Batcher:
+                          confirm_workers: int = 1,
+                          tenant_queue_cap: int = 0,
+                          tenant_weights: Optional[str] = None,
+                          tenant_guard: str = "prefilter_only") -> Batcher:
     from ingress_plus_tpu.compiler.ruleset import compile_ruleset
     from ingress_plus_tpu.compiler.seclang import load_seclang_dir
     from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
@@ -1298,12 +1372,17 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
         print("learned scoring: head %s (threshold %.4f, coverage %.3f)"
               % (head.version, pipeline.scorer.threshold,
                  pipeline.scorer.coverage), file=sys.stderr)
+    from ingress_plus_tpu.models.tenant_guard import parse_tenant_weights
+
     batcher = Batcher(pipeline, max_batch=max_batch, max_delay_s=max_delay_s,
                       hard_deadline_s=hard_deadline_s, queue_cap=queue_cap,
                       hang_budget_s=hang_budget_s,
                       breaker_failures=breaker_failures,
                       breaker_cooldown_s=breaker_cooldown_s,
-                      n_lanes=n_lanes)
+                      n_lanes=n_lanes,
+                      tenant_queue_cap=tenant_queue_cap,
+                      tenant_weights=parse_tenant_weights(tenant_weights),
+                      tenant_guard=tenant_guard)
     if warmup and n_lanes > 1:
         # mesh warmup (docs/MESH_SERVING.md): every lane's device-bound
         # executables compile in ONE overlapped pass, every Q-pad tier
@@ -1469,6 +1548,25 @@ def main(argv=None) -> None:
     ap.add_argument("--breaker-cooldown-s", type=float, default=5.0,
                     help="seconds the breaker stays open before a "
                          "half-open canary batch probes the device")
+    # tenant isolation (docs/ROBUSTNESS.md "Tenant isolation")
+    ap.add_argument("--tenant-queue-cap", type=int, default=0,
+                    help="per-tenant admission sub-queue cap (deficit-"
+                         "round-robin fair queue); 0 = the global "
+                         "--queue-cap (single-tenant behavior "
+                         "unchanged).  Beyond it that tenant sheds "
+                         "fail-open (reason=tenant_queue_full) while "
+                         "other tenants keep admitting")
+    ap.add_argument("--tenant-weights", default=None,
+                    help="DRR weights per tenant, e.g. '1:4,7:0.5' — a "
+                         "weight-2 tenant drains twice the bytes per "
+                         "fair-queue round; unlisted tenants weigh 1")
+    ap.add_argument("--tenant-guard", default="prefilter_only",
+                    choices=["prefilter_only", "fail_open", "off"],
+                    help="per-tenant flood guard policy: a tenant "
+                         "breaching its admission budget is served "
+                         "prefilter-only (degraded, never blocks) or "
+                         "shed fail-open; 'off' disables quarantining "
+                         "(fair admission still applies)")
     # guarded ruleset rollout (docs/ROBUSTNESS.md "Guarded rollout")
     ap.add_argument("--lkg-dir", default=None,
                     help="last-known-good pack directory: packs that "
@@ -1525,7 +1623,10 @@ def main(argv=None) -> None:
         rollout_fail_on=args.rollout_fail_on,
         n_lanes=_parse_lanes(args.lanes),
         scoring_head_path=args.scoring_head,
-        confirm_workers=_parse_confirm_workers(args.confirm_workers))
+        confirm_workers=_parse_confirm_workers(args.confirm_workers),
+        tenant_queue_cap=args.tenant_queue_cap,
+        tenant_weights=args.tenant_weights,
+        tenant_guard=args.tenant_guard)
 
     post = None
     if args.spool_dir or args.export_url:

@@ -45,7 +45,9 @@ site                      injected where / what it does when it fires
                           (models/confirm_plane.py), so ``worker=K`` targets
                           ONE confirm worker of a multi-worker pool — a
                           wedged worker must fail only its request share
-                          open (docs/CONFIRM_PLANE.md)
+                          open (docs/CONFIRM_PLANE.md) — and ``tenant=T``
+                          targets ONE tenant's requests (per-request
+                          stamping), the tenant-flood scenarios' hammer
 ========================  ====================================================
 
 A plan is a set of per-site rules ``site:after=N,times=M,delay_s=X,
@@ -101,7 +103,13 @@ class FaultRule:
     (docs/CONFIRM_PLANE.md) — restricts the site to ONE confirm
     worker's share execution, so ``slow_confirm:worker=1,times=1``
     wedges exactly one confirm worker while its pool siblings keep
-    confirming."""
+    confirming; ``tenant``: the tenant-isolation twin
+    (docs/ROBUSTNESS.md "Tenant isolation") — restricts the site to
+    requests of ONE tenant at per-request sites (the confirm plane
+    stamps the request's tenant around each confirm walk when a
+    tenant-targeted rule is active), so ``slow_confirm:tenant=1``
+    makes exactly one tenant's traffic pathologically expensive while
+    other tenants' arrivals neither count nor fire."""
 
     site: str
     after: int = 0
@@ -110,6 +118,7 @@ class FaultRule:
     prob: float = 1.0
     lane: Optional[int] = None
     worker: Optional[int] = None
+    tenant: Optional[int] = None
 
     @classmethod
     def parse(cls, text: str) -> "FaultRule":
@@ -123,7 +132,7 @@ class FaultRule:
             k, _, v = part.partition("=")
             k = k.strip()
             if k not in ("after", "times", "delay_s", "prob", "lane",
-                         "worker"):
+                         "worker", "tenant"):
                 raise ValueError("unknown fault arg %r in %r" % (k, text))
             kw[k] = float(v)
         return cls(site=site,
@@ -132,7 +141,8 @@ class FaultRule:
                    delay_s=float(kw.get("delay_s", 1.0)),
                    prob=float(kw.get("prob", 1.0)),
                    lane=int(kw["lane"]) if "lane" in kw else None,
-                   worker=int(kw["worker"]) if "worker" in kw else None)
+                   worker=int(kw["worker"]) if "worker" in kw else None,
+                   tenant=int(kw["tenant"]) if "tenant" in kw else None)
 
 
 class FaultPlan:
@@ -175,6 +185,11 @@ class FaultPlan:
             # as lane targeting, keyed on the confirm plane's
             # thread-local worker id (models/confirm_plane.py)
             return None
+        if rule.tenant is not None and rule.tenant != current_tenant():
+            # tenant-targeted rule: arrivals while another tenant's (or
+            # no) request is being processed are invisible — per-tenant
+            # arrival order is deterministic, so replays hold
+            return None
         with self._lock:
             n = self.arrivals[site]
             self.arrivals[site] = n + 1
@@ -195,6 +210,7 @@ class FaultPlan:
                     {"site": r.site, "after": r.after, "times": r.times,
                      "delay_s": r.delay_s, "prob": r.prob,
                      "lane": r.lane, "worker": r.worker,
+                     "tenant": r.tenant,
                      "arrivals": self.arrivals[r.site],
                      "fired": self.fired[r.site]}
                     for r in self.rules.values()
@@ -234,6 +250,32 @@ def set_current_confirm_worker(index: Optional[int]) -> None:
 
 def current_confirm_worker() -> Optional[int]:
     return getattr(_lane_local, "confirm_worker", None)
+
+
+# thread-local tenant attribution (docs/ROBUSTNESS.md "Tenant
+# isolation"): per-request processing stamps the request's tenant
+# around the work, so ``tenant=``-targeted rules fire only while that
+# tenant's request is in hand.  Stamping is OPT-IN per site via
+# ``tenant_targeted`` — an untargeted plan never reaches the
+# per-request arrival points, so its site arrival counts (and
+# therefore every existing plan's replay) are unchanged.
+def set_current_tenant(tenant: Optional[int]) -> None:
+    _lane_local.tenant = tenant
+
+
+def current_tenant() -> Optional[int]:
+    return getattr(_lane_local, "tenant", None)
+
+
+def tenant_targeted(site: str) -> bool:
+    """True when the active plan has a tenant-targeted rule at
+    ``site`` — per-request stamping code keys on this so untargeted
+    plans keep their exact arrival accounting."""
+    p = _active
+    if p is None:
+        return False
+    r = p.rules.get(site)
+    return r is not None and r.tenant is not None
 
 
 def install(plan: Optional[FaultPlan]) -> None:
@@ -335,14 +377,16 @@ def _mk_batcher(cr=None, confirm_workers: int = 1,
     return b
 
 
-def _requests(n: int, attack_every: int = 0, tag: str = "r"):
+def _requests(n: int, attack_every: int = 0, tag: str = "r",
+              tenant: int = 0):
     from ingress_plus_tpu.serve.normalize import Request
 
     out = []
     for i in range(n):
         uri = (ATTACK_URI if attack_every and i % attack_every == 0
                else "/benign?i=%d" % i)
-        out.append(Request(uri=uri, request_id="%s%d" % (tag, i)))
+        out.append(Request(uri=uri, request_id="%s%d" % (tag, i),
+                           tenant=tenant))
     return out
 
 
@@ -671,7 +715,7 @@ def _scenario_confirm_worker_hang(install_plan) -> dict:
 # exactly one verdict.
 
 
-def _rollout_fixtures():
+def _rollout_fixtures(**kw):
     from ingress_plus_tpu.compiler.ruleset import compile_ruleset
     from ingress_plus_tpu.compiler.seclang import parse_seclang
     from ingress_plus_tpu.control.rollout import (
@@ -683,7 +727,7 @@ def _rollout_fixtures():
 
     cr_inc = compile_ruleset(parse_seclang(_DRILL_INCUMBENT))
     cr_cand = compile_ruleset(parse_seclang(_DRILL_CANDIDATE))
-    b = _mk_batcher(cr=cr_inc)
+    b = _mk_batcher(cr=cr_inc, **kw)
     ro = RolloutController(b, _drill_config())
     b.rollout = ro
     return b, ro, cr_inc, cr_cand
@@ -943,6 +987,151 @@ def _scenario_lane_dispatch_raise(install_plan) -> dict:
         b.close()
 
 
+# ------------------------------------------------ tenant isolation
+# (serve/batcher.py fair admission + models/tenant_guard.py,
+# docs/ROBUSTNESS.md "Tenant isolation").  The multi-tenant invariant:
+# one tenant's flood degrades only THAT tenant — victims keep real,
+# un-degraded verdicts in the same cycles, the GLOBAL brownout ladder
+# never climbs, and the hostile tenant recovers once the flood stops.
+
+
+def _scenario_tenant_flood(install_plan) -> dict:
+    """Hostile tenant 1 floods (8x volume, tenant-targeted slow
+    confirm makes its confirmed traffic genuinely expensive): fair
+    admission + the tenant guard must confine the blast radius.
+    Victim tenant 0's verdicts stay real and un-degraded in the SAME
+    waves the hostile tenant sheds/degrades; the hostile tenant is
+    quarantined (and only it); the global ladder records zero steps
+    up; after the flood the hostile tenant returns to full
+    detection."""
+    from ingress_plus_tpu.models.tenant_guard import TenantGuardConfig
+
+    install_plan(FaultPlan.from_spec(
+        "slow_confirm:tenant=1,times=48,delay_s=0.01"))
+    b = _mk_batcher(
+        queue_cap=256, hard_deadline_s=0.4, hang_budget_s=30.0,
+        tenant_queue_cap=16,
+        tenant_guard=TenantGuardConfig(
+            window_s=0.15, up_confirm_windows=1, dwell_s=0.6,
+            min_window_arrivals=16))
+    violations: List[str] = []
+    try:
+        victim_bad = hostile_curbed = victim_real_attacks = 0
+        for wave in range(8):
+            vfuts = [b.submit(r) for r in _requests(
+                6, attack_every=3, tag="tf%dv" % wave, tenant=0)]
+            hfuts = [b.submit(r) for r in _requests(
+                48, tag="tf%dh" % wave, tenant=1)]
+            vs_v, viol_v = _collect(vfuts, timeout_s=60)
+            vs_h, viol_h = _collect(hfuts, timeout_s=60)
+            violations += viol_v + viol_h
+            for v in vs_v:
+                if v.fail_open or v.degraded:
+                    victim_bad += 1
+                if v.attack and not v.fail_open and not v.degraded:
+                    victim_real_attacks += 1
+            hostile_curbed += sum(1 for v in vs_h
+                                  if v.fail_open or v.degraded)
+        if victim_bad:
+            violations.append("victim tenant saw %d shed/degraded "
+                              "verdicts during the flood — isolation "
+                              "leaked" % victim_bad)
+        if not victim_real_attacks:
+            violations.append("victim tenant's attacks were not "
+                              "detected during the flood")
+        if not hostile_curbed:
+            violations.append("flooding tenant was never shed or "
+                              "degraded — admission is not tenant-fair")
+        lc = b.pipeline.load_controller
+        if lc.steps_up:
+            violations.append("GLOBAL brownout ladder climbed (%d "
+                              "steps) on a single-tenant flood — the "
+                              "ladder must be reachable only from "
+                              "aggregate pressure" % lc.steps_up)
+        g = b.tenant_guard
+        if g.quarantines < 1:
+            violations.append("tenant guard never quarantined the "
+                              "flooding tenant")
+        if g.is_quarantined(0):
+            violations.append("victim tenant was quarantined")
+        # recovery: flood over, fault exhausted — after the dwell the
+        # hostile tenant serves full-detection verdicts again
+        deadline = time.monotonic() + 20
+        recovered = False
+        while time.monotonic() < deadline:
+            vs, viol = _collect([b.submit(r) for r in _requests(
+                4, attack_every=2, tag="tfr", tenant=1)], 30)
+            violations += viol
+            if vs and all(not v.fail_open and not v.degraded
+                          for v in vs) and any(v.attack for v in vs):
+                recovered = True
+                break
+            time.sleep(0.2)
+        if not recovered:
+            violations.append("flooding tenant never recovered to "
+                              "full detection after the flood")
+        return {"ok": not violations, "violations": violations,
+                "hostile_curbed": hostile_curbed,
+                "victim_real_attacks": victim_real_attacks,
+                "quarantines": g.quarantines,
+                "ladder_steps_up": lc.steps_up,
+                "shed": dict(b.pipeline.stats.shed)}
+    finally:
+        b.close()
+
+
+def _scenario_tenant_flood_canary(install_plan) -> dict:
+    """A tenant flood DURING a staged rollout must not trip the
+    candidate's rollback triggers: quarantined traffic is split out
+    before the canary split, shed verdicts never reach the shadow
+    mirror, and tenant-degraded verdicts are skipped by the diff the
+    same way fail-open ones are — the rollout reaches LIVE while the
+    hostile tenant sheds alone."""
+    from ingress_plus_tpu.models.tenant_guard import TenantGuardConfig
+
+    b, ro, cr_inc, cr_cand = _rollout_fixtures(
+        tenant_queue_cap=16,
+        tenant_guard=TenantGuardConfig(
+            window_s=0.15, up_confirm_windows=1, dwell_s=5.0,
+            min_window_arrivals=16))
+    install_plan(FaultPlan.from_spec(
+        "slow_confirm:tenant=1,times=32,delay_s=0.01"))
+    violations: List[str] = []
+    try:
+        ro.admit(ruleset=cr_cand)
+        from ingress_plus_tpu.control.rollout import LIVE, ROLLED_BACK
+        deadline = time.monotonic() + 60
+        wave = 0
+        hostile_curbed = 0
+        while ro.state not in (LIVE, ROLLED_BACK) \
+                and time.monotonic() < deadline and wave <= 40:
+            futs = [b.submit(r) for r in _requests(
+                24, attack_every=4, tag="tc%d" % wave)]
+            futs += [b.submit(r) for r in _requests(
+                48, tag="tch%d" % wave, tenant=1)]
+            vs, viol = _collect(futs, timeout_s=30)
+            violations.extend(viol)
+            hostile_curbed += sum(1 for v in vs
+                                  if v.fail_open or v.degraded)
+            wave += 1
+        if ro.state != LIVE:
+            violations.append("rollout did not reach LIVE under a "
+                              "tenant flood (state=%s, rollback=%r) — "
+                              "the flood tripped a candidate trigger"
+                              % (ro.state, ro.rollback_reason))
+        if not hostile_curbed:
+            violations.append("the flood was never shed or degraded — "
+                              "the scenario exercised nothing")
+        if b.tenant_guard.is_quarantined(0):
+            violations.append("victim tenant was quarantined")
+        return {"ok": not violations, "violations": violations,
+                "state": ro.state, "waves": wave,
+                "hostile_curbed": hostile_curbed,
+                "quarantines": b.tenant_guard.quarantines}
+    finally:
+        b.close()
+
+
 SCENARIOS = {
     "overload_burst": _scenario_overload,
     "dispatch_hang": _scenario_dispatch_hang,
@@ -957,6 +1146,8 @@ SCENARIOS = {
     "lkg_corrupt": _scenario_lkg_corrupt,
     "lane_dispatch_hang": _scenario_lane_dispatch_hang,
     "lane_dispatch_raise": _scenario_lane_dispatch_raise,
+    "tenant_flood": _scenario_tenant_flood,
+    "tenant_flood_during_canary": _scenario_tenant_flood_canary,
 }
 
 

@@ -21,7 +21,12 @@ from ingress_plus_tpu.control.annotations import (
 from ingress_plus_tpu.control.config import GlobalConfig
 from ingress_plus_tpu.control.model import build_configuration
 from ingress_plus_tpu.control.objects import ConfigMap, Ingress
-from ingress_plus_tpu.control.sync import SyncController, tenant_masks
+from ingress_plus_tpu.control.sync import (
+    MAX_TENANTS,
+    SyncController,
+    tenant_masks,
+    validate_tenant_tags,
+)
 from ingress_plus_tpu.control.template import render
 
 RULES = """
@@ -335,6 +340,44 @@ def test_tenant_masks_unlisted_tenant_runs_full_ruleset():
     # reserved row 0 cannot be overridden; out-of-bounds ids are dropped
     masks = tenant_masks(cr, {0: ("attack-xss",), 10**9: ("attack-xss",)})
     assert masks.shape[0] == 1 and masks[0].all()
+
+
+def test_validate_tenant_tags_accepts_canonical_table():
+    """The accept path: canonical ids, list-of-string tags, within the
+    MAX_TENANTS budget → the exact table tenant_masks consumes."""
+    got = validate_tenant_tags({"1": ["attack-xss"],
+                                "42": ["attack-sqli", "attack-xss"],
+                                "0": []})
+    assert got == {1: ("attack-xss",),
+                   42: ("attack-sqli", "attack-xss"),
+                   0: ()}
+
+
+def test_validate_tenant_tags_rejects_oversized_and_collapsing():
+    """The reject paths (ISSUE 10 satellite): a payload that would
+    silently truncate the mask table or silently collapse two keys
+    into one row must be a structured error, never a partial install."""
+    # > MAX_TENANTS entries: tenant_masks would silently drop the tail
+    big = {str(i): [] for i in range(MAX_TENANTS + 1)}
+    with pytest.raises(ValueError, match="too many tenants"):
+        validate_tenant_tags(big)
+    # non-canonical key: "01" and "1" would collapse, last writer wins
+    with pytest.raises(ValueError, match="not canonical"):
+        validate_tenant_tags({"01": ["attack-xss"], "1": []})
+    # non-integer key
+    with pytest.raises(ValueError, match="not an integer"):
+        validate_tenant_tags({"abc": []})
+    # out-of-range id (would be silently dropped by tenant_masks)
+    with pytest.raises(ValueError, match=r"\[0, 4096\)"):
+        validate_tenant_tags({str(MAX_TENANTS): []})
+    with pytest.raises(ValueError, match=r"\[0, 4096\)"):
+        validate_tenant_tags({"-1": []})
+    # a bare string iterates per-character into no-match tags →
+    # all-False mask → scan bypass
+    with pytest.raises(ValueError, match="lists of strings"):
+        validate_tenant_tags({"1": "attack-xss"})
+    with pytest.raises(ValueError, match="must be a JSON object"):
+        validate_tenant_tags(["1"])
 
 
 def test_explicit_mode_off_is_honored_as_opt_out():

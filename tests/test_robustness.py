@@ -293,7 +293,8 @@ def test_cpu_fallback_verdict_parity(cr):
     "overload_burst", "dispatch_hang", "dispatch_raise",
     "recompile_storm", "swap_fail", "export_5xx", "slow_confirm",
     "rollout_promote_fail", "rollout_shadow_diverge", "lkg_corrupt",
-    "lane_dispatch_hang", "lane_dispatch_raise", "confirm_worker_hang"])
+    "lane_dispatch_hang", "lane_dispatch_raise", "confirm_worker_hang",
+    "tenant_flood", "tenant_flood_during_canary"])
 def test_fault_matrix_scenario(scenario):
     rep = run_fault_matrix(only=[scenario])
     res = rep["scenarios"][scenario]

@@ -22,12 +22,14 @@ Four gates, one verdict:
   faultmatrix the fail-safe serve plane (docs/ROBUSTNESS.md): a real
              CPU batcher runs under every deterministic FaultPlan
              scenario (dispatch_hang/raise, recompile_storm, swap_fail,
-             export_5xx, slow_confirm, plus the rollout-phase faults
-             shadow_diverge/lkg_corrupt/promote-boundary swap_fail) and
-             a synthetic overload burst; the invariant "every admitted
-             request gets exactly one verdict, and no fault becomes an
-             unhandled exception or a block" must hold, the breaker
-             must trip and recover
+             export_5xx, slow_confirm, the rollout-phase faults
+             shadow_diverge/lkg_corrupt/promote-boundary swap_fail,
+             the lane/confirm-worker isolation scenarios, and the
+             tenant-isolation floods tenant_flood /
+             tenant_flood_during_canary) plus a synthetic overload
+             burst; the invariant "every admitted request gets exactly
+             one verdict, and no fault becomes an unhandled exception
+             or a block" must hold, the breaker must trip and recover
   swapdrill  the guarded-rollout state machine (docs/ROBUSTNESS.md
              "Guarded rollout"): a known-good pack is driven through
              the full staged rollout to LIVE, a rulecheck-dirty pack
@@ -67,6 +69,7 @@ MYPY_SCOPE = ["ingress_plus_tpu/compiler", "ingress_plus_tpu/analysis",
               "ingress_plus_tpu/serve",   # includes serve/lanes.py
               "ingress_plus_tpu/models/rule_stats.py",
               "ingress_plus_tpu/models/confirm_plane.py",
+              "ingress_plus_tpu/models/tenant_guard.py",
               "ingress_plus_tpu/post/topk.py",
               "ingress_plus_tpu/control/rollout.py",
               "ingress_plus_tpu/parallel/serve_mesh.py",
