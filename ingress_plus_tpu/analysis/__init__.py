@@ -13,6 +13,12 @@ Entry points: ``run_rulecheck()`` (library), ``python -m
 ingress_plus_tpu.analysis`` (CLI, text/JSON/SARIF), ``dbg rulecheck``
 (control/dbg.py), ``tools/lint.py --ci`` (the CI gate: zero unsuppressed
 error-severity findings on the bundled CRS tree).
+
+The package also hosts ``concheck`` — the concurrency static analyzer
+over the serve-plane SOURCES (analysis/concheck.py + threadmap.py,
+docs/ANALYSIS.md "Concurrency analysis"): ``run_concheck()``,
+``python -m ingress_plus_tpu.analysis --conc``, ``dbg concheck``, and
+its own ``concheck`` gate in ``tools/lint.py --ci``.
 """
 
 from __future__ import annotations
@@ -28,6 +34,9 @@ from ingress_plus_tpu.analysis.findings import (  # noqa: F401 (public API)
     Finding,
     Report,
     SEVERITIES,
+)
+from ingress_plus_tpu.analysis.concheck import (  # noqa: F401 (public API)
+    run_concheck,
 )
 from ingress_plus_tpu.analysis.lanecheck import check_lanes
 from ingress_plus_tpu.analysis.prefilter_audit import audit_prefilter

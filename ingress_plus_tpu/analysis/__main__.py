@@ -3,6 +3,8 @@
     python -m ingress_plus_tpu.analysis                    # bundled tree
     python -m ingress_plus_tpu.analysis --rules path/ --format sarif
     python -m ingress_plus_tpu.analysis --format json --output reports/RULECHECK.json
+    python -m ingress_plus_tpu.analysis --conc             # concurrency analyzer
+    python -m ingress_plus_tpu.analysis --conc --fail-on error
 
 Exit code 0 when no unsuppressed finding reaches ``--fail-on`` severity
 (default: error) — the CI gate contract.
@@ -17,12 +19,16 @@ from pathlib import Path
 from ingress_plus_tpu.analysis import (
     BaselineError,
     SEVERITIES,
+    run_concheck,
     run_rulecheck,
 )
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ingress_plus_tpu.analysis")
+    ap.add_argument("--conc", action="store_true",
+                    help="run concheck (the serve-plane concurrency "
+                         "analyzer) instead of rulecheck")
     ap.add_argument("--rules", default=None,
                     help="rules tree (directory of *.conf, or an entry "
                          "config); default: the bundled CRS tree")
@@ -30,8 +36,9 @@ def main(argv=None) -> int:
                     default="text")
     ap.add_argument("--baseline", default="auto",
                     help="suppression baseline JSON; 'auto' (default) "
-                         "uses <rules>/rulecheck-baseline.json, 'none' "
-                         "disables suppression")
+                         "uses <rules>/rulecheck-baseline.json (or "
+                         "analysis/concheck-baseline.json with --conc), "
+                         "'none' disables suppression")
     ap.add_argument("--fail-on", choices=list(SEVERITIES),
                     default="error",
                     help="exit nonzero when an unsuppressed finding of "
@@ -40,9 +47,29 @@ def main(argv=None) -> int:
                     help="also write the rendered report to this path")
     args = ap.parse_args(argv)
 
+    baseline = None if args.baseline == "none" else args.baseline
+    if args.conc:
+        try:
+            report = run_concheck(baseline_path=baseline)
+        except (OSError, BaselineError, SyntaxError) as e:
+            print("concheck: %s" % e, file=sys.stderr)
+            return 2
+        out = {"text": report.to_text, "json": report.to_json,
+               "sarif": report.to_sarif}[args.format]()
+        if args.output:
+            Path(args.output).parent.mkdir(parents=True, exist_ok=True)
+            Path(args.output).write_text(out)
+        print(out, end="")
+        gating = report.gating(args.fail_on)
+        if gating:
+            print("concheck: %d unsuppressed finding(s) at or above "
+                  "severity %r" % (len(gating), args.fail_on),
+                  file=sys.stderr)
+            return 1
+        return 0
+
     from ingress_plus_tpu.compiler.seclang import SecLangError
 
-    baseline = None if args.baseline == "none" else args.baseline
     try:
         report = run_rulecheck(rules_path=args.rules,
                                baseline_path=baseline)

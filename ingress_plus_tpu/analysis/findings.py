@@ -83,8 +83,11 @@ class BaselineError(Exception):
 class Baseline:
     """Accepted-findings list.  An entry matches a finding when the
     ``check`` ids are equal AND every anchor the entry names (rule_id,
-    subject, file) matches — an entry with only ``check`` set accepts
-    the whole class, which is deliberate for by-design info classes."""
+    subject, class, file) matches — an entry with only ``check`` set
+    accepts the whole class, which is deliberate for by-design info
+    classes.  ``class`` matches the owner part of a dotted subject
+    (``ConfirmResult.confirmed`` → ``ConfirmResult``) — concheck's
+    class-level suppression for single-owner handoff objects."""
 
     entries: List[Dict] = field(default_factory=list)
     path: str = ""
@@ -116,6 +119,9 @@ class Baseline:
                 continue
             if "subject" in e and e["subject"] != f.subject:
                 continue
+            if "class" in e and \
+                    e["class"] != f.subject.partition(".")[0]:
+                continue
             if "file" in e and e["file"] != Path(f.file).name:
                 continue
             return e
@@ -138,6 +144,12 @@ class Report:
     baseline_path: str = ""
     n_rules: int = 0
     pack_version: str = ""
+    #: which analyzer produced this report ("rulecheck" | "concheck") —
+    #: renderers brand their headers/driver from it
+    tool: str = "rulecheck"
+    #: tool-specific provenance (concheck: analyzed files, the thread
+    #: -root registry, the lock-order edge list)
+    meta: Optional[Dict] = None
     #: approximate-merge provenance of the audited pack (compiler
     #: ReductionReport dict; None = exact compile).  The prefilter audit
     #: certifies soundness THROUGH the reduction (widened/truncated
@@ -162,8 +174,8 @@ class Report:
     # ------------------------------------------------------------ renderers
 
     def to_json(self) -> str:
-        return json.dumps({
-            "tool": "rulecheck",
+        out = {
+            "tool": self.tool,
             "rules_path": self.rules_path,
             "baseline": self.baseline_path,
             "n_rules": self.n_rules,
@@ -174,11 +186,21 @@ class Report:
             "findings": [f.to_dict()
                          for f in sorted(self.findings,
                                          key=Finding.sort_key)],
-        }, indent=2, sort_keys=False) + "\n"
+        }
+        if self.meta is not None:
+            out["meta"] = self.meta
+        return json.dumps(out, indent=2, sort_keys=False) + "\n"
 
     def to_text(self) -> str:
-        lines = ["rulecheck: %d rules, pack %s" %
-                 (self.n_rules, self.pack_version or "?")]
+        if self.tool == "concheck":
+            m = self.meta or {}
+            lines = ["concheck: %d functions over %d files, "
+                     "%d thread roots"
+                     % (m.get("functions", 0), len(m.get("files", ())),
+                        len(m.get("thread_roots", ())))]
+        else:
+            lines = ["rulecheck: %d rules, pack %s" %
+                     (self.n_rules, self.pack_version or "?")]
         active = [f for f in self.findings if not f.suppressed]
         for f in sorted(active, key=Finding.sort_key):
             loc = Path(f.file).name if f.file else "-"
@@ -224,7 +246,7 @@ class Report:
             "version": "2.1.0",
             "runs": [{
                 "tool": {"driver": {
-                    "name": "rulecheck",
+                    "name": self.tool,
                     "informationUri": "docs/ANALYSIS.md",
                     "version": "1.0.0",
                     "rules": [{"id": cid,

@@ -442,8 +442,8 @@ def main(argv=None) -> int:
     ap.add_argument("cmd",
                     choices=["conf", "health", "metrics", "latency",
                              "tenants", "ruleset", "acl", "rulecheck",
-                             "rules", "drift", "breaker", "faults",
-                             "rollout", "scoring"])
+                             "concheck", "rules", "drift", "breaker",
+                             "faults", "rollout", "scoring"])
     ap.add_argument("--server", default="127.0.0.1:9901")
     ap.add_argument("--rules", default=None,
                     help="rulecheck: rules tree to analyze (default: "
@@ -467,13 +467,15 @@ def main(argv=None) -> int:
                          "--status-port JSON at this host:port")
     args = ap.parse_args(argv)
 
-    if args.cmd == "rulecheck":
+    if args.cmd in ("rulecheck", "concheck"):
         # local analysis, no serve plane involved — delegate to the
         # analyzer CLI so dbg and `python -m ingress_plus_tpu.analysis`
         # render and gate identically
         from ingress_plus_tpu.analysis.__main__ import main as rc_main
         rc_args = ["--fail-on", args.fail_on]
-        if args.rules:
+        if args.cmd == "concheck":
+            rc_args.append("--conc")
+        elif args.rules:
             rc_args += ["--rules", args.rules]
         return rc_main(rc_args)
 

@@ -70,10 +70,14 @@ import zlib
 from dataclasses import dataclass, field
 from pathlib import Path
 from queue import Empty, Full, Queue
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, TYPE_CHECKING, Tuple
+
+if TYPE_CHECKING:
+    from ingress_plus_tpu.serve.batcher import Batcher
 
 from ingress_plus_tpu.compiler.ruleset import CompiledRuleset
 from ingress_plus_tpu.utils import faults
+from ingress_plus_tpu.utils.trace import named_rlock
 
 #: rollout phases (ipt_rollout_state exports the index)
 STATES = ("idle", "admitted", "shadow", "canary", "live", "rejected",
@@ -248,7 +252,8 @@ class RolloutController:
     pipeline is installed/cleared only under the batcher's swap lock so
     the dispatch thread never sees a half-built generation."""
 
-    def __init__(self, batcher, config: Optional[RolloutConfig] = None):
+    def __init__(self, batcher: "Batcher",
+                 config: Optional[RolloutConfig] = None):
         self.batcher = batcher
         # _base_config is the attached default; each admit() derives its
         # EFFECTIVE config from it (base + that push's overrides), so an
@@ -290,7 +295,12 @@ class RolloutController:
         self.promotions = 0
         self.last_admission: Optional[dict] = None
         self.history: List[dict] = []    # bounded event log
-        self._lock = threading.Lock()
+        # REENTRANT: the accounting helpers below (_event,
+        # count_rejected, the shadow/canary counters) serialize on this
+        # lock and are called both bare and from under it — concheck
+        # found the bare counter bumps racing the shadow thread
+        # (conc.unguarded-mutation, ISSUE 11)
+        self._lock = named_rlock("RolloutController._lock")
         # shadow lane: bounded queue + one CPU worker + token budget
         self._shadow_q: "Queue" = Queue(maxsize=self.config.shadow_queue_cap)
         self._shadow_thread: Optional[threading.Thread] = None
@@ -308,13 +318,16 @@ class RolloutController:
     # ------------------------------------------------------- accounting
 
     def _event(self, kind: str, **kw) -> None:
-        self.history.append({"ts": time.time(), "event": kind, **kw})
-        del self.history[:-64]
+        with self._lock:
+            self.history.append({"ts": time.time(), "event": kind, **kw})
+            del self.history[:-64]
 
     def count_rejected(self, reason: str) -> None:
         """Also used by the serve endpoint for force-mode load failures
         (the ``ipt_swap_rejected_total{reason="load"}`` satellite)."""
-        self.swap_rejected[reason] = self.swap_rejected.get(reason, 0) + 1
+        with self._lock:
+            self.swap_rejected[reason] = \
+                self.swap_rejected.get(reason, 0) + 1
 
     def _reject(self, stage: str, reason: str, detail=None) -> None:
         self.count_rejected(reason)
@@ -369,7 +382,8 @@ class RolloutController:
                 self._admitting = False
 
     def _admit_inner(self, artifact_path, ruleset, paranoia_level) -> dict:
-        self.candidate_artifact = str(artifact_path or "")
+        with self._lock:
+            self.candidate_artifact = str(artifact_path or "")
         # stage 1: load ----------------------------------------------------
         if ruleset is None:
             try:
@@ -629,7 +643,8 @@ class RolloutController:
     def _admit_scoring_inner(self, artifact_path, head) -> dict:
         from ingress_plus_tpu.learn.head import LearnedScorer, ScoringHead
 
-        self.candidate_artifact = str(artifact_path or "")
+        with self._lock:
+            self.candidate_artifact = str(artifact_path or "")
         # stage 1: load (content hash verified inside load) -----------------
         if head is None:
             try:
@@ -754,9 +769,11 @@ class RolloutController:
             return
         try:
             self._shadow_q.put_nowait((request, live_verdict))
-            self.shadow_mirrored += 1
+            with self._lock:
+                self.shadow_mirrored += 1
         except Full:
-            self.shadow_dropped += 1
+            with self._lock:
+                self.shadow_dropped += 1
 
     def _shadow_run(self) -> None:
         cfg = self.config
@@ -771,37 +788,44 @@ class RolloutController:
             # CPU token budget: earn budget_frac of elapsed wall time,
             # spend measured scan seconds; broke → drop (counted)
             now = time.monotonic()
-            self._budget_s = min(
-                self._budget_s + (now - self._budget_at) *
-                cfg.shadow_cpu_budget, 1.0)
-            self._budget_at = now
-            if self._budget_s <= 0.0:
-                self.shadow_dropped += 1
+            with self._lock:
+                self._budget_s = min(
+                    self._budget_s + (now - self._budget_at) *
+                    cfg.shadow_cpu_budget, 1.0)
+                self._budget_at = now
+                broke = self._budget_s <= 0.0
+                if broke:
+                    self.shadow_dropped += 1
+            if broke:
                 continue
             t0 = time.monotonic()
             try:
                 if faults.fire("shadow_diverge"):
                     # injected divergence: the candidate "blocks" a
                     # request the incumbent passed (CI rollback drill)
-                    self.diff["new_block"] += 1
-                    self.shadow_compared += 1
+                    with self._lock:
+                        self.diff["new_block"] += 1
+                        self.shadow_compared += 1
                 else:
                     cv = cand.detect_cpu_only([request])[0]
                     self._diff_verdicts(live_v, cv)
             except Exception:
-                self.candidate_failures += 1
-            self._budget_s -= time.monotonic() - t0
+                with self._lock:
+                    self.candidate_failures += 1
+            with self._lock:
+                self._budget_s -= time.monotonic() - t0
             self._evaluate()
             self.tick()
 
     def _diff_verdicts(self, live_v, cand_v) -> None:
-        self.shadow_compared += 1
-        if cand_v.blocked and not live_v.blocked:
-            self.diff["new_block"] += 1
-        if live_v.attack and not cand_v.attack:
-            self.diff["lost_hit"] += 1
-        if cand_v.score != live_v.score:
-            self.diff["score_delta"] += 1
+        with self._lock:
+            self.shadow_compared += 1
+            if cand_v.blocked and not live_v.blocked:
+                self.diff["new_block"] += 1
+            if live_v.attack and not cand_v.attack:
+                self.diff["lost_hit"] += 1
+            if cand_v.score != live_v.score:
+                self.diff["score_delta"] += 1
 
     # ----------------------------------------------------- canary phase
 
@@ -825,11 +849,12 @@ class RolloutController:
     def observe_canary(self, n_served: int, verdicts) -> None:
         """Per-cycle canary accounting + trigger evaluation (dispatch
         thread, after the candidate sub-batch resolved)."""
-        self.candidate_requests += n_served
-        self.step_served += n_served
-        for v in verdicts:
-            if v.fail_open:
-                self.candidate_fail_open += 1
+        with self._lock:
+            self.candidate_requests += n_served
+            self.step_served += n_served
+            for v in verdicts:
+                if v.fail_open:
+                    self.candidate_fail_open += 1
         self._evaluate()
 
     def record_candidate_failure(self, reason: str) -> None:
@@ -837,7 +862,8 @@ class RolloutController:
         Candidate failures never feed the SHARED breaker — the incumbent
         path must keep its own failure signal clean; they trigger
         rollback instead."""
-        self.candidate_failures += 1
+        with self._lock:
+            self.candidate_failures += 1
         self._event("candidate_failure", reason=reason)
         self._evaluate()
 
@@ -945,12 +971,13 @@ class RolloutController:
         except Exception as e:
             self.rollback("promote_failed:%s" % type(e).__name__)
             return
-        self.promotions += 1
+        with self._lock:
+            self.promotions += 1
+            cr, self._candidate_cr = self._candidate_cr, None
+            head, self._candidate_head = self._candidate_head, None
+            self.candidate = None
         self._event("live", candidate=self.candidate_version,
                     rollout_kind=self.candidate_kind)
-        cr, self._candidate_cr = self._candidate_cr, None
-        head, self._candidate_head = self._candidate_head, None
-        self.candidate = None
         if self.config.lkg_dir and cr is not None:
             try:
                 persist_lkg(cr, self.config.lkg_dir)
@@ -978,7 +1005,7 @@ class RolloutController:
             self.state = ROLLED_BACK
             self.rollback_reason = reason
             self._clear_candidate()
-        self.rollbacks += 1
+            self.rollbacks += 1
         self.count_rejected("rollback_" + reason.partition(":")[0])
         self._quarantine(reason)
         self._event("rolled_back", reason=reason,
@@ -1017,7 +1044,8 @@ class RolloutController:
             self._clear_candidate()
         if self._shadow_thread is not None:
             self._shadow_thread.join(timeout=2)
-            self._shadow_thread = None
+            with self._lock:
+                self._shadow_thread = None
 
     # ------------------------------------------------------------ status
 

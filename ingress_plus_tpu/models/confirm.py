@@ -872,9 +872,9 @@ class ConfirmRule:
                     if lit in hay:
                         break
                 else:
-                    self.qr_skips += 1
+                    self.qr_skips += 1  # concheck: ok telemetry-grade counter race between confirm workers
                     return False
-                self.qr_evals += 1
+                self.qr_evals += 1  # concheck: ok telemetry-grade, same as qr_skips
             return self.rx.search(text) is not None
         if self.op == "pm":
             low = text.lower()
@@ -966,6 +966,7 @@ class ConfirmRule:
                 # the config outright; abstain is our fail-safe analog)
                 parsed = None
                 break
+        # concheck: ok idempotent lazy-init cache — racers compute identical values, last write wins
         self._ip_nets_cache = parsed or None
         return self._ip_nets_cache
 

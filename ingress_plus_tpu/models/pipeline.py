@@ -16,6 +16,7 @@ verdicts, never an outage.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -38,7 +39,7 @@ from ingress_plus_tpu.models.confirm_plane import (
 from ingress_plus_tpu.models.engine import DetectionEngine
 from ingress_plus_tpu.models.rule_stats import RuleStats
 from ingress_plus_tpu.utils import faults
-from ingress_plus_tpu.utils.trace import Ewma
+from ingress_plus_tpu.utils.trace import Ewma, named_lock
 
 #: wallarm_mode precedence (weakest → strongest).  Wire values (frame
 #: mode bits 0-1) are historical — safe_blocking arrived round 4 as
@@ -135,15 +136,34 @@ class PipelineStats:
     confirm_memo_hits: int = 0
     confirm_memo_misses: int = 0
 
+    #: the admission-shared counters (fail_open / degraded / shed /
+    #: scorer_diff) are bumped from every thread that can fail a
+    #: request open — submit callers, the dispatch thread, the
+    #: oversized side worker, the watchdog, confirm folds — so those
+    #: bumps serialize on this lock (concheck conc.unguarded-mutation
+    #: fix, ISSUE 11).  The per-batch hot counters (requests, rows,
+    #: engine_us, ...) stay single-writer under the batcher's swap
+    #: lock / bounded-call handoff and are lock-free on purpose.
+    _lock: threading.Lock = field(
+        default_factory=lambda: named_lock("PipelineStats._lock"),
+        repr=False, compare=False)
+
+    def count_fail_open(self, n: int = 1) -> None:
+        with self._lock:
+            self.fail_open += n
+
+    def count_degraded(self, n: int = 1) -> None:
+        with self._lock:
+            self.degraded += n
+
     def count_scorer_diff(self, kind: str) -> None:
-        """Single-writer like count_shed (finalize runs under the
-        batcher's swap lock; library callers are single-threaded)."""
-        self.scorer_diff[kind] = self.scorer_diff.get(kind, 0) + 1
+        with self._lock:
+            self.scorer_diff[kind] = self.scorer_diff.get(kind, 0) + 1
 
     def count_shed(self, reason: str) -> None:
-        """One admission shed (dict ops are GIL-atomic enough for the
-        single-writer submit path; readers snapshot with dict())."""
-        self.shed[reason] = self.shed.get(reason, 0) + 1
+        """One admission shed (readers snapshot with dict())."""
+        with self._lock:
+            self.shed[reason] = self.shed.get(reason, 0) + 1
 
     def reset_efficiency(self) -> None:
         """Zero the resettable device-efficiency group only (the
@@ -620,7 +640,7 @@ class DetectionPipeline:
             if not self.fail_open:
                 raise
             # fail-open contract (wallarm-fallback): pass + flag
-            self.stats.fail_open += len(requests)
+            self.stats.count_fail_open(len(requests))
             return [
                 Verdict(request_id=r.request_id, blocked=False, attack=False,
                         classes=[], rule_ids=[], score=0, fail_open=True)
@@ -660,8 +680,8 @@ class DetectionPipeline:
         except Exception:
             if not self.fail_open:
                 raise
-            self.stats.fail_open += len(requests)
-            self.stats.degraded += len(requests)
+            self.stats.count_fail_open(len(requests))
+            self.stats.count_degraded(len(requests))
             return [
                 Verdict(request_id=r.request_id, blocked=False,
                         attack=False, classes=[], rule_ids=[], score=0,
@@ -693,7 +713,7 @@ class DetectionPipeline:
         except Exception:
             if not self.fail_open:
                 raise
-            self.stats.fail_open += len(requests)
+            self.stats.count_fail_open(len(requests))
             return [
                 Verdict(request_id=r.request_id, blocked=False, attack=False,
                         classes=[], rule_ids=[], score=0, fail_open=True)
@@ -791,8 +811,8 @@ class DetectionPipeline:
             return fin
         st = self.stats
         if job.level >= 2:
-            st.fail_open += len(requests)
-            st.degraded += len(requests)
+            st.count_fail_open(len(requests))
+            st.count_degraded(len(requests))
             fin.verdicts = [
                 Verdict(request_id=r.request_id, blocked=False,
                         attack=False, classes=[], rule_ids=[], score=0,
@@ -848,8 +868,8 @@ class DetectionPipeline:
             # brownout floor for requests already queued before the
             # ladder reached fail-open (admission sheds new arrivals):
             # pass + flag, no scan work at all
-            self.stats.fail_open += len(requests)
-            self.stats.degraded += len(requests)
+            self.stats.count_fail_open(len(requests))
+            self.stats.count_degraded(len(requests))
             return [
                 Verdict(request_id=r.request_id, blocked=False, attack=False,
                         classes=[], rule_ids=[], score=0, fail_open=True,
@@ -890,7 +910,7 @@ class DetectionPipeline:
         # confirmed hits, and candidates over-approximate — fixed
         # weights keep the degraded path's never-blocks contract simple
         self.rule_stats.observe_finalize(rule_hits[:len(requests)], [], [])
-        self.stats.degraded += len(requests)
+        self.stats.count_degraded(len(requests))
         elapsed = int((time.perf_counter() - t0) * 1e6)
         for v in verdicts:
             v.elapsed_us = elapsed
@@ -1098,7 +1118,7 @@ class DetectionPipeline:
                 # wallarm-fallback answer — detection degrades for the
                 # wedged worker's share only, traffic does not
                 failed_rows.append(qi)
-                stats.fail_open += 1
+                stats.count_fail_open()
                 confirmed_rows.append([])
                 verdicts.append(Verdict(
                     request_id=req.request_id, blocked=False,

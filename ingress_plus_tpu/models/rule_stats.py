@@ -33,12 +33,13 @@ Prometheus gets per-FAMILY series with a hard label budget
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ingress_plus_tpu.utils.trace import named_lock
 
 
 def family_of(rule_id: int) -> str:
@@ -170,7 +171,7 @@ class RuleStats:
         # opt-in raw-bitmap capture (learned-scorer feature source);
         # None = off, the serve-plane default
         self.capture: Optional[BitmapRing] = None
-        self._lock = threading.Lock()
+        self._lock = named_lock("RuleStats._lock")
 
     # ---------------------------------------------------------- update
 

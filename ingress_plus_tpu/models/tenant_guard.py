@@ -52,7 +52,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
 
 from ingress_plus_tpu.post.topk import SpaceSaving
-from ingress_plus_tpu.utils.trace import Ewma
+from ingress_plus_tpu.utils.trace import Ewma, named_lock
 
 #: shared bucket for tenants past ``max_tracked`` — counted, never
 #: quarantined
@@ -132,7 +132,7 @@ class TenantGuard:
             raise ValueError("tenant-guard policy must be %s, got %r"
                              % ("|".join(GUARD_LEVELS[1:]),
                                 self.config.policy))
-        self._lock = threading.Lock()
+        self._lock = named_lock("TenantGuard._lock")
         self._states: Dict[int, _TenantState] = {}
         self._quarantined: Dict[int, float] = {}   # tenant → since ts
         self._win_touched: Set[int] = set()

@@ -5,9 +5,10 @@ forwards to the cloud.  Served by the serve loop at ``/wallarm-status``.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict
+
+from ingress_plus_tpu.utils.trace import named_lock
 
 
 def _bump(d: Dict, key, cap: int, overflow) -> None:
@@ -38,7 +39,7 @@ class NodeCounters:
     MAX_EXPORT_KEYS = 4 * 4096
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("NodeCounters._lock")
         self.started = time.time()
         self.requests = 0
         self.attacks = 0

@@ -19,8 +19,9 @@ Served under ``/wallarm-status`` as ``top_attacked`` (post/channel.py).
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional
+
+from ingress_plus_tpu.utils.trace import named_lock
 
 
 class SpaceSaving:
@@ -34,7 +35,7 @@ class SpaceSaving:
         self.capacity = capacity
         self._counts: Dict[str, int] = {}
         self._error: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("SpaceSaving._lock")
 
     def __len__(self) -> int:
         return len(self._counts)

@@ -54,7 +54,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from dataclasses import dataclass, replace
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional
 
 from ingress_plus_tpu.models.pipeline import DetectionPipeline, Verdict
@@ -80,6 +80,8 @@ from ingress_plus_tpu.utils.trace import (
     Histogram,
     SlowRing,
     TraceRing,
+    install_thread_excepthook,
+    named_lock,
 )
 
 #: backward-compat alias — the single-device worker grew into
@@ -149,7 +151,7 @@ class _TenantFairQueue:
         self.tenant_cap = tenant_cap or cap
         self.weights = dict(weights or {})
         self.quantum = quantum
-        self._lock = threading.Lock()
+        self._lock = named_lock("_TenantFairQueue._lock")
         self._not_empty = threading.Condition(self._lock)
         self._qs: Dict[int, deque] = {}
         self._ring: deque = deque()          # active tenant ids, DRR order
@@ -344,9 +346,30 @@ class BatcherStats:
     hangs: int = 0                 # device-lane hang-budget overruns
     cpu_fallback_batches: int = 0  # batches served breaker-open (CPU)
     watchdog_released: int = 0     # futures force-released by the monitor
+    #: admission-side counters (submitted / stream ingress) are bumped
+    #: by ARBITRARY caller threads (Batcher.submit is a declared
+    #: thread-safe API), so those bumps serialize on this lock — the
+    #: dispatch-thread-only counters stay lock-free single-writer
+    #: (concheck conc.unguarded-mutation fix, ISSUE 11)
+    _lock: threading.Lock = field(
+        default_factory=lambda: named_lock("BatcherStats._lock"),
+        repr=False, compare=False)
+
+    def count_submitted(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def count_stream_begin(self) -> None:
+        with self._lock:
+            self.streams += 1
+
+    def count_stream_chunk(self, nbytes: int) -> None:
+        with self._lock:
+            self.stream_chunks += 1
+            self.stream_bytes += nbytes
 
     def snapshot(self) -> dict:
-        d = self.__dict__.copy()
+        d = {k: v for k, v in self.__dict__.items() if k != "_lock"}
         if self.batches:
             d["avg_batch"] = self.completed / self.batches
             d["avg_batch_us"] = self.batch_us_sum / self.batches
@@ -455,11 +478,16 @@ class Batcher:
         self._watch_grace = (2.0 * hang_budget_s + hard_deadline_s + 1.0
                              + confirm_grace)
         self._stop = threading.Event()
-        self._swap_lock = threading.Lock()
+        self._swap_lock = named_lock("Batcher._swap_lock")
         # guarded-rollout controller (control/rollout.py), attached by
         # the serve layer; None keeps the clean path at two attribute
         # reads per cycle (docs/ROBUSTNESS.md "Guarded rollout")
         self.rollout = None
+        # silent-thread-death repair (ISSUE 11): uncaught exceptions in
+        # ANY worker thread count into ipt_thread_uncaught_total{thread=}
+        # and surface in /healthz — the runtime counterpart of
+        # concheck's lifecycle lint
+        install_thread_excepthook()
         self._watchdog = threading.Thread(target=self._watch, daemon=True,
                                           name="ipt-watchdog")
         self._watchdog.start()
@@ -474,7 +502,7 @@ class Batcher:
         # tenant's oversized request.  Lock shared by the dispatch
         # thread (submit side) and the oversized worker (release side).
         self._oversized_by_tenant: Dict[int, int] = {}
-        self._oversized_lock = threading.Lock()
+        self._oversized_lock = named_lock("Batcher._oversized_lock")
         self._oversized_thread = threading.Thread(
             target=self._run_oversized, daemon=True, name="ipt-oversized")
         self._oversized_thread.start()
@@ -503,14 +531,20 @@ class Batcher:
         Bench legs call this after warmup so every scraped observation
         layer — stage_breakdown and rule_stats alike — describes ONLY
         the measured traffic, not the synthetic warmup corpus or its
-        first-dispatch XLA compiles."""
+        first-dispatch XLA compiles.
+
+        Under the swap lock: the resets rebind the efficiency dicts and
+        per-lane stats that the dispatch thread mutates under this same
+        lock — a bare reset raced a mid-cycle fold (concheck
+        conc.unguarded-mutation, ISSUE 11)."""
         for h in self.hist.values():
             h.reset()
         self.batch_size_hist.reset()
         self.slow.reset()
-        for lane in self.lanes.lanes:
-            lane.stats = type(lane.stats)()
-        self.pipeline.reset_detection_observations()
+        with self._swap_lock:
+            for lane in self.lanes.lanes:
+                lane.stats = type(lane.stats)()
+            self.pipeline.reset_detection_observations()
 
     def queue_depth(self) -> int:
         return self._q.qsize()
@@ -538,8 +572,8 @@ class Batcher:
         ladder's verdicts (Verdict.degraded contract).  ``tenant``
         charges the shed to that tenant's guard counters."""
         st = self.pipeline.stats
-        st.fail_open += 1
-        st.degraded += 1
+        st.count_fail_open()
+        st.count_degraded()
         st.count_shed(reason)
         if tenant is not None and self.tenant_guard is not None:
             self.tenant_guard.on_shed(tenant, reason)
@@ -550,7 +584,7 @@ class Batcher:
 
     def submit(self, request: Request) -> "Future[Verdict]":
         fut: "Future[Verdict]" = Future()
-        self.stats.submitted += 1
+        self.stats.count_submitted()
         lc = self.pipeline.load_controller
         tenant = request.tenant
         g = self.tenant_guard
@@ -650,7 +684,7 @@ class Batcher:
                     pass
         if not ok:
             st = self.pipeline.stats
-            st.fail_open += 1
+            st.count_fail_open()
             st.count_shed("oversized_overload")
             if self.tenant_guard is not None:
                 self.tenant_guard.on_shed(tenant, "oversized_overload")
@@ -697,7 +731,7 @@ class Batcher:
             # Healthy sibling lanes don't help here (reviewer catch:
             # an any-lane-closed gate let this worker scan a wedged
             # default device).
-            self.pipeline.stats.fail_open += 1
+            self.pipeline.stats.count_fail_open()
             _safe_set(fut, _fail_open_verdict(request.request_id))
             return
         try:
@@ -720,7 +754,7 @@ class Batcher:
                 self.stream_engine.scan(h.flush())
                 v = self.stream_engine.finish(h)
         except Exception:
-            self.pipeline.stats.fail_open += 1
+            self.pipeline.stats.count_fail_open()
             v = Verdict(request_id=request.request_id, blocked=False,
                         attack=False, classes=[], rule_ids=[], score=0,
                         fail_open=True)
@@ -740,7 +774,7 @@ class Batcher:
         """Register a streaming request: uri/args/headers scan happens
         now (prefilter), body arrives via feed_chunk."""
         handle = self.stream_engine.begin(request)
-        self.stats.streams += 1
+        self.stats.count_stream_begin()
         g = self.tenant_guard
         if g is not None:
             # streams count toward the tenant's arrival share — a
@@ -778,8 +812,7 @@ class Batcher:
         return handle
 
     def feed_chunk(self, handle: StreamState, data: bytes) -> None:
-        self.stats.stream_chunks += 1
-        self.stats.stream_bytes += len(data)
+        self.stats.count_stream_chunk(len(data))
         if handle.error:
             return
         try:
@@ -805,8 +838,8 @@ class Batcher:
                                tenant=handle.request.tenant)
         except queue.Full:
             st = self.pipeline.stats
-            st.fail_open += 1
-            st.degraded += 1
+            st.count_fail_open()
+            st.count_degraded()
             self._count_stream_shed(handle.request.tenant)
             v = _fail_open_verdict(handle.request.request_id)
             v.degraded = True
@@ -864,12 +897,20 @@ class Batcher:
                     new.warm_lane_shape(buckets, q_pad, head, lane)
 
             warmers = [threading.Thread(target=_warm_lane, args=(i, s),
+                                        daemon=True,
                                         name="ipt-swapwarm-%d" % i)
                        for i, s in lane_shapes.items()]
             for t in warmers:
                 t.start()
+            # bounded join (concheck conc.join-no-timeout): warming is
+            # best-effort — a compile wedged past the budget must not
+            # hang the swap forever; the unwarmed shape just pays a
+            # serve-time compile, which the recompile gauge surfaces
+            warm_deadline = time.monotonic() + max(
+                2.0 * self.hang_budget_s, 60.0)
             for t in warmers:
-                t.join()
+                t.join(timeout=max(warm_deadline - time.monotonic(),
+                                   0.001))
         new.stats = old.stats  # counters span swaps (Prometheus contract)
         # the brownout ladder's pressure signal also spans swaps — a
         # reload under load must not reset the ladder to full detection
@@ -949,7 +990,7 @@ class Batcher:
             else:
                 rid = obj.request.request_id
                 tenant = obj.request.tenant
-            st.fail_open += 1
+            st.count_fail_open()
             st.count_shed(reason)
             if self.tenant_guard is not None:
                 # the per-tenant sub-queues drain fail-open at shutdown
@@ -980,7 +1021,7 @@ class Batcher:
                 _ts, request, _plan, fut = self._oversized_q.get_nowait()
             except queue.Empty:
                 break
-            self.pipeline.stats.fail_open += 1
+            self.pipeline.stats.count_fail_open()
             _safe_set(fut, _fail_open_verdict(request.request_id))
 
     # ------------------------------------------------------------ loop
@@ -1047,7 +1088,7 @@ class Batcher:
             st = self.pipeline.stats
             for h, fut in finishes:
                 h.error = True
-                st.fail_open += 1
+                st.count_fail_open()
                 v = _fail_open_verdict(h.request.request_id)
                 _safe_set(fut, v)
                 out.append((h, v))
@@ -1102,7 +1143,7 @@ class Batcher:
             # path calls detect_strict rather than detect
             lane.stats.errors += 1
             lane.breaker.record_failure()
-        p.stats.fail_open += len(requests)
+        p.stats.count_fail_open(len(requests))
         return [_fail_open_verdict(r.request_id) for r in requests]
 
     def _detect_candidate(self, requests: List[Request], ro,
@@ -1135,7 +1176,7 @@ class Batcher:
             ro.record_candidate_failure("hang")
         except Exception:
             ro.record_candidate_failure("error")
-        self.pipeline.stats.fail_open += len(requests)
+        self.pipeline.stats.count_fail_open(len(requests))
         return [_fail_open_verdict(r.request_id) for r in requests]
 
     def _arm_guard(self, t0: float, items: List) -> _CycleGuard:
@@ -1239,8 +1280,8 @@ class Batcher:
                 lane.stats.errors += 1
                 lane.breaker.record_failure()
         if verdicts is None:
-            p.stats.fail_open += len(dreqs)
-            p.stats.degraded += len(dreqs)
+            p.stats.count_fail_open(len(dreqs))
+            p.stats.count_degraded(len(dreqs))
             verdicts = []
             for r in dreqs:
                 v = _fail_open_verdict(r.request_id)
@@ -1480,7 +1521,7 @@ class Batcher:
             except Exception:
                 for rid, fut in c.guard.items:
                     if not fut.done():
-                        self.pipeline.stats.fail_open += 1
+                        self.pipeline.stats.count_fail_open()
                         _safe_set(fut, _fail_open_verdict(rid))
                 self._clear_guard(c.guard)
 
@@ -1585,7 +1626,7 @@ class Batcher:
                         # and count the failure against THIS lane only
                         lane.stats.errors += 1
                         lane.breaker.record_failure()
-                        c.pipeline.stats.fail_open += len(part)
+                        c.pipeline.stats.count_fail_open(len(part))
                         for _ts, r, fut in part:
                             _safe_set(fut,
                                       _fail_open_verdict(r.request_id))
@@ -1755,7 +1796,7 @@ class Batcher:
         """Resolve one lane share fail-open; returns its done-entries
         so the e2e histogram and slow ring still see these requests."""
         out = []
-        pipeline.stats.fail_open += len(part)
+        pipeline.stats.count_fail_open(len(part))
         for ts, r, fut in part:
             v = _fail_open_verdict(r.request_id)
             _safe_set(fut, v)
@@ -1803,7 +1844,9 @@ class Batcher:
                     for _lane, job in jobs:
                         self.pipeline.detect_collect(job, timeout=None)
         # warmup traffic must not pollute the detection telemetry
-        self.pipeline.reset_detection_observations()
+        # (under the swap lock, like reset_latency_observations)
+        with self._swap_lock:
+            self.pipeline.reset_detection_observations()
 
     def _watch(self) -> None:
         """Monitor thread: last-resort backstop for a wedged DISPATCH
@@ -1830,7 +1873,7 @@ class Batcher:
                 st = self.pipeline.stats
                 for rid, fut in guard.items:
                     if not fut.done():
-                        st.fail_open += 1
+                        st.count_fail_open()
                         _safe_set(fut, _fail_open_verdict(rid))
                         released += 1
                 if released:
@@ -1957,7 +2000,7 @@ class Batcher:
             try:
                 v = self.stream_engine.finish(h)
             except Exception:
-                self.pipeline.stats.fail_open += 1
+                self.pipeline.stats.count_fail_open()
                 v = Verdict(
                     request_id=h.request.request_id, blocked=False,
                     attack=False, classes=[], rule_ids=[], score=0,

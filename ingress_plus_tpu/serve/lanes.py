@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from ingress_plus_tpu.utils import faults
+from ingress_plus_tpu.utils.trace import named_lock
 
 
 class DeviceHang(Exception):
@@ -157,7 +158,7 @@ class CircuitBreaker:
         self.probes = 0
         self.last_trip_reason: Optional[str] = None
         self._opened_at = 0.0
-        self._lock = threading.Lock()
+        self._lock = named_lock("CircuitBreaker._lock")
 
     def route(self) -> str:
         """Where this lane's share goes: "device" | "canary" |

@@ -49,6 +49,7 @@ from ingress_plus_tpu.serve.protocol import (
     encode_response,
 )
 from ingress_plus_tpu.serve.websocket import DIR_C2S, DIR_S2C, WSStream
+from ingress_plus_tpu.utils.trace import thread_uncaught_counts
 
 
 MAX_STREAMS_PER_CONN = 256  # bounded per-connection stream state
@@ -193,8 +194,8 @@ class ServeLoop:
                                     if len(ws_shed) >= 4096:
                                         ws_shed.clear()
                                     ws_shed.add(stream_id)
-                                    self.batcher.pipeline.stats.fail_open \
-                                        += 1
+                                    self.batcher.pipeline.stats \
+                                        .count_fail_open()
                                 send_pass(req_id, fail_open=True)
                                 continue
                             off = frozenset(
@@ -273,7 +274,7 @@ class ServeLoop:
                             # bound of the non-stream path): excess
                             # streams pass fail-open, never accumulate
                             streams[req_id] = _OVERFLOW
-                            self.batcher.pipeline.stats.fail_open += 1
+                            self.batcher.pipeline.stats.count_fail_open()
                             continue
                         request.mode = eff_mode
                         first_chunk = request.body
@@ -454,6 +455,27 @@ class ServeLoop:
             "# TYPE ipt_cpu_fallback_batches_total counter",
             "ipt_cpu_fallback_batches_total %d" % s.cpu_fallback_batches,
         ]
+        # silent-thread-death repair (ISSUE 11): uncaught worker-thread
+        # exceptions by normalized thread name — the runtime counterpart
+        # of concheck's lifecycle lint.  Bounded label set: thread-name
+        # prefixes are a small closed family (ipt-*).
+        from ingress_plus_tpu.utils.trace import (
+            debug_locks_enabled,
+            lock_registry,
+        )
+        lines.append("# TYPE ipt_thread_uncaught_total counter")
+        lines += bounded_counter_series(
+            "ipt_thread_uncaught_total", "thread",
+            thread_uncaught_counts())
+        if debug_locks_enabled():
+            locks = lock_registry.snapshot()
+            lines += [
+                "# TYPE ipt_lock_order_violations gauge",
+                "ipt_lock_order_violations %d"
+                % locks["violation_count"],
+                "# TYPE ipt_lock_contended_total counter",
+                "ipt_lock_contended_total %d" % locks["contended"],
+            ]
         # --- per-device lane plane (docs/MESH_SERVING.md): one series
         # per lane, labeled device= — a single-lane server emits
         # device="0" so dashboards are mesh-shape-agnostic.  The
@@ -728,6 +750,10 @@ class ServeLoop:
                         self.batcher.tenant_guard.brief()
                         if self.batcher.tenant_guard is not None
                         else None),
+                    # silent-thread-death repair (ISSUE 11): uncaught
+                    # worker exceptions by thread family — nonzero here
+                    # means a thread died that nothing else surfaced
+                    "thread_uncaught": thread_uncaught_counts(),
                 },
             }).encode()
         if path.startswith("/readyz"):
@@ -1180,9 +1206,15 @@ class ServeLoop:
                                  | ({default} - names if default else set()))
                 if missing:   # validate BEFORE any mutation: atomic swap
                     raise ValueError("unknown acl(s) bound: %s" % missing)
-                loaded = pipeline.acl_store.swap(acl_specs)
-                pipeline.tenant_acl = binding
-                pipeline.default_acl = default
+                # under the batcher's swap lock: finalize reads the
+                # (acl_store, tenant_acl, default_acl) TRIPLE per batch
+                # — an executor-thread swap between those reads handed
+                # one request a new store with the old bindings
+                # (concheck conc.unguarded-mutation, ISSUE 11)
+                with self.batcher._swap_lock:
+                    loaded = pipeline.acl_store.swap(acl_specs)
+                    pipeline.tenant_acl = binding
+                    pipeline.default_acl = default
                 return loaded
 
             try:
@@ -1528,6 +1560,12 @@ def main(argv=None) -> None:
                     help="host:port of the native sidecar's --status-port"
                          " listener; /traces/request then includes the "
                          "sidecar hop's per-upstream EWMA timing")
+    ap.add_argument("--debug-locks", action="store_true",
+                    help="instrument every serve-plane lock "
+                         "(docs/ANALYSIS.md 'Concurrency analysis'): "
+                         "acquisition-order assertions + contention "
+                         "counters at /metrics; debugging aid, not for "
+                         "production hot paths")
     # fail-safe serve plane (docs/ROBUSTNESS.md)
     ap.add_argument("--queue-cap", type=int, default=8192,
                     help="bounded admission: max queued items; beyond "
@@ -1602,6 +1640,13 @@ def main(argv=None) -> None:
                                            seed=args.faults_seed))
     else:
         faults_mod.install_from_env()
+
+    if args.debug_locks:
+        # BEFORE the batcher builds: named_lock() returns instrumented
+        # locks only for objects constructed after this point
+        from ingress_plus_tpu.utils.trace import enable_debug_locks
+
+        enable_debug_locks(True)
 
     if args.platform:
         import jax

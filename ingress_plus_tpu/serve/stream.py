@@ -401,7 +401,7 @@ class StreamEngine:
         p = self.pipeline
         req = st.request
         if st.error or st.version != p.ruleset.version:
-            p.stats.fail_open += 1
+            p.stats.count_fail_open()
             return Verdict(request_id=req.request_id, blocked=False,
                            attack=False, classes=[], rule_ids=[], score=0,
                            fail_open=True, elapsed_us=int(

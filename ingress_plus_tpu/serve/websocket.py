@@ -369,6 +369,6 @@ class WSStream:
         self.poisoned = True
         self.abort()
         try:
-            self.batcher.pipeline.stats.fail_open += 1
+            self.batcher.pipeline.stats.count_fail_open()
         except Exception:
             pass

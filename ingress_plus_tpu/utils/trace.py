@@ -32,14 +32,239 @@ profiles the Go side with pprof.  The TPU-native equivalents:
 from __future__ import annotations
 
 import heapq
+import os
 import re
 import threading
 import time
+import traceback
 from bisect import bisect_left
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
+
+
+# ----------------------------------------------------- instrumented locks
+# The runtime twin of the static concurrency analyzer (docs/ANALYSIS.md
+# "Concurrency analysis"): opt-in (env IPT_DEBUG_LOCKS / --debug-locks /
+# enable_debug_locks()).  When OFF — the production default —
+# named_lock() returns a plain threading.Lock and the serve plane pays
+# nothing.  When ON, every named_lock is an InstrumentedLock that
+# records per-thread acquisition order into a global LockRegistry:
+# nested-acquisition edges (the runtime lock-order graph, compared
+# against concheck's static one), ORDER VIOLATIONS (lock pair observed
+# in both orders — the dynamic face of conc.lock-order-cycle), and
+# contention counts.  tools/lint.py flips this on for the faultmatrix
+# run, so the 15 fault scenarios double as a race stress harness at
+# zero extra CI cost.
+
+_DEBUG_LOCKS = os.environ.get("IPT_DEBUG_LOCKS", "") not in ("", "0")
+
+
+def debug_locks_enabled() -> bool:
+    return _DEBUG_LOCKS
+
+
+def enable_debug_locks(on: bool = True) -> None:
+    """Flip lock instrumentation for locks created FROM NOW ON (existing
+    plain locks are untouched — callers construct their objects after
+    enabling, e.g. the faultmatrix building fresh batchers)."""
+    global _DEBUG_LOCKS
+    _DEBUG_LOCKS = bool(on)
+
+
+class LockRegistry:
+    """Process-global acquisition-order ledger for instrumented locks."""
+
+    MAX_VIOLATIONS = 64
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.edges: Dict[Tuple[str, str], int] = {}
+        self.violations: List[dict] = []
+        self.acquisitions = 0
+        self.contended = 0
+
+    def note_acquire(self, name: str,
+                     held: Sequence["InstrumentedLock"]) -> None:
+        with self._lock:
+            self.acquisitions += 1
+            for h in held:
+                if h.name == name:
+                    continue
+                edge = (h.name, name)
+                self.edges[edge] = self.edges.get(edge, 0) + 1
+                rev = (name, h.name)
+                if rev in self.edges:
+                    if len(self.violations) < self.MAX_VIOLATIONS:
+                        self.violations.append({
+                            "pair": [h.name, name],
+                            "thread": threading.current_thread().name,
+                            "stack": "".join(
+                                traceback.format_stack(limit=8)),
+                        })
+
+    def note_contention(self) -> None:
+        with self._lock:
+            self.contended += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "acquisitions": self.acquisitions,
+                "contended": self.contended,
+                "edges": sorted("%s -> %s" % e for e in self.edges),
+                "violations": [dict(v, stack=v["stack"].splitlines()[-4:])
+                               for v in self.violations],
+                "violation_count": len(self.violations),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.edges.clear()
+            self.violations.clear()
+            self.acquisitions = 0
+            self.contended = 0
+
+    def assert_consistent_with(self, static_edges: Sequence[str]) -> List[str]:
+        """Order-consistency against the static lock-order graph
+        (concheck's ``meta.lock_order_edges``): every runtime edge whose
+        REVERSE appears statically is a latent deadlock the static
+        analyzer must be told about.  Returns the offending edges."""
+        static = set(static_edges)
+        with self._lock:
+            runtime = {"%s -> %s" % e for e in self.edges}
+        out = []
+        for e in runtime:
+            a, _, b = e.partition(" -> ")
+            if "%s -> %s" % (b, a) in static:
+                out.append(e)
+        return out
+
+
+#: the process-wide registry instrumented locks report into
+lock_registry = LockRegistry()
+
+_held_locks = threading.local()
+
+
+class InstrumentedLock:
+    """Drop-in threading.Lock that records acquisition order, order
+    violations, and contention into :data:`lock_registry`.  Works as a
+    ``threading.Condition`` backing lock (Condition only needs
+    acquire/release/locked and falls back gracefully for the rest)."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str = "lock", rlock: bool = False):
+        self.name = name
+        self._inner = threading.RLock() if rlock else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(False)
+        if not got:
+            if not blocking:
+                return False
+            lock_registry.note_contention()
+            got = self._inner.acquire(True, timeout)
+            if not got:
+                return False
+        stack = getattr(_held_locks, "stack", None)
+        if stack is None:
+            stack = _held_locks.stack = []
+        lock_registry.note_acquire(self.name, stack)
+        stack.append(self)
+        return True
+
+    def release(self) -> None:
+        stack = getattr(_held_locks, "stack", None)
+        if stack is not None:
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] is self:
+                    del stack[i]
+                    break
+        self._inner.release()
+
+    def locked(self) -> bool:
+        probe = getattr(self._inner, "locked", None)
+        if probe is not None:
+            return probe()
+        # RLock has no locked() before 3.14: probe non-blocking (an
+        # owner's re-acquire succeeds, reading as unlocked — fine for
+        # the debug-surface uses of this method)
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def named_lock(name: str) -> "threading.Lock | InstrumentedLock":
+    """The ONE lock constructor of the serve plane: a plain
+    threading.Lock in production (zero overhead, zero behavior change),
+    an :class:`InstrumentedLock` when lock debugging is on."""
+    if _DEBUG_LOCKS:
+        return InstrumentedLock(name)
+    return threading.Lock()
+
+
+def named_rlock(name: str):
+    """Reentrant variant (the rollout state machine's lock: its
+    accounting helpers are called both with and without the lock
+    held)."""
+    if _DEBUG_LOCKS:
+        return InstrumentedLock(name, rlock=True)
+    return threading.RLock()
+
+
+# ------------------------------------------------- silent-thread-death
+# Runtime counterpart of concheck's lifecycle lint: an uncaught
+# exception killing a worker thread used to vanish into stderr.  The
+# serve plane installs this hook (Batcher.__init__); /healthz surfaces
+# the counts and /metrics exports ipt_thread_uncaught_total{thread=}.
+
+_uncaught_lock = threading.Lock()
+_uncaught_counts: Dict[str, int] = {}
+_hook_installed = False
+_THREAD_SUFFIX_RE = re.compile(r"[-_]\d+$")
+
+
+def install_thread_excepthook() -> None:
+    """Idempotently wrap ``threading.excepthook``: count uncaught
+    worker-thread exceptions by normalized thread name (ipt-device-3 →
+    ipt-device) and chain to the previous hook so the traceback still
+    prints."""
+    global _hook_installed
+    with _uncaught_lock:
+        if _hook_installed:
+            return
+        _hook_installed = True
+        prev = threading.excepthook
+
+        def hook(args) -> None:
+            name = getattr(args.thread, "name", None) or "unknown"
+            base = _THREAD_SUFFIX_RE.sub("", name) or name
+            with _uncaught_lock:
+                _uncaught_counts[base] = _uncaught_counts.get(base, 0) + 1
+            prev(args)
+
+        threading.excepthook = hook
+
+
+def thread_uncaught_counts() -> Dict[str, int]:
+    with _uncaught_lock:
+        return dict(_uncaught_counts)
+
+
+def reset_thread_uncaught_counts() -> None:
+    with _uncaught_lock:
+        _uncaught_counts.clear()
 
 #: log2-scaled µs bucket upper bounds: 1µs … ~8.4s, factor-2 resolution
 #: (24 finite buckets + the implicit +Inf overflow).  Fixed at import
@@ -92,7 +317,7 @@ class Histogram:
         self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf overflow
         self.total = 0
         self.sum_us = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("Histogram._lock")
 
     def observe(self, us: float) -> None:
         us_i = int(us)
@@ -180,7 +405,7 @@ class TraceRing:
 
     def __init__(self, capacity: int = 256):
         self._ring: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = named_lock("TraceRing._lock")
 
     def record(self, trace: BatchTrace) -> None:
         with self._lock:
@@ -226,7 +451,7 @@ class SlowRing:
         self.capacity = capacity
         self._heap: List[Tuple[int, int, dict]] = []
         self._seq = 0           # tie-break: dicts don't compare
-        self._lock = threading.Lock()
+        self._lock = named_lock("SlowRing._lock")
 
     def offer(self, e2e_us: int, exemplar: dict) -> None:
         with self._lock:
@@ -271,29 +496,40 @@ class SlowRing:
 
 class Ewma:
     """Exponentially weighted moving average — the load signal of the
-    brownout ladder (models/pipeline.py LoadController) and the
-    batcher's queue-wait estimator (admission-time deadline shedding).
-    Single-writer (the dispatch thread); readers see a torn-free float
-    via the GIL."""
+    brownout ladder (models/pipeline.py LoadController), the batcher's
+    queue-wait estimator (admission-time deadline shedding), and the
+    per-tenant rate/shed estimators (models/tenant_guard.py).
 
-    __slots__ = ("alpha", "value")
+    ``update`` is a read-modify-write, and Ewmas now live on more than
+    one thread boundary (dispatch-thread fold vs submit-thread tenant
+    windows), so updates serialize on a tiny per-instance lock —
+    concheck flagged the bare RMW (conc.unguarded-mutation, the
+    lost-update class); updates are per-cycle/per-window, never
+    per-request, so the acquire is noise.  ``get`` stays lock-free: a
+    float read is torn-free under the GIL and a stale sample only
+    shifts the EWMA by one observation."""
+
+    __slots__ = ("alpha", "value", "_lock")
 
     def __init__(self, alpha: float = 0.2):
         self.alpha = alpha
         self.value: Optional[float] = None
+        self._lock = named_lock("Ewma._lock")
 
     def update(self, x: float) -> float:
-        v = self.value
-        self.value = x if v is None else self.alpha * x \
-            + (1.0 - self.alpha) * v
-        return self.value
+        with self._lock:
+            v = self.value
+            self.value = out = x if v is None else self.alpha * x \
+                + (1.0 - self.alpha) * v
+        return out
 
     def get(self, default: float = 0.0) -> float:
         v = self.value
         return default if v is None else v
 
     def reset(self) -> None:
-        self.value = None
+        with self._lock:
+            self.value = None
 
 
 def bounded_counter_series(name: str, label: str,

@@ -476,6 +476,9 @@ def test_readyz_faults_and_metrics_endpoints(serve_loop):
     rb = health["robustness"]
     assert rb["breaker"]["state"] == "closed"
     assert rb["ladder"]["mode"] == "full"
+    # silent-thread-death repair (ISSUE 11): the uncaught-exception
+    # counter block is always present (a dict, usually empty)
+    assert isinstance(rb["thread_uncaught"], dict)
     # ready while healthy
     code, body = _get(port, "/readyz")
     assert code == 200 and json.loads(body)["ready"]
@@ -538,7 +541,8 @@ def test_readyz_faults_and_metrics_endpoints(serve_loop):
                  "ipt_breaker_state", "ipt_breaker_trips_total",
                  "ipt_watchdog_hangs_total",
                  "ipt_cpu_fallback_batches_total",
-                 "ipt_degraded_verdicts_total"):
+                 "ipt_degraded_verdicts_total",
+                 "ipt_thread_uncaught_total"):
         assert name in metrics, name
     # shed series appears once something was shed
     b.pipeline.stats.count_shed("queue_full")

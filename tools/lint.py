@@ -14,6 +14,12 @@ Four gates, one verdict:
   rulecheck  the ruleset static analyzer (ingress_plus_tpu/analysis/,
              docs/ANALYSIS.md) over the bundled CRS tree: zero
              unsuppressed error-severity findings required
+  concheck   the serve-plane CONCURRENCY static analyzer
+             (docs/ANALYSIS.md "Concurrency analysis"): thread-boundary
+             map, guarded-by inference + unguarded-mutation findings,
+             lock-order cycles, thread-lifecycle lint — zero
+             unsuppressed error-severity findings required
+             (reports/CONCHECK.json)
   deadrules  the RUNTIME twin of rulecheck (docs/OBSERVABILITY.md,
              detection-plane telemetry): the bench corpus runs through
              a CPU pipeline and any runtime-dead rule (confirm regex
@@ -64,12 +70,12 @@ REPO = Path(__file__).resolve().parent.parent
 if str(REPO) not in sys.path:  # script execution puts tools/ first
     sys.path.insert(0, str(REPO))
 #: the mypy gate is TARGETED: the correctness-critical planes first;
-#: widen as modules gain annotations (zero-warning baseline per scope)
+#: widen as modules gain annotations (zero-warning baseline per scope).
+#: ISSUE 11 widened models/ to the whole package (pipeline.py and every
+#: tenant_guard caller) — ops/ stays out (device-kernel code).
 MYPY_SCOPE = ["ingress_plus_tpu/compiler", "ingress_plus_tpu/analysis",
               "ingress_plus_tpu/serve",   # includes serve/lanes.py
-              "ingress_plus_tpu/models/rule_stats.py",
-              "ingress_plus_tpu/models/confirm_plane.py",
-              "ingress_plus_tpu/models/tenant_guard.py",
+              "ingress_plus_tpu/models",  # pipeline + tenant_guard callers
               "ingress_plus_tpu/post/topk.py",
               "ingress_plus_tpu/control/rollout.py",
               "ingress_plus_tpu/parallel/serve_mesh.py",
@@ -135,6 +141,38 @@ def run_rulecheck(write_report: bool) -> dict:
     return result
 
 
+def run_concheck_gate(write_report: bool) -> dict:
+    """Concurrency static analysis of the serve-plane sources (ISSUE
+    11, docs/ANALYSIS.md "Concurrency analysis"): zero unsuppressed
+    error-severity findings — unguarded cross-thread mutations,
+    live-view escapes, lock-order cycles, lifecycle lint."""
+    from ingress_plus_tpu.analysis.concheck import run_concheck as cc
+    t0 = time.time()
+    report = cc()
+    gating = report.gating("error")
+    meta = report.meta or {}
+    result = {
+        "status": "OK" if not gating else "FAIL",
+        "seconds": round(time.time() - t0, 2),
+        "counts": report.counts(),
+        "suppressed": sum(report.counts(suppressed=True).values()),
+        "functions": meta.get("functions"),
+        "thread_roots": len(meta.get("thread_roots", ())),
+        "lock_order_edges": len(meta.get("lock_order_edges", ())),
+        "detail": "; ".join("%s %s (%s)" % (f.severity, f.check,
+                                            f.subject)
+                            for f in gating) or
+                  "%d findings, 0 unsuppressed errors over %d functions"
+                  % (len(report.findings), meta.get("functions", 0)),
+    }
+    if write_report:
+        out = REPO / "reports" / "CONCHECK.json"
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(report.to_json())
+        result["report"] = str(out.relative_to(REPO))
+    return result
+
+
 def run_dead_rules() -> dict:
     """Runtime dead-rule gate (ISSUE 3): compile the bundled pack,
     drive the bench corpus through a CPU pipeline, and fail on any
@@ -187,25 +225,53 @@ def run_dead_rules() -> dict:
 def run_faultmatrix(write_report: bool) -> dict:
     """Fail-safe serve-plane gate (docs/ROBUSTNESS.md): every fault
     scenario + the overload burst against a real CPU batcher; any
-    invariant violation fails CI."""
+    invariant violation fails CI.
+
+    Runs with InstrumentedLock debugging ON (docs/ANALYSIS.md
+    "Concurrency analysis"): every batcher the 15 scenarios build gets
+    order-asserting locks, so the fault matrix doubles as a race/
+    deadlock stress harness — any lock-pair observed in both orders
+    fails the gate."""
     t0 = time.time()
     from ingress_plus_tpu.utils.platform import force_cpu_devices
 
     force_cpu_devices(1)
     from ingress_plus_tpu.utils.faults import run_fault_matrix
+    from ingress_plus_tpu.utils.trace import (
+        debug_locks_enabled,
+        enable_debug_locks,
+        lock_registry,
+    )
 
-    report = run_fault_matrix()
+    lock_registry.reset()
+    was_on = debug_locks_enabled()
+    enable_debug_locks(True)
+    try:
+        report = run_fault_matrix()
+    finally:
+        enable_debug_locks(was_on)
+    locks = lock_registry.snapshot()
+    report["lock_order"] = locks
+    lock_violations = locks["violation_count"]
     failed = {name: r["violations"]
               for name, r in report["scenarios"].items() if not r["ok"]}
+    if lock_violations:
+        failed["lock_order"] = ["%s <-> %s" % tuple(v["pair"])
+                                for v in locks["violations"]]
     result = {
-        "status": "OK" if report["passed"] else "FAIL",
+        "status": ("OK" if report["passed"] and not lock_violations
+                   else "FAIL"),
         "seconds": round(time.time() - t0, 2),
         "scenarios": {name: r["ok"]
                       for name, r in report["scenarios"].items()},
+        "lock_acquisitions": locks["acquisitions"],
+        "lock_order_violations": lock_violations,
         "detail": "; ".join("%s: %s" % (n, "; ".join(v))
                             for n, v in failed.items()) or
-                  "%d scenarios, invariant held under every fault"
-                  % len(report["scenarios"]),
+                  "%d scenarios, invariant held under every fault; "
+                  "%d instrumented lock acquisitions, 0 order "
+                  "violations"
+                  % (len(report["scenarios"]), locks["acquisitions"]),
     }
     if write_report:
         out = REPO / "reports" / "FAULTMATRIX.json"
@@ -320,8 +386,9 @@ def main(argv=None) -> int:
     ap.add_argument("--ci", action="store_true",
                     help="CI mode: also write reports/RULECHECK.json")
     ap.add_argument("--only",
-                    choices=["ruff", "mypy", "rulecheck", "deadrules",
-                             "faultmatrix", "swapdrill", "modelgate"],
+                    choices=["ruff", "mypy", "rulecheck", "concheck",
+                             "deadrules", "faultmatrix", "swapdrill",
+                             "modelgate"],
                     default=None)
     args = ap.parse_args(argv)
 
@@ -332,6 +399,8 @@ def main(argv=None) -> int:
         gates["mypy"] = run_mypy()
     if args.only in (None, "rulecheck"):
         gates["rulecheck"] = run_rulecheck(write_report=args.ci)
+    if args.only in (None, "concheck"):
+        gates["concheck"] = run_concheck_gate(write_report=args.ci)
     if args.only in (None, "deadrules"):
         gates["deadrules"] = run_dead_rules()
     if args.only in (None, "faultmatrix"):
