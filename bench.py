@@ -514,6 +514,31 @@ def run_mesh_scale(points=(1, 2, 4, 8),
     else:
         log("MESHSCALE WARNING: no point carried a confirm_share — "
             "the confirm-bound check was NOT evaluated this round")
+    # measured overlap structure (ISSUE 12): every point carries the
+    # flight recorder's pipeline_overlap; the widest point's block is
+    # promoted and checked against the PR 7/9 design claims — a
+    # contradiction is LOUD, never a silently-recorded number
+    widest_po = max((m for m in results if m.get("pipeline_overlap")),
+                    key=lambda m: m["n_lanes"], default=None)
+    if widest_po is not None:
+        from ingress_plus_tpu.utils.overlap import check_claims
+        po = widest_po["pipeline_overlap"]
+        result["pipeline_overlap_widest"] = po
+        log("MESHSCALE overlap at %d lanes: scan<->confirm=%s "
+            "drain_occ=%s critical=%s"
+            % (widest_po["n_lanes"], po.get("scan_confirm_overlap"),
+               po.get("drain_occupancy"),
+               "/".join("%s:%d" % kv
+                        for kv in (po.get("critical_path") or {})
+                        .items())))
+        for w in check_claims(po):
+            log("=" * 64)
+            log("MESHSCALE PIPELINE OVERLAP WARNING: %s" % w)
+            log("=" * 64)
+    else:
+        log("MESHSCALE WARNING: no point carried a pipeline_overlap — "
+            "the flight recorder measured nothing this round (overlap "
+            "claims unverified)")
     if out_path is None:
         out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "reports", "MESHSCALE.json")
@@ -1610,6 +1635,33 @@ def run_latency_leg(cr, scan_impl: str, platform: str,
                 % (cp["confirm_share"],
                    cp["quick_reject"].get("skip_rate"),
                    cp["memo_hits"], cp["confirm_workers"]))
+        # cycle flight recorder (ISSUE 12, docs/OBSERVABILITY.md):
+        # the MEASURED pipeline-overlap block — scan↔confirm overlap
+        # fraction, per-lane idle share, drain occupancy, critical-path
+        # ranking, serialized residue.  The recorder was reset with the
+        # latency observations, so this describes only the measured
+        # pass.  Missing is LOUD; a measured contradiction of the
+        # PR 7/9 overlap claims (or one thread holding >60% of the
+        # critical path) is LOUDER.
+        from ingress_plus_tpu.utils.overlap import check_claims, collect
+        po = collect(batcher)
+        if not po:
+            log("WARNING: latency leg has NO pipeline_overlap block — "
+                "the flight recorder captured no cycles; the overlap "
+                "structure is unmeasured this round")
+        else:
+            lat["pipeline_overlap"] = po
+            top = (po["serialized_residue"] or [{}])[0]
+            log("pipeline overlap: scan<->confirm=%s drain_occ=%.3f "
+                "critical=%s bounding=%s(%.2f excl)"
+                % (po["scan_confirm_overlap"], po["drain_occupancy"],
+                   "/".join("%s:%d" % kv
+                            for kv in po["critical_path"].items()),
+                   top.get("thread"), top.get("exclusive_share", 0.0)))
+            for w in check_claims(po):
+                log("=" * 64)
+                log("PIPELINE OVERLAP WARNING: %s" % w)
+                log("=" * 64)
         # fail-safe plane sanity (docs/ROBUSTNESS.md): the CLEAN latency
         # leg must never shed, degrade, or trip the breaker — any of
         # those here means the fail-safe layer is costing the happy

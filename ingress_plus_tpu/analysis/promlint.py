@@ -1,0 +1,162 @@
+"""Prometheus exposition hygiene lint (ISSUE 12 satellite).
+
+The /metrics exposition grew hand-rolled across 11 PRs; nothing ever
+checked it against the conventions scrapers and dashboards assume.
+This checker parses one text-format scrape and enforces:
+
+* **naming** — every metric carries the ``ipt_`` namespace prefix;
+  counters end in ``_total`` (or ``_sum``/``_count`` — the cumulative
+  microsecond counters like ``ipt_batch_us_sum`` predate this lint and
+  follow the histogram-component convention);
+* **metadata** — every emitted series has a ``# TYPE`` line, and every
+  ``# TYPE`` a ``# HELP`` (the serve loop guarantees the pair via
+  ``server._with_help``; the lint guards hand-added lines that bypass
+  it);
+* **bounded cardinality** — no label (other than ``le``) may exceed
+  ``series_cap`` distinct values: the ``bounded_counter_series``
+  budget is 30 + the "other" fold, so a per-rule or per-tenant series
+  slipping into the exposition unfolded fails on its FIRST scrape, not
+  after a dashboard dies;
+* **histogram shape** — ``_bucket`` series carry ``le``, include
+  ``+Inf``, and the cumulative counts are monotonic;
+* **values parse** — every sample value is a float (NaN allowed: the
+  efficiency gauges are NaN until the first dispatch by design).
+
+``check_exposition`` returns finding strings (empty = clean); the
+``promlint`` gate in tools/lint.py scrapes an in-process ServeLoop
+after real traffic so the tenant/family folds are actually exercised.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Set, Tuple
+
+#: bounded_counter_series caps at 30 verbatim + "other"; lanes and
+#: stages are small closed sets.  Anything past this is an unbounded
+#: label escaping the budget.
+DEFAULT_SERIES_CAP = 40
+
+_SERIES_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)\s*$")
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+_META_RE = re.compile(
+    r"^# (?P<kind>TYPE|HELP) (?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\s+(?P<rest>.*))?$")
+
+#: suffixes that resolve a series back to its declared metric family
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+#: counter naming: _total is the convention; _sum/_count are accepted
+#: for cumulative histogram-component counters (documented above)
+_COUNTER_SUFFIXES = ("_total", "_sum", "_count")
+
+
+def _base_name(name: str, types: Dict[str, str]) -> str:
+    """Resolve a series name to the declared metric it samples
+    (histogram/summary components strip their suffix)."""
+    if name in types:
+        return name
+    for suf in _HIST_SUFFIXES:
+        if name.endswith(suf) and name[: -len(suf)] in types:
+            return name[: -len(suf)]
+    return name
+
+
+def check_exposition(text: str,
+                     prefix: str = "ipt_",
+                     series_cap: int = DEFAULT_SERIES_CAP) -> List[str]:
+    findings: List[str] = []
+    types: Dict[str, str] = {}
+    helps: Set[str] = set()
+    #: (metric, label) -> distinct values
+    label_values: Dict[Tuple[str, str], Set[str]] = {}
+    #: histogram buckets: (metric, non-le labelset) -> [(le, value)]
+    buckets: Dict[Tuple[str, str], List[Tuple[float, float]]] = {}
+    seen_series: Set[str] = set()
+
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _META_RE.match(line)
+            if m is None:
+                findings.append("line %d: malformed comment %r"
+                                % (lineno, line[:60]))
+                continue
+            if m.group("kind") == "TYPE":
+                types[m.group("name")] = (m.group("rest") or "").strip()
+            else:
+                helps.add(m.group("name"))
+            continue
+        m = _SERIES_RE.match(line)
+        if m is None:
+            findings.append("line %d: unparsable series line %r"
+                            % (lineno, line[:60]))
+            continue
+        name = m.group("name")
+        seen_series.add(name)
+        try:
+            val = float(m.group("value"))
+        except ValueError:
+            findings.append("line %d: %s value %r is not a float"
+                            % (lineno, name, m.group("value")))
+            continue
+        labels = dict(_LABEL_RE.findall(m.group("labels") or ""))
+        base = _base_name(name, types)
+        for k, v in labels.items():
+            if k == "le":
+                continue
+            label_values.setdefault((base, k), set()).add(v)
+        if name.endswith("_bucket"):
+            le = labels.get("le")
+            if le is None:
+                findings.append("line %d: %s has no le label"
+                                % (lineno, name))
+            else:
+                key = (base, ",".join(
+                    "%s=%s" % kv for kv in sorted(labels.items())
+                    if kv[0] != "le"))
+                lev = math.inf if le == "+Inf" else float(le)
+                buckets.setdefault(key, []).append((lev, val))
+
+    # naming + metadata per declared or sampled metric family
+    for name in sorted(seen_series):
+        base = _base_name(name, types)
+        if not name.startswith(prefix):
+            findings.append("%s: missing the %s namespace prefix"
+                            % (name, prefix))
+        if base not in types:
+            findings.append("%s: series has no # TYPE line" % name)
+    for base, mtype in sorted(types.items()):
+        if base not in helps:
+            findings.append("%s: # TYPE without # HELP" % base)
+        if mtype == "counter" and not base.endswith(_COUNTER_SUFFIXES):
+            findings.append(
+                "%s: TYPE counter but name lacks a _total/_sum/_count "
+                "suffix" % base)
+
+    # bounded cardinality: the first offender is the finding (the gate
+    # fails fast — an unbounded per-rule/per-tenant series is a scrape
+    # bomb, not a style nit)
+    for (base, label), values in sorted(label_values.items()):
+        if len(values) > series_cap:
+            findings.append(
+                "%s{%s=}: %d distinct label values (cap %d) — an "
+                "unbounded series escaped the bounded_counter_series "
+                "fold" % (base, label, len(values), series_cap))
+
+    # histogram shape: +Inf present, cumulative counts monotonic
+    for (base, labelset), pts in sorted(buckets.items()):
+        pts.sort(key=lambda p: p[0])
+        if not pts or pts[-1][0] != math.inf:
+            findings.append("%s{%s}: histogram without a +Inf bucket"
+                            % (base, labelset))
+        vals = [v for _, v in pts]
+        if any(b < a for a, b in zip(vals, vals[1:])):
+            findings.append("%s{%s}: non-monotonic cumulative bucket "
+                            "counts" % (base, labelset))
+    return findings

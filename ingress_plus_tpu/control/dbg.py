@@ -103,16 +103,26 @@ def render_latency(metrics_text: str, slow: dict,
     ex = slow.get("slowest", [])
     lines.append("")
     lines.append("slowest requests (%d retained):" % len(ex))
-    lines.append("%-14s %10s %9s %9s %9s %9s  %s"
+    # attribution dims (ISSUE 12 satellite): lane=device, wrk=confirm
+    # worker, ten=fair-queue tenant, gen=ruleset generation — a slow
+    # request names every plane that served it
+    lines.append("%-14s %10s %9s %9s %9s %9s %4s %4s %4s %-12s %s"
                  % ("req_id", "e2e_us", "queue", "prep", "scan",
-                    "confirm", "rules"))
+                    "confirm", "lane", "wrk", "ten", "gen", "rules"))
     for e in ex[:20]:
         b = e.get("batch", {})
-        lines.append("%-14s %10d %9d %9d %9d %9d  %s"
+
+        def dim(key, e=e):
+            v = e.get(key)
+            return "-" if v is None or v == -1 else str(v)
+
+        lines.append("%-14s %10d %9d %9d %9d %9d %4s %4s %4s %-12s %s"
                      % (str(e.get("request_id", "?"))[:14],
                         e.get("e2e_us", 0), e.get("queue_us", 0),
                         b.get("prep_us", 0), b.get("scan_us", 0),
                         b.get("confirm_us", 0),
+                        dim("lane"), dim("worker"), dim("tenant"),
+                        str(e.get("generation", "-") or "-")[:12],
                         ",".join(str(r) for r in
                                  e.get("rule_ids", [])[:4]) or "-"))
     if sidecar is not None:
@@ -125,6 +135,64 @@ def render_latency(metrics_text: str, slow: dict,
             lines.append("  %-28s ewma_ms=%.3f inflight=%s"
                          % (up.get("path", "?"), up.get("ewma_ms", 0.0),
                             up.get("inflight", 0)))
+    return "\n".join(lines)
+
+
+def render_timeline(trace: dict, max_cycles: int = 6,
+                    width: int = 48) -> str:
+    """Terminal Gantt for `dbg timeline` (ISSUE 12): per cycle, one bar
+    row per recorded span — thread, span name, duration, and its
+    position inside the cycle's window, so the cross-thread overlap
+    structure (device busy vs confirm shares vs the next drain) is
+    visible without leaving the terminal.  Input is the /debug/trace
+    Chrome-trace JSON (the same bytes Perfetto loads)."""
+    events = trace.get("traceEvents", [])
+    if not trace.get("enabled", True) and not events:
+        return "flight recorder disabled (--no-flight-recorder)"
+    tnames = {e["tid"]: e["args"]["name"]
+              for e in events if e.get("ph") == "M"
+              and e.get("name") == "thread_name"}
+    spans = [e for e in events if e.get("ph") == "X"
+             and e.get("cat") == "serve"]
+    by_cycle: dict = {}
+    for s in spans:
+        cyc = (s.get("args") or {}).get("cycle", 0)
+        if cyc:
+            by_cycle.setdefault(cyc, []).append(s)
+    if not by_cycle:
+        return ("no cycles recorded yet (no traffic, or the ring "
+                "evicted them)")
+    lines = []
+    dropped = (trace.get("otherData") or {}).get("dropped", 0)
+    if dropped:
+        lines.append("NOTE: %d events evicted from the ring "
+                     "(--trace-ring-kb raises the cap)" % dropped)
+    for cyc in sorted(by_cycle)[-max_cycles:]:
+        cspans = sorted(by_cycle[cyc], key=lambda s: s["ts"])
+        w0 = min(s["ts"] for s in cspans)
+        w1 = max(s["ts"] + s["dur"] for s in cspans)
+        span_w = max(w1 - w0, 1.0)
+        env = next((s for s in cspans if s["name"] == "cycle"), None)
+        lines.append("cycle %d  (%.2f ms window%s)" % (
+            cyc, span_w / 1000.0,
+            ", %s requests" % env["args"].get("arg")
+            if env is not None and env.get("args", {}).get("arg")
+            else ""))
+        for s in cspans:
+            tname = tnames.get(s["tid"], str(s["tid"])).split(" ")[0]
+            off = int((s["ts"] - w0) / span_w * width)
+            ln = max(1, int(s["dur"] / span_w * width))
+            bar = "." * off + "#" * min(ln, width - off)
+            bar += "." * (width - len(bar))
+            tag = s.get("args", {}).get("tag", 0)
+            label = s["name"]
+            if s["name"] in ("lane_launch", "device_busy",
+                             "lane_collect"):
+                label += "[%s]" % tag
+            elif s["name"] == "confirm_share":
+                label += "[w%s]" % tag
+            lines.append("  %-22s %-16s %9dus |%s|"
+                         % (tname, label, int(s["dur"]), bar))
     return "\n".join(lines)
 
 
@@ -443,7 +511,11 @@ def main(argv=None) -> int:
                     choices=["conf", "health", "metrics", "latency",
                              "tenants", "ruleset", "acl", "rulecheck",
                              "concheck", "rules", "drift", "breaker",
-                             "faults", "rollout", "scoring"])
+                             "faults", "rollout", "scoring",
+                             "timeline"])
+    ap.add_argument("--cycles", type=int, default=6,
+                    help="timeline: how many recent cycles to render "
+                         "(the Gantt view of /debug/trace)")
     ap.add_argument("--server", default="127.0.0.1:9901")
     ap.add_argument("--rules", default=None,
                     help="rulecheck: rules tree to analyze (default: "
@@ -516,6 +588,11 @@ def main(argv=None) -> int:
             else:
                 out = render_faults(json.loads(_call(args.server,
                                                      "/faults")))
+        elif args.cmd == "timeline":
+            trace = json.loads(_call(
+                args.server, "/debug/trace?cycles=%d"
+                % max(args.cycles, 1)))
+            out = render_timeline(trace, max_cycles=max(args.cycles, 1))
         elif args.cmd == "latency":
             metrics = _call(args.server, "/metrics")
             slow = json.loads(_call(args.server, "/debug/slow"))

@@ -77,7 +77,11 @@ if TYPE_CHECKING:
 
 from ingress_plus_tpu.compiler.ruleset import CompiledRuleset
 from ingress_plus_tpu.utils import faults
-from ingress_plus_tpu.utils.trace import named_rlock
+from ingress_plus_tpu.utils.trace import (
+    EV_SHADOW,
+    flight,
+    named_rlock,
+)
 
 #: rollout phases (ipt_rollout_state exports the index)
 STATES = ("idle", "admitted", "shadow", "canary", "live", "rejected",
@@ -777,6 +781,7 @@ class RolloutController:
 
     def _shadow_run(self) -> None:
         cfg = self.config
+        flight.register_thread("shadow")
         while not self._stop.is_set():
             try:
                 request, live_v = self._shadow_q.get(timeout=0.1)
@@ -799,6 +804,7 @@ class RolloutController:
             if broke:
                 continue
             t0 = time.monotonic()
+            flight.begin(EV_SHADOW, cycle=0)
             try:
                 if faults.fire("shadow_diverge"):
                     # injected divergence: the candidate "blocks" a
@@ -812,6 +818,8 @@ class RolloutController:
             except Exception:
                 with self._lock:
                     self.candidate_failures += 1
+            finally:
+                flight.end(EV_SHADOW, cycle=0)
             with self._lock:
                 self._budget_s -= time.monotonic() - t0
             self._evaluate()

@@ -43,6 +43,7 @@ import numpy as np
 
 from ingress_plus_tpu.serve.lanes import DeviceHang, LaneWorker
 from ingress_plus_tpu.utils import faults
+from ingress_plus_tpu.utils.trace import EV_CONFIRM, flight
 
 
 class ConfirmResult:
@@ -260,6 +261,7 @@ class _ConfirmWorker(LaneWorker):
 
     def _setup(self) -> None:
         faults.set_current_confirm_worker(self.worker_index)
+        flight.register_thread("confirm_worker")
 
 
 class ConfirmJob:
@@ -354,10 +356,17 @@ def launch_confirm(pl, requests, rule_hits: np.ndarray) -> ConfirmJob:
     # the share-level sleep_if above/below is invisible to a
     # tenant-targeted rule (no tenant stamped there).
     tt = faults.tenant_targeted("slow_confirm")
+    # flight recorder: the cycle id is read on the CALLING thread (the
+    # dispatch thread set it) and travels into the worker closures, so
+    # a confirm share overlapping the NEXT cycle's scan still stitches
+    # to the cycle whose verdicts it computes
+    trace_cycle = flight.cycle()
     if pool.inline:
         # worker id 0 stamped around the inline walk so worker-targeted
         # fault plans behave identically at --confirm-workers 1
         faults.set_current_confirm_worker(0)
+        flight.begin(EV_CONFIRM, cycle=trace_cycle, tag=0,
+                     arg=len(requests))
         try:
             faults.sleep_if("slow_confirm")
             for qi, req in enumerate(requests):
@@ -369,6 +378,7 @@ def launch_confirm(pl, requests, rule_hits: np.ndarray) -> ConfirmJob:
             if tt:
                 faults.set_current_tenant(None)
             faults.set_current_confirm_worker(None)
+            flight.end(EV_CONFIRM, cycle=trace_cycle, tag=0)
     else:
         n = pool.n_workers
         for wi in range(n):
@@ -376,7 +386,10 @@ def launch_confirm(pl, requests, rule_hits: np.ndarray) -> ConfirmJob:
             if not idxs:
                 continue
 
-            def _share(idxs=idxs, tt=tt):
+            def _share(idxs=idxs, tt=tt, wi=wi):
+                flight.set_cycle(trace_cycle)
+                flight.begin(EV_CONFIRM, cycle=trace_cycle, tag=wi,
+                             arg=len(idxs))
                 faults.sleep_if("slow_confirm")
                 out = []
                 try:
@@ -389,6 +402,7 @@ def launch_confirm(pl, requests, rule_hits: np.ndarray) -> ConfirmJob:
                 finally:
                     if tt:
                         faults.set_current_tenant(None)
+                    flight.end(EV_CONFIRM, cycle=trace_cycle, tag=wi)
                 return out
 
             job.pending.append((wi, idxs, pool.submit(wi, _share)))

@@ -39,7 +39,14 @@ from ingress_plus_tpu.models.confirm_plane import (
 from ingress_plus_tpu.models.engine import DetectionEngine
 from ingress_plus_tpu.models.rule_stats import RuleStats
 from ingress_plus_tpu.utils import faults
-from ingress_plus_tpu.utils.trace import Ewma, named_lock
+from ingress_plus_tpu.utils.trace import (
+    EV_DEVICE,
+    EV_FINALIZE,
+    EV_PREP,
+    Ewma,
+    flight,
+    named_lock,
+)
 
 #: wallarm_mode precedence (weakest → strongest).  Wire values (frame
 #: mode bits 0-1) are historical — safe_blocking arrived round 4 as
@@ -84,6 +91,10 @@ class Verdict:
     #: up to 8 dicts {rule_id, var, value} — var is the SecLang variable
     #: ('ARGS:q'), value a bounded post-transform snippet
     matches: List[dict] = field(default_factory=list)
+    #: confirm worker that walked this request's candidates (ISSUE 12
+    #: satellite: /debug/slow names the worker): 0 = the inline serial
+    #: walk, -1 = no confirm ran (fail-open, prefilter-only, streams)
+    confirm_worker: int = -1
 
 
 @dataclass
@@ -768,9 +779,16 @@ class DetectionPipeline:
         else:
             self.seen_shapes.add((bucket_shapes, Q_pad, head_ok))
         device = lane.device if lane is not None else None
+        # flight recorder: the cycle id travels with the closure onto
+        # the lane worker (read HERE on the dispatch thread)
+        trace_cycle = flight.cycle()
+        trace_lane = lane.index if lane is not None else 0
 
         def _dispatch():
             tb0 = time.perf_counter()
+            flight.set_cycle(trace_cycle)
+            flight.begin(EV_DEVICE, cycle=trace_cycle, tag=trace_lane,
+                         arg=len(requests))
             try:
                 if multi is not None:
                     return np.asarray(multi(
@@ -787,6 +805,7 @@ class DetectionPipeline:
                 # overlap design means launch→collect wall includes a
                 # whole drain window — that must not book as scan time
                 job.busy_us = int((time.perf_counter() - tb0) * 1e6)
+                flight.end(EV_DEVICE, cycle=trace_cycle, tag=trace_lane)
 
         if lane is not None:
             job.pending = lane.submit(_dispatch)
@@ -928,6 +947,7 @@ class DetectionPipeline:
         (``bucket_us``) rides the scan stage — the caller adds it to
         engine_us (docs/OBSERVABILITY.md)."""
         tp0 = time.perf_counter()
+        flight.begin(EV_PREP)
         if faults.fire("recompile_storm"):
             # injected executable loss: forget every warm shape and drop
             # the compiled programs — the following dispatches pay
@@ -944,6 +964,7 @@ class DetectionPipeline:
         # per-bucket pad/pack below is interleaved with async dispatch
         # and rides the scan stage — documented in docs/OBSERVABILITY.md)
         stats.prep_us += int((time.perf_counter() - tp0) * 1e6)
+        flight.end(EV_PREP, arg=len(requests))
         if not data_list:
             return [], (), False, 0, 0, 0
         te0 = time.perf_counter()
@@ -1014,6 +1035,14 @@ class DetectionPipeline:
         rule_hits = np.zeros((self._pad_q(Q), R), dtype=bool)
         if buckets:
             te0 = time.perf_counter()
+            # lane attribution from the worker's thread-local stamp
+            # (utils/faults): canary/tenant-degraded/stream scans ride
+            # whichever lane is serving — hardcoding 0 booked their
+            # device time to the wrong lane (review catch); -1 = a
+            # host thread with no lane (warmup, library callers)
+            _lane = faults.current_lane()
+            _ltag = _lane if _lane is not None else -1
+            flight.begin(EV_DEVICE, tag=_ltag, arg=Q)
             # Single-mapping dispatch (docs/SCAN_KERNEL.md): each bucket
             # scans in its own jit program, the rule-count-scaling
             # factor→rule mapping runs once per batch.  Engines that
@@ -1044,6 +1073,7 @@ class DetectionPipeline:
                     rule_hits |= np.asarray(rh_dev)
             stats.engine_us += bucket_us + int(
                 (time.perf_counter() - te0) * 1e6)
+            flight.end(EV_DEVICE, tag=_ltag)
         rule_hits = self.mask_hits(requests, rule_hits[:Q])
         stats.prefilter_rule_hits += int(rule_hits.sum())
         return rule_hits
@@ -1091,6 +1121,7 @@ class DetectionPipeline:
         verdict."""
         stats = self.stats
         tc0 = time.perf_counter()
+        flight.begin(EV_FINALIZE, arg=len(cjob.requests))
         results = join_confirm(self, cjob)
         requests, rule_hits = cjob.requests, cjob.rule_hits
         verdicts: List[Verdict] = []
@@ -1218,9 +1249,17 @@ class DetectionPipeline:
         stats.confirm_us += cjob.launch_us + int(
             (time.perf_counter() - tc0) * 1e6)
         stats.confirmed_rule_hits += sum(len(v.rule_ids) for v in verdicts)
+        flight.end(EV_FINALIZE)
 
         elapsed = int((time.perf_counter() - t0) * 1e6)
-        for v in verdicts:
+        # worker attribution (ISSUE 12 satellite): the pool round-robins
+        # request qi onto worker qi % N (confirm_plane.launch_confirm),
+        # so the stamp is derivable without threading state through the
+        # walk; 0 = the inline serial walk, wedged shares keep -1
+        nw = self.confirm_pool.n_workers
+        for qi, v in enumerate(verdicts):
             v.elapsed_us = elapsed
             v.generation = self.generation_tag
+            if not v.fail_open:
+                v.confirm_worker = (qi % nw) if nw > 1 else 0
         return verdicts

@@ -36,6 +36,7 @@ import numpy as np
 
 from ingress_plus_tpu.compiler.ruleset import CompiledRuleset
 from ingress_plus_tpu.parallel.shard import ShardedEngine
+from ingress_plus_tpu.utils.overlap import collect as overlap_collect
 
 try:  # Mesh type only used for annotations / isinstance docs
     from jax.sharding import Mesh
@@ -152,6 +153,13 @@ def run_lane_measurement(cr: CompiledRuleset, n_lanes: int,
             "confirm_share": confirm_share,
             "confirm_us": d_confirm,
             "confirm_workers": pipeline.confirm_pool.n_workers,
+            # cycle flight recorder (ISSUE 12): the MEASURED overlap
+            # structure of this point — scan↔confirm overlap fraction,
+            # per-lane idle share, drain occupancy, critical path,
+            # serialized-residue ranking (utils/overlap.py); the
+            # recorder was reset with the latency observations, so the
+            # report describes only the measured pass
+            "pipeline_overlap": overlap_collect(batcher),
             "ruleset": {"rules": int(cr.n_rules),
                         "words": int(cr.tables.n_words)},
         }

@@ -32,6 +32,7 @@ from ingress_plus_tpu.post.aggregate import aggregate_attacks
 from ingress_plus_tpu.post.brute import BruteDetector
 from ingress_plus_tpu.post.queue import HitQueue
 from ingress_plus_tpu.utils import faults
+from ingress_plus_tpu.utils.trace import EV_EXPORT, flight
 
 
 class Exporter:
@@ -201,12 +202,16 @@ class Exporter:
 
     def _run(self) -> None:
         wait = self.interval_s
+        flight.register_thread("exporter")
         while not self._stop.wait(wait):
+            flight.begin(EV_EXPORT, cycle=0)
             try:
                 self.flush_once()
             except Exception:
                 self.export_errors += 1
                 self.consecutive_failures += 1
+            finally:
+                flight.end(EV_EXPORT, cycle=0)
             wait = self.next_wait_s()
             self.backoff_s = wait if self.consecutive_failures else 0.0
 

@@ -74,14 +74,27 @@ from ingress_plus_tpu.serve.stream import StreamEngine, StreamState
 from ingress_plus_tpu.serve.unpack import GZIP_MAGIC, unpack_body
 from ingress_plus_tpu.utils import faults
 from ingress_plus_tpu.utils.trace import (
+    EV_COLLECT,
+    EV_CYCLE,
+    EV_DRAIN,
+    EV_LAUNCH,
+    EV_MIRROR,
+    EV_OVERSIZED,
+    EV_QUEUE,
+    EV_STREAM,
+    EV_SUBMIT,
+    EV_VERDICT,
+    EV_WATCHDOG,
     STAGES,
     BatchTrace,
     Ewma,
     Histogram,
     SlowRing,
     TraceRing,
+    flight,
     install_thread_excepthook,
     named_lock,
+    request_tag,
 )
 
 #: backward-compat alias — the single-device worker grew into
@@ -289,7 +302,7 @@ class _MeshCycle:
     finalized one drain later (the double buffer)."""
 
     __slots__ = (
-        "t0", "guard", "route", "pipeline", "ro", "cand_items",
+        "cid", "t0", "guard", "route", "pipeline", "ro", "cand_items",
         "lane_parts", "fallback_items", "finish_verdicts", "deg_done",
         "n_reqs", "n_finishes", "n_stream_items", "min_ts",
         "max_queue_delay_us", "engine_us0", "confirm_us0", "prep_us0",
@@ -306,6 +319,7 @@ class _MeshCycle:
 
     def __init__(self):
         self.overlap_drain_s = 0.0
+        self.cid = 0   # flight-recorder cycle id (stats.batches stamp)
 
 
 class _CycleGuard:
@@ -541,6 +555,10 @@ class Batcher:
             h.reset()
         self.batch_size_hist.reset()
         self.slow.reset()
+        # the flight recorder rides the same post-warmup reset: the
+        # overlap report must describe ONLY the measured traffic, not
+        # warmup's compile-dominated cycles (rings re-arm lazily)
+        flight.reset()
         with self._swap_lock:
             for lane in self.lanes.lanes:
                 lane.stats = type(lane.stats)()
@@ -587,6 +605,10 @@ class Batcher:
         self.stats.count_submitted()
         lc = self.pipeline.load_controller
         tenant = request.tenant
+        # flight recorder: the admission end of the request flow — the
+        # verdict end (EV_VERDICT, dispatch thread) closes the arrow
+        flight.instant(EV_SUBMIT, cycle=0,
+                       tag=request_tag(request.request_id), arg=tenant)
         g = self.tenant_guard
         glevel = 0
         if g is not None:
@@ -701,13 +723,20 @@ class Batcher:
                 self._oversized_by_tenant.pop(tenant, None)
 
     def _run_oversized(self) -> None:
+        flight.register_thread("oversized")
         while not self._stop.is_set():
             try:
                 ts, request, plan, fut = self._oversized_q.get(timeout=0.1)
             except queue.Empty:
                 continue
             try:
-                self._detect_oversized(ts, request, plan, fut)
+                flight.begin(EV_OVERSIZED, cycle=0, tag=request.tenant,
+                             arg=len(request.body))
+                try:
+                    self._detect_oversized(ts, request, plan, fut)
+                finally:
+                    flight.end(EV_OVERSIZED, cycle=0,
+                               tag=request.tenant)
             finally:
                 self._release_oversized_slot(request.tenant)
 
@@ -761,10 +790,14 @@ class Batcher:
         _safe_set(fut, v)
         e2e_us = int((time.perf_counter() - ts) * 1e6)
         self.hist["e2e"].observe(e2e_us)
+        flight.instant(EV_VERDICT, tag=request_tag(request.request_id),
+                       arg=-1)
         if e2e_us > self.slow.threshold():
             # side-lane: no batch stage spans, flagged oversized instead
             self.slow.offer(e2e_us, self._exemplar(
-                request, v, time.time(), 0, oversized=True))
+                request, v, time.time(), 0, oversized=True,
+                worker=v.confirm_worker, tenant=request.tenant,
+                generation=v.generation))
 
     # --------------------------------------------- streaming-body API
     # (config #5).  Queue FIFO guarantees begin ≤ chunks ≤ finish order;
@@ -1071,10 +1104,12 @@ class Batcher:
         if lane is None:
             lane = self.lanes.primary
         lane.stats.stream_cycles += 1
+        cid = flight.cycle()
         try:
             return lane.call(
-                lambda: self._stream_step(begins, chunks, finishes,
-                                          device_ok=(route != "fallback")),
+                lambda: flight.scoped(
+                    cid, self._stream_step, begins, chunks, finishes,
+                    route != "fallback"),
                 self.hang_budget_s)
         except DeviceHang:
             self.stats.hangs += 1
@@ -1117,8 +1152,10 @@ class Batcher:
             rows0 = p.stats.live_rows
             padded0 = p.stats.padded_rows
             tb0 = time.perf_counter()
+            cid = flight.cycle()
             verdicts = lane.call(
-                lambda: p.detect_strict(requests), self.hang_budget_s)
+                lambda: flight.scoped(cid, p.detect_strict, requests),
+                self.hang_budget_s)
             lane.breaker.record_success()
             st = lane.stats
             st.requests += len(requests)
@@ -1168,8 +1205,10 @@ class Batcher:
         if route == "fallback":
             return cand.detect_cpu_only(requests)
         try:
+            cid = flight.cycle()
             return lane.call(
-                lambda: cand.detect_strict(requests), self.hang_budget_s)
+                lambda: flight.scoped(cid, cand.detect_strict, requests),
+                self.hang_budget_s)
         except DeviceHang:
             self.stats.hangs += 1
             lane.stats.hangs += 1
@@ -1207,6 +1246,20 @@ class Batcher:
         items = [(r.request_id, fut) for _ts, r, fut in reqs]
         items += [(r.request_id, fut) for _ts, r, fut in deg_reqs]
         items += [(h.request.request_id, fut) for h, fut in finishes]
+        if flight.enabled:
+            # flight recorder: one queue-wait instant per tenant
+            # sub-queue this cycle (tag=tenant, arg=max wait µs) — the
+            # fair-queue dimension the aggregate queue histogram folds
+            per_tenant: Dict[int, float] = {}
+            for k, ts, obj, _f in batch:
+                t = self._item_tenant(k, obj)
+                d = t0 - ts
+                if d > per_tenant.get(t, -1.0):
+                    per_tenant[t] = d
+            cid = self.stats.batches
+            for t, d in per_tenant.items():
+                flight.instant(EV_QUEUE, cycle=cid, tag=t,
+                               arg=int(d * 1e6))
         return (reqs, deg_reqs, begins, chunks, finishes,
                 self._arm_guard(t0, items))
 
@@ -1269,8 +1322,10 @@ class Batcher:
             if lane is None:
                 lane = self.lanes.primary
             try:
+                cid = flight.cycle()
                 verdicts = lane.call(
-                    lambda: p.detect_tenant_degraded(dreqs),
+                    lambda: flight.scoped(cid, p.detect_tenant_degraded,
+                                          dreqs),
                     self.hang_budget_s)
             except DeviceHang:
                 self.stats.hangs += 1
@@ -1301,11 +1356,15 @@ class Batcher:
             pass
 
     def _run(self) -> None:
+        flight.register_thread("dispatch")
         if self.lanes.n > 1:
             self._run_mesh()
             return
         while not self._stop.is_set():
+            flight.set_cycle(0)
+            flight.begin(EV_DRAIN)
             batch = self._drain()
+            flight.end(EV_DRAIN)
             if not batch:
                 # idle drain: feed the brownout ladder a zero so the
                 # queue-delay EWMA decays and the ladder can step back
@@ -1317,6 +1376,12 @@ class Batcher:
             # every budget, the watchdog releases its futures fail-open
             reqs, deg_reqs, begins, chunks, finishes, guard = \
                 self._classify_batch(batch, t0)
+            # flight recorder: the cycle envelope — every span below
+            # stitches to this id (stats.batches, the cycle counter)
+            cid = self.stats.batches
+            flight.set_cycle(cid)
+            flight.begin(EV_CYCLE, cycle=cid,
+                         arg=len(reqs) + len(deg_reqs))
             # one breaker decision per cycle: requests AND stream scan
             # work follow it (a wedged device must not be probed twice)
             route = self.breaker.route()
@@ -1394,12 +1459,15 @@ class Batcher:
             # needs the swap lock the dispatch thread just released)
             if ro is not None:
                 if ro.shadow_active:
+                    flight.begin(EV_MIRROR, cycle=cid, arg=len(done))
                     for _ts, r, v, _lane in done:
                         ro.mirror(r, v)
+                    flight.end(EV_MIRROR, cycle=cid)
                 if cand_items:
                     ro.observe_canary(len(cand_items), cand_verdicts)
                 ro.tick()
             self._clear_guard(guard)
+            flight.end(EV_CYCLE, cycle=cid)
             t_end = time.perf_counter()
             took = t_end - t0
             # fail-safe plane signals: cycle-time EWMA feeds the
@@ -1474,14 +1542,21 @@ class Batcher:
         confirming: Optional[_MeshCycle] = None  # confirm in flight
         while not self._stop.is_set():
             if pending is None and confirming is None:
+                flight.set_cycle(0)
+                flight.begin(EV_DRAIN)
                 batch = self._drain()
+                flight.end(EV_DRAIN)
                 if not batch:
                     # idle drain: decay the brownout ladder's signal
                     self.pipeline.load_controller.observe(0.0)
                     continue
             else:
                 td0 = time.perf_counter()
+                # the interleaved drain IS the double-buffer overlap
+                # window — the flight recorder's drain-occupancy signal
+                flight.begin(EV_DRAIN)
                 batch = self._drain(first_timeout=self.max_delay_s)
+                flight.end(EV_DRAIN)
                 # the interleaved drain wait is the double buffer's
                 # idle window, not the in-flight cycles' service time —
                 # excluded from their clocks so the queue-math EWMA and
@@ -1538,6 +1613,10 @@ class Batcher:
         c.t0 = t0
         reqs, deg_reqs, begins, chunks, finishes, c.guard = \
             self._classify_batch(batch, t0)
+        c.cid = self.stats.batches
+        flight.set_cycle(c.cid)
+        flight.begin(EV_CYCLE, cycle=c.cid,
+                     arg=len(reqs) + len(deg_reqs))
         c.n_reqs = len(reqs) + len(deg_reqs)
         c.n_finishes = len(finishes)
         c.n_stream_items = len(begins) + len(chunks) + len(finishes)
@@ -1617,9 +1696,15 @@ class Batcher:
                     if not part:
                         continue
                     try:
-                        job = c.pipeline.detect_launch(
-                            [r for _, r, _ in part], lane=lane,
-                            count_batch=first_share)
+                        flight.begin(EV_LAUNCH, cycle=c.cid,
+                                     tag=lane.index, arg=len(part))
+                        try:
+                            job = c.pipeline.detect_launch(
+                                [r for _, r, _ in part], lane=lane,
+                                count_batch=first_share)
+                        finally:
+                            flight.end(EV_LAUNCH, cycle=c.cid,
+                                       tag=lane.index)
                         first_share = False
                     except Exception:
                         # host prep died for this share: fail it open
@@ -1659,16 +1744,23 @@ class Batcher:
         # regardless of what its siblings burned
         collect_deadline = time.perf_counter() + self.hang_budget_s
         fins: List = []   # (lane, part, _FinishJob)
+        flight.set_cycle(c.cid)
         with self._swap_lock:
             ps = p.stats
             e0, cf0 = ps.engine_us, ps.confirm_us
             pp0, cp0 = ps.prep_us, ps.engine_compiles
             for lane, lroute, part, job in c.lane_parts:
                 try:
-                    fin = p.detect_collect_launch(
-                        job, timeout=max(
-                            collect_deadline - time.perf_counter(),
-                            0.001))
+                    flight.begin(EV_COLLECT, cycle=c.cid,
+                                 tag=lane.index)
+                    try:
+                        fin = p.detect_collect_launch(
+                            job, timeout=max(
+                                collect_deadline - time.perf_counter(),
+                                0.001))
+                    finally:
+                        flight.end(EV_COLLECT, cycle=c.cid,
+                                   tag=lane.index)
                     # success is recorded in _resolve_cycle AFTER the
                     # confirm join: recording here would reset the
                     # breaker's consecutive-failure count every cycle
@@ -1734,6 +1826,7 @@ class Batcher:
         scan dispatch."""
         done = c.done
         p = c.pipeline
+        flight.set_cycle(c.cid)
         with self._swap_lock:
             ps = p.stats
             e0, cf0 = ps.engine_us, ps.confirm_us
@@ -1762,12 +1855,15 @@ class Batcher:
         ro = c.ro
         if ro is not None:
             if ro.shadow_active:
+                flight.begin(EV_MIRROR, cycle=c.cid, arg=len(done))
                 for _ts, r, v, _lane in done:
                     ro.mirror(r, v)
+                flight.end(EV_MIRROR, cycle=c.cid)
             if c.cand_items:
                 ro.observe_canary(len(c.cand_items), c.cand_verdicts)
             ro.tick()
         self._clear_guard(c.guard)
+        flight.end(EV_CYCLE, cycle=c.cid)
         t_end = time.perf_counter()
         took = max(t_end - c.t0 - c.overlap_drain_s, 0.0)
         if d_compiles == 0:
@@ -1858,6 +1954,7 @@ class Batcher:
         fail-open each tick — the one-verdict invariant outlives even
         a dead dispatcher."""
         period = min(max(self.hang_budget_s / 4.0, 0.05), 1.0)
+        flight.register_thread("watchdog")
         stuck_at_batches: Optional[int] = None
         while not self._stop.wait(period):
             # NEVER remove from _active_guards here: the dispatch
@@ -1879,6 +1976,7 @@ class Batcher:
                 if released:
                     self.stats.watchdog_released += released
                     self.breaker.trip("watchdog")
+                    flight.instant(EV_WATCHDOG, cycle=0, arg=released)
                     stuck_at_batches = self.stats.batches
             if stuck_at_batches is not None:
                 if self.stats.batches != stuck_at_batches:
@@ -1924,24 +2022,38 @@ class Batcher:
             self.batch_size_hist.observe(trace.n_requests)
         stages = None                 # built only if something IS slow
         thr = self.slow.threshold()   # skip dict build for fast requests
+        rec = flight.enabled
         for ts, r, v, lane_idx in done:
             queue_us = int((t0 - ts) * 1e6)
             e2e_us = int((t_end - ts) * 1e6)
             h["queue"].observe(queue_us)
             h["e2e"].observe(e2e_us)
+            if rec:
+                # the verdict end of the request flow (EV_SUBMIT is the
+                # admission end); arg = the lane that served it
+                flight.instant(EV_VERDICT, tag=request_tag(r.request_id),
+                               arg=lane_idx)
             if e2e_us <= thr:
                 continue
             if stages is None:
                 stages = trace.stages()
-            # lane attribution on the exemplar (docs/MESH_SERVING.md):
-            # /debug/slow shows WHICH device served a slow request
+            # slow-exemplar attribution (docs/MESH_SERVING.md + ISSUE
+            # 12 satellite): lane=WHICH device, worker=WHICH confirm
+            # worker, tenant=fair-queue tenant, generation=the ruleset
+            # generation that produced the verdict
             self.slow.offer(e2e_us, self._exemplar(
-                r, v, trace.ts, queue_us, batch=stages, lane=lane_idx))
+                r, v, trace.ts, queue_us, batch=stages, lane=lane_idx,
+                worker=v.confirm_worker, tenant=r.tenant,
+                generation=v.generation))
         for handle, v in finish_verdicts:
             # streams: end-to-end is begin→finish (the verdict's own
             # clock), not this cycle's queue wait
             e2e_us = int(v.elapsed_us)
             h["e2e"].observe(e2e_us)
+            if rec:
+                flight.instant(
+                    EV_VERDICT,
+                    tag=request_tag(handle.request.request_id), arg=-1)
             if e2e_us <= thr:
                 continue
             if stages is None:
@@ -1949,6 +2061,8 @@ class Batcher:
             self.slow.offer(e2e_us, self._exemplar(
                 handle.request, v, trace.ts, 0,
                 body_len=handle.body_len, batch=stages,
+                worker=v.confirm_worker, tenant=handle.request.tenant,
+                generation=v.generation,
                 stream={"chunks": handle.chunks,
                         "body_len": handle.body_len,
                         "truncated": handle.truncated}))
@@ -1964,6 +2078,8 @@ class Batcher:
         a wedged device; every finish resolves fail-open."""
         if not (begins or chunks or finishes):
             return []
+        flight.begin(EV_STREAM, arg=len(begins) + len(chunks)
+                     + len(finishes))
         if not device_ok:
             for h in begins:
                 h.error = True
@@ -2011,4 +2127,5 @@ class Batcher:
                     elapsed_us=int((time.perf_counter() - h.t0) * 1e6))
             _safe_set(fut, v)
             out.append((h, v))
+        flight.end(EV_STREAM)
         return out

@@ -37,7 +37,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from ingress_plus_tpu.utils import faults
-from ingress_plus_tpu.utils.trace import named_lock
+from ingress_plus_tpu.utils.trace import flight, named_lock
 
 
 class DeviceHang(Exception):
@@ -100,6 +100,7 @@ class LaneWorker:
         with their own attribution."""
         if self.lane_index is not None:
             faults.set_current_lane(self.lane_index)
+        flight.register_thread("lane_worker")
 
     def _run(self) -> None:
         self._setup()

@@ -622,6 +622,373 @@ def stage_breakdown_from_metrics(text: str,
     return out
 
 
+# ------------------------------------------------ cycle flight recorder
+# (ISSUE 12, docs/OBSERVABILITY.md "Cycle flight recorder").  The serve
+# plane is pipelined across threads — per-device lanes with double-
+# buffered transfer (PR 7), confirm workers overlapped with the next
+# cycle's scan (PR 9) — but the stage histograms above AGGREGATE away
+# exactly that concurrency structure.  The flight recorder keeps the
+# timeline: every thread root in the PR 11 threadmap emits begin/end
+# span events into a per-thread single-writer ring (fixed byte cap,
+# oldest-evict, drop-counted), stitched by cycle id and request-id hash
+# so a request's path is followable across admission → lane → confirm
+# worker → verdict.  Exported as Chrome-trace / Perfetto JSON at
+# /debug/trace, as a terminal Gantt by `dbg timeline`, and consumed by
+# utils/overlap.py for the measured overlap report.
+#
+# Cost discipline (the <3% clean-path budget): recording is ON by
+# default but every event is ONE tuple write into a preallocated ring
+# slot — integer event codes, monotonic-ns stamps, no dicts, no string
+# formatting; naming/export cost is paid only at snapshot time.
+# ``--no-flight-recorder`` reduces record() to a single attribute read.
+
+#: event codes (ints on the hot path; EVENT_NAMES only at export)
+EV_CYCLE = 1       # one dispatch cycle, launch → resolve (dispatch)
+EV_DRAIN = 2       # admission-queue drain wait (dispatch)
+EV_QUEUE = 3       # instant: a tenant sub-queue's max wait this cycle
+EV_PREP = 4        # host prep: normalize/unpack/row build+merge
+EV_LAUNCH = 5      # one lane share's prep+launch (dispatch), tag=lane
+EV_DEVICE = 6      # device dispatch busy (lane worker), tag=lane
+EV_COLLECT = 7     # one lane share's scan collection (dispatch), tag=lane
+EV_CONFIRM = 8     # one confirm share's walk, tag=worker, arg=n_requests
+EV_FINALIZE = 9    # finalize join + single-threaded fold (dispatch)
+EV_MIRROR = 10     # rollout shadow mirroring of resolved verdicts
+EV_STREAM = 11     # stream-step scan work (pinned lane worker)
+EV_OVERSIZED = 12  # oversized side-lane body scan, tag=tenant
+EV_SUBMIT = 13     # instant: admission, tag=req-id hash, arg=tenant
+EV_VERDICT = 14    # instant: verdict resolved, tag=req-id hash, arg=lane
+EV_SHADOW = 15     # shadow-lane candidate scan (shadow thread)
+EV_EXPORT = 16     # postanalytics export flush attempt
+EV_WATCHDOG = 17   # instant: watchdog released futures, arg=count
+
+EVENT_NAMES: Dict[int, str] = {
+    EV_CYCLE: "cycle", EV_DRAIN: "drain", EV_QUEUE: "queue_wait",
+    EV_PREP: "host_prep", EV_LAUNCH: "lane_launch", EV_DEVICE:
+    "device_busy", EV_COLLECT: "lane_collect", EV_CONFIRM:
+    "confirm_share", EV_FINALIZE: "finalize_join", EV_MIRROR: "mirror",
+    EV_STREAM: "stream_step", EV_OVERSIZED: "oversized",
+    EV_SUBMIT: "submit", EV_VERDICT: "verdict", EV_SHADOW: "shadow_scan",
+    EV_EXPORT: "export", EV_WATCHDOG: "watchdog_release",
+}
+
+#: phases — begin / end / instant (flow endpoints are instants on the
+#: submit/verdict codes; the exporter synthesizes Chrome s/f pairs)
+PH_B, PH_E, PH_I = 0, 1, 2
+
+#: per-event byte estimate for the ring cap: a 6-int tuple (~104B on
+#: CPython) plus its list slot — documented, not measured per-platform
+EVENT_BYTES = 112
+
+#: events per cycle are O(lanes + confirm workers + tenants), plus two
+#: instants per request (submit/verdict) — the default 256KB ring holds
+#: ~2300 events ≈ hundreds of cycles of structure on a quiet box and
+#: tens under load, plenty for the overlap report's window
+DEFAULT_RING_KB = 256
+
+
+def request_tag(request_id: str) -> int:
+    """Stable-within-process int tag for a wire request id (the flow id
+    stitching submit → verdict across threads)."""
+    return hash(request_id) & 0x7FFFFFFFFFFFFFFF
+
+
+class _ThreadRing:
+    """One thread's event ring: SINGLE-WRITER by construction (only the
+    owning thread records; readers snapshot the slot list, tolerating a
+    torn read of at most the newest slot — telemetry, not verdicts)."""
+
+    __slots__ = ("root", "thread_name", "index", "cap", "buf", "head",
+                 "dropped", "cycle", "thread")
+
+    def __init__(self, root: str, thread_name: str, index: int, cap: int):
+        self.root = root
+        self.thread_name = thread_name
+        self.index = index          # stable tid for the trace export
+        self.cap = cap
+        self.buf: List[Optional[tuple]] = [None] * cap
+        self.head = 0
+        self.dropped = 0            # events evicted by the byte cap
+        self.cycle = 0              # ambient cycle id for this thread
+        #: owner thread — registration prunes DEAD threads' rings past
+        #: a soft cap, so short-lived workers (abandoned lanes, test
+        #: batchers, swap warmers) cannot grow the registry unbounded
+        self.thread = threading.current_thread()
+
+    def record(self, t_ns: int, code: int, phase: int, cycle: int,
+               tag: int, arg: int) -> None:
+        i = self.head
+        buf = self.buf
+        if buf[i] is not None:
+            # concheck: ok single-writer ring — only the owning thread records
+            self.dropped += 1
+        buf[i] = (t_ns, code, phase, cycle, tag, arg)
+        # concheck: ok single-writer ring — only the owning thread records
+        self.head = (i + 1) % self.cap
+
+    def events(self) -> List[tuple]:
+        """Chronological copy (oldest first)."""
+        buf = list(self.buf)        # GIL-atomic slot copy
+        head = self.head
+        out = [e for e in buf[head:] if e is not None]
+        out += [e for e in buf[:head] if e is not None]
+        return out
+
+
+class FlightRecorder:
+    """Process-wide cycle flight recorder.  Threads register (or are
+    lazily auto-registered under their normalized thread name) and get a
+    private ring; ``record`` is the one hot-path entry.  ``configure``
+    re-arms every ring (generation bump — stale thread-locals from
+    before a reconfigure re-register on their next event)."""
+
+    #: soft registry cap: past it, registration drops the oldest rings
+    #: whose owner thread has exited (live rings are never pruned)
+    MAX_RINGS = 128
+
+    def __init__(self, ring_kb: int = DEFAULT_RING_KB,
+                 enabled: bool = True):
+        self.enabled = enabled
+        self.ring_kb = ring_kb
+        self._gen = 0
+        self._next_tid = 0
+        self._lock = named_lock("FlightRecorder._lock")
+        self._rings: List[_ThreadRing] = []
+        self._tls = threading.local()
+
+    # ------------------------------------------------------- lifecycle
+
+    def configure(self, ring_kb: Optional[int] = None,
+                  enabled: Optional[bool] = None) -> None:
+        """Re-arm the recorder (serve startup: --trace-ring-kb /
+        --no-flight-recorder; tests: isolation between cases).  Existing
+        rings are dropped — every thread re-registers lazily."""
+        with self._lock:
+            if ring_kb is not None:
+                self.ring_kb = max(1, int(ring_kb))
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            self._rings = []
+            self._gen += 1
+
+    def reset(self) -> None:
+        self.configure()
+
+    def _cap(self) -> int:
+        return max(64, (self.ring_kb * 1024) // EVENT_BYTES)
+
+    def register_thread(self, root: Optional[str] = None) -> None:
+        """Declare the calling thread's root name (the threadmap root:
+        dispatch, lane_worker, confirm_worker, ...).  Threads that never
+        call this are auto-registered under their normalized thread
+        name on first record."""
+        if not self.enabled:
+            return
+        self._register(root)
+
+    def _register(self, root: Optional[str]) -> _ThreadRing:
+        name = threading.current_thread().name
+        if root is None:
+            root = _THREAD_SUFFIX_RE.sub("", name) or name
+        with self._lock:
+            if len(self._rings) >= self.MAX_RINGS:
+                # prune dead threads' rings oldest-first (their events
+                # age out of the post-mortem window; live rings stay)
+                alive = [r for r in self._rings if r.thread.is_alive()]
+                dead = [r for r in self._rings
+                        if not r.thread.is_alive()]
+                self._rings = alive + dead[-16:]
+            ring = _ThreadRing(root, name, self._next_tid, self._cap())
+            self._next_tid += 1
+            self._rings.append(ring)
+            gen = self._gen
+        self._tls.ring = ring
+        self._tls.gen = gen
+        return ring
+
+    def _ring(self) -> _ThreadRing:
+        tls = self._tls
+        ring = getattr(tls, "ring", None)
+        if ring is None:
+            return self._register(None)
+        if getattr(tls, "gen", -1) != self._gen:
+            # re-arm after a configure()/reset(): keep the declared
+            # root name — a post-warmup reset must not demote
+            # "dispatch" to its raw thread name
+            return self._register(ring.root)
+        return ring
+
+    # --------------------------------------------------------- hot path
+
+    def record(self, code: int, phase: int, cycle: Optional[int] = None,
+               tag: int = 0, arg: int = 0) -> None:
+        if not self.enabled:
+            return
+        ring = self._ring()
+        ring.record(time.monotonic_ns(), code, phase,
+                    ring.cycle if cycle is None else cycle, tag, arg)
+
+    def begin(self, code: int, cycle: Optional[int] = None,
+              tag: int = 0, arg: int = 0) -> None:
+        self.record(code, PH_B, cycle, tag, arg)
+
+    def end(self, code: int, cycle: Optional[int] = None,
+            tag: int = 0, arg: int = 0) -> None:
+        self.record(code, PH_E, cycle, tag, arg)
+
+    def instant(self, code: int, cycle: Optional[int] = None,
+                tag: int = 0, arg: int = 0) -> None:
+        self.record(code, PH_I, cycle, tag, arg)
+
+    def set_cycle(self, cycle: int) -> None:
+        """Ambient cycle id for subsequent events on THIS thread (the
+        dispatch thread stamps it per cycle; lane/confirm closures carry
+        it across the thread boundary via scoped())."""
+        if not self.enabled:
+            return
+        self._ring().cycle = cycle
+
+    def cycle(self) -> int:
+        if not self.enabled:
+            return 0
+        return self._ring().cycle
+
+    def scoped(self, cycle: int, fn, *args):
+        """Run ``fn`` with the calling thread's ambient cycle set —
+        the closure-crossing helper for work launched onto lane/confirm
+        workers (the cycle id travels with the work, not the thread)."""
+        self.set_cycle(cycle)
+        return fn(*args)
+
+    # ---------------------------------------------------------- export
+
+    def dropped(self) -> int:
+        with self._lock:
+            rings = list(self._rings)
+        return sum(r.dropped for r in rings)
+
+    def snapshot(self, cycles: Optional[int] = None) -> dict:
+        """Raw event snapshot: ``threads`` (tid/root/name/dropped) +
+        ``events`` as (tid, t_ns, code, phase, cycle, tag, arg) tuples,
+        time-sorted.  ``cycles=N`` keeps only the last N cycle ids seen
+        (untagged cycle-0 events are kept by time-window containment so
+        drain/idle context survives the filter)."""
+        with self._lock:
+            rings = list(self._rings)
+        threads = [{"tid": r.index, "root": r.root,
+                    "thread": r.thread_name, "dropped": r.dropped}
+                   for r in rings]
+        events: List[tuple] = []
+        for r in rings:
+            tid = r.index
+            events.extend((tid,) + e for e in r.events())
+        events.sort(key=lambda e: e[1])
+        if cycles is not None and events:
+            cids = sorted({e[4] for e in events if e[4] > 0})
+            keep = set(cids[-cycles:])
+            if keep:
+                t_min = min((e[1] for e in events if e[4] in keep),
+                            default=0)
+                # cycle-0 events (drain, submit/verdict flows, side
+                # lanes) keep a 1s grace before the window so a kept
+                # verdict's SUBMIT endpoint survives the filter — a
+                # flow arrow needs both ends
+                t_keep = t_min - 1_000_000_000
+                events = [e for e in events
+                          if e[4] in keep or (e[4] == 0
+                                              and e[1] >= t_keep)]
+            else:
+                events = []
+        return {"enabled": self.enabled, "ring_kb": self.ring_kb,
+                "threads": threads, "events": events,
+                "dropped": sum(r.dropped for r in rings)}
+
+    def chrome_trace(self, cycles: Optional[int] = None) -> dict:
+        """Chrome trace-event JSON (Perfetto-loadable): thread-name
+        metadata per registered ring, matched begin/end pairs folded to
+        complete ("X") slices with cycle/tag args, instants as "i", and
+        request flow stitched as "s"/"f" pairs keyed on the submit/
+        verdict request-id hash — load the output straight into
+        https://ui.perfetto.dev."""
+        snap = self.snapshot(cycles=cycles)
+        trace: List[dict] = []
+        for t in snap["threads"]:
+            trace.append({"ph": "M", "name": "thread_name", "pid": 1,
+                          "tid": t["tid"],
+                          "args": {"name": "%s/%s (%s)"
+                                   % (t["root"], t["tid"], t["thread"])}})
+        flows = {EV_SUBMIT: "s", EV_VERDICT: "f"}
+        for tid, code, cyc, tag, arg, t0_ns, t1_ns in match_spans(
+                snap["events"]):
+            trace.append({
+                "ph": "X", "pid": 1, "tid": tid,
+                "name": EVENT_NAMES.get(code, "ev%d" % code),
+                "cat": "serve", "ts": round(t0_ns / 1000.0, 3),
+                "dur": round(max((t1_ns - t0_ns) / 1000.0, 0.001), 3),
+                "args": {"cycle": cyc, "tag": tag, "arg": arg}})
+        for tid, t_ns, code, phase, cyc, tag, arg in snap["events"]:
+            if phase != PH_I:
+                continue
+            ts = t_ns / 1000.0              # chrome ts unit: µs
+            name = EVENT_NAMES.get(code, "ev%d" % code)
+            ev = {"ph": "i", "pid": 1, "tid": tid, "name": name,
+                  "cat": "serve", "ts": round(ts, 3), "s": "t",
+                  "args": {"cycle": cyc, "tag": tag, "arg": arg}}
+            trace.append(ev)
+            if code in flows and tag:
+                # flow endpoints ride a minimal slice so Perfetto can
+                # anchor the arrow (legacy-JSON flow events bind to an
+                # enclosing slice)
+                trace.append({"ph": "X", "pid": 1, "tid": tid,
+                              "name": name, "cat": "req",
+                              "ts": round(ts, 3), "dur": 1,
+                              "args": {"cycle": cyc}})
+                trace.append({"ph": flows[code], "pid": 1, "tid": tid,
+                              "name": "request", "cat": "req",
+                              "id": tag, "ts": round(ts, 3),
+                              **({"bp": "e"} if code == EV_VERDICT
+                                 else {})})
+        trace.sort(key=lambda e: e.get("ts", 0))
+        return {"traceEvents": trace, "displayTimeUnit": "ms",
+                "otherData": {"dropped": snap["dropped"],
+                              "ring_kb": snap["ring_kb"]}}
+
+
+def match_spans(events: Sequence[tuple]) -> List[tuple]:
+    """The ONE begin/end pair matcher (chrome_trace and
+    utils/overlap.py both consume it — two drifting folds shared a
+    mispairing bug once, review catch): LIFO per (tid, code, tag,
+    CYCLE).  The cycle id is part of the key because the mesh loop's
+    double buffer begins cycle N's envelope BEFORE ending cycle
+    N-1's — a (tid, code, tag)-only fold pairs end(N-1) with begin(N)
+    and reports a tiny wrongly-attributed slice exactly in the
+    overlapped configuration the recorder exists to measure.  Every
+    instrumentation site stamps the SAME cycle on a span's begin and
+    end (closures carry it), so the key is stable.  Returns
+    ``(tid, code, cycle, tag, arg, t0_ns, t1_ns)`` tuples,
+    begin-time-sorted; unmatched begins/ends (ring eviction at the
+    window edge) are dropped."""
+    open_spans: Dict[tuple, List[tuple]] = {}
+    out: List[tuple] = []
+    for tid, t_ns, code, phase, cyc, tag, arg in events:
+        if phase == PH_B:
+            open_spans.setdefault((tid, code, tag, cyc), []).append(
+                (t_ns, arg))
+        elif phase == PH_E:
+            stack = open_spans.get((tid, code, tag, cyc))
+            if not stack:
+                continue
+            t0, arg0 = stack.pop()
+            out.append((tid, code, cyc, tag, arg0 or arg, t0, t_ns))
+    out.sort(key=lambda s: s[5])
+    return out
+
+
+#: the process-wide flight recorder every serve-plane thread reports
+#: into (the lock_registry pattern; serve --trace-ring-kb /
+#: --no-flight-recorder configure it at startup)
+flight = FlightRecorder()
+
+
 @contextmanager
 def profiled(trace_dir: Optional[str]):
     """JAX profiler region (no-op when trace_dir is falsy).

@@ -1,0 +1,514 @@
+"""Cycle flight recorder (ISSUE 12, docs/OBSERVABILITY.md "Cycle
+flight recorder"): per-thread ring bound/evict/drop accounting,
+cross-thread flow stitching for a request spanning a lane worker AND a
+confirm worker, Perfetto/Chrome-trace schema round trip, overlap-report
+math on a synthetic event stream with a KNOWN overlap fraction, the
+``--no-flight-recorder`` escape hatch zeroing the surface, the
+clean-path A/B overhead bound, the slow-ring worker=/tenant=/
+generation= satellite, and the promlint / bench-trend satellite
+checkers."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.batcher import Batcher
+from ingress_plus_tpu.serve.normalize import Request
+from ingress_plus_tpu.utils import trace as trace_mod
+from ingress_plus_tpu.utils.overlap import (
+    brief,
+    check_claims,
+    overlap_report,
+    spans_from_events,
+)
+from ingress_plus_tpu.utils.trace import (
+    EV_CONFIRM,
+    EV_CYCLE,
+    EV_DEVICE,
+    EV_DRAIN,
+    EV_SUBMIT,
+    EV_VERDICT,
+    PH_B,
+    PH_E,
+    PH_I,
+    FlightRecorder,
+    flight,
+    request_tag,
+)
+
+RULES = """
+SecRule ARGS|REQUEST_BODY "@rx (?i)union\\s+select" "id:942100,phase:2,block,t:urlDecodeUni,t:lowercase,severity:CRITICAL,tag:'attack-sqli'"
+SecRule REQUEST_URI|ARGS "@rx /etc/(?:passwd|shadow)" "id:930120,phase:2,block,severity:CRITICAL,tag:'attack-lfi'"
+"""
+
+
+@pytest.fixture(scope="module")
+def cr():
+    return compile_ruleset(parse_seclang(RULES))
+
+
+@pytest.fixture(autouse=True)
+def fresh_flight():
+    """Isolate the process-global recorder per test (rings re-arm
+    lazily on the next event; enabled state restored to the default)."""
+    flight.configure(ring_kb=256, enabled=True)
+    yield
+    flight.configure(ring_kb=256, enabled=True)
+
+
+def _reqs(n, attack_every=2):
+    out = []
+    for i in range(n):
+        if i % attack_every == 0:
+            r = Request(uri="/p?q=1%27%20UNION%20SELECT%20x",
+                        headers={}, body=b"", request_id="atk-%d" % i)
+        else:
+            r = Request(uri="/ok?page=%d" % i, headers={}, body=b"",
+                        request_id="ben-%d" % i)
+        out.append(r)
+    return out
+
+
+def _serve(batcher, reqs, timeout=60):
+    futs = [batcher.submit(r) for r in reqs]
+    return [f.result(timeout=timeout) for f in futs]
+
+
+# ------------------------------------------------- ring accounting
+
+def test_ring_bound_evict_drop_accounting():
+    rec = FlightRecorder(ring_kb=1)   # floor: 64 slots
+    cap = rec._cap()
+    assert cap == 64
+    n = 200
+    for i in range(n):
+        rec.instant(EV_SUBMIT, cycle=1, tag=i)
+    snap = rec.snapshot()
+    assert len(snap["events"]) == cap          # bounded, oldest evicted
+    assert snap["dropped"] == n - cap          # every eviction counted
+    # chronological, newest retained: tags are the LAST cap values
+    tags = [e[5] for e in snap["events"]]
+    assert tags == list(range(n - cap, n))
+    # timestamps monotonic within the ring
+    ts = [e[1] for e in snap["events"]]
+    assert ts == sorted(ts)
+
+
+def test_ring_cap_scales_with_kb():
+    rec = FlightRecorder(ring_kb=256)
+    assert rec._cap() == (256 * 1024) // trace_mod.EVENT_BYTES
+
+
+# ------------------------------------- cross-thread flow stitching
+
+def test_cross_thread_flow_lane_plus_confirm_worker(cr):
+    """A request's path is followable across admission → lane worker →
+    confirm worker → verdict: the submit/verdict flow tags match, and
+    the cycle id stitches device spans (lane worker threads) to confirm
+    spans (confirm worker threads)."""
+    pipe = DetectionPipeline(cr, mode="block", confirm_workers=2)
+    b = Batcher(pipe, max_batch=8, n_lanes=2)
+    try:
+        reqs = _reqs(32)
+        vs = _serve(b, reqs)
+        assert sum(v.attack for v in vs) == 16
+        snap = flight.snapshot()
+    finally:
+        b.close()
+    roots = {t["root"] for t in snap["threads"]}
+    assert {"dispatch", "lane_worker", "confirm_worker",
+            "watchdog", "oversized"} <= roots
+    by_code = {}
+    for e in snap["events"]:
+        by_code.setdefault(e[2], []).append(e)
+    # flow endpoints: every request's submit tag has a matching verdict
+    sub_tags = {e[5] for e in by_code.get(EV_SUBMIT, ())}
+    ver_tags = {e[5] for e in by_code.get(EV_VERDICT, ())}
+    want = {request_tag(r.request_id) for r in reqs}
+    assert want <= sub_tags
+    assert want <= ver_tags
+    # cycle stitching: device spans (lane workers) and confirm spans
+    # (confirm workers) share cycle ids with the dispatch thread's
+    # cycle envelopes — and run on DIFFERENT threads
+    tid_root = {t["tid"]: t["root"] for t in snap["threads"]}
+    dev_cycles = {e[4] for e in by_code.get(EV_DEVICE, ())
+                  if tid_root[e[0]] == "lane_worker" and e[4] > 0}
+    conf_cycles = {e[4] for e in by_code.get(EV_CONFIRM, ())
+                   if tid_root[e[0]] == "confirm_worker" and e[4] > 0}
+    cyc_cycles = {e[4] for e in by_code.get(EV_CYCLE, ())
+                  if tid_root[e[0]] == "dispatch" and e[4] > 0}
+    assert dev_cycles and conf_cycles
+    assert dev_cycles <= cyc_cycles
+    assert conf_cycles <= cyc_cycles
+    assert dev_cycles & conf_cycles   # same cycle crossed both planes
+    # both lanes and both confirm workers actually recorded
+    assert {e[5] for e in by_code.get(EV_DEVICE, ())} >= {0, 1}
+    assert {e[5] for e in by_code.get(EV_CONFIRM, ())} >= {0, 1}
+
+
+# --------------------------------------------- Perfetto round trip
+
+def test_chrome_trace_schema_round_trip(cr):
+    pipe = DetectionPipeline(cr, mode="block")
+    b = Batcher(pipe, max_batch=8)
+    try:
+        _serve(b, _reqs(24))
+        ct = flight.chrome_trace(cycles=16)
+    finally:
+        b.close()
+    # JSON round trip: the exact bytes /debug/trace serves load back
+    loaded = json.loads(json.dumps(ct))
+    events = loaded["traceEvents"]
+    assert isinstance(events, list) and events
+    phases = {e["ph"] for e in events}
+    # matched begin/end: the exporter folds B/E into complete X slices
+    # — no unmatched B or E phase ever reaches the output
+    assert "B" not in phases and "E" not in phases
+    assert "X" in phases and "M" in phases
+    tids_meta = {e["tid"] for e in events if e["ph"] == "M"}
+    per_thread_ts = {}
+    for e in events:
+        if e["ph"] == "M":
+            assert e["name"] == "thread_name"
+            assert e["args"]["name"]
+            continue
+        assert e["tid"] in tids_meta      # every event's thread named
+        assert e["ts"] >= 0
+        per_thread_ts.setdefault((e["tid"], e["ph"]), []).append(e["ts"])
+        if e["ph"] == "X":
+            assert e["dur"] > 0
+    # monotonic timestamps: the global event list is time-sorted
+    ts = [e["ts"] for e in events if e["ph"] != "M"]
+    assert ts == sorted(ts)
+    # request flows: every finish has a start with the same id
+    starts = {e["id"] for e in events if e["ph"] == "s"}
+    finishes = {e["id"] for e in events if e["ph"] == "f"}
+    assert finishes and finishes <= starts
+
+
+# ------------------------------------------------ overlap-report math
+
+def _ms(x):
+    return int(x * 1e6)   # ms → ns
+
+
+def _synthetic_snapshot():
+    """Known structure: cycle [0,100]ms on dispatch, device busy
+    [0,50]ms on a lane worker, confirm [30,90]ms on a confirm worker,
+    drain [90,100]ms on dispatch.  Overlap = [30,50] = 20ms of the
+    60ms confirm → fraction 1/3."""
+    threads = [
+        {"tid": 0, "root": "dispatch", "thread": "ipt-batcher",
+         "dropped": 0},
+        {"tid": 1, "root": "lane_worker", "thread": "ipt-device-0",
+         "dropped": 0},
+        {"tid": 2, "root": "confirm_worker", "thread": "ipt-confirm-1",
+         "dropped": 0},
+    ]
+    events = [
+        (0, _ms(0), EV_CYCLE, PH_B, 1, 0, 4),
+        (1, _ms(0), EV_DEVICE, PH_B, 1, 0, 4),
+        (2, _ms(30), EV_CONFIRM, PH_B, 1, 0, 4),
+        (1, _ms(50), EV_DEVICE, PH_E, 1, 0, 0),
+        (2, _ms(90), EV_CONFIRM, PH_E, 1, 0, 0),
+        (0, _ms(90), EV_DRAIN, PH_B, 0, 0, 0),
+        (0, _ms(100), EV_DRAIN, PH_E, 0, 0, 0),
+        (0, _ms(100), EV_CYCLE, PH_E, 1, 0, 0),
+    ]
+    events.sort(key=lambda e: e[1])
+    return {"enabled": True, "ring_kb": 256, "threads": threads,
+            "events": events, "dropped": 0}
+
+
+def test_overlap_backfills_silent_lanes():
+    """A lane that recorded NO device span (wedged/starved) must show
+    idle 1.0, not vanish from the report."""
+    rep = overlap_report(_synthetic_snapshot(), confirm_workers=2,
+                         n_lanes=3)
+    assert rep["lane_idle_share"]["1"] == 1.0
+    assert rep["lane_idle_share"]["2"] == 1.0
+    assert rep["lane_idle_share"]["0"] == pytest.approx(0.5, abs=1e-4)
+
+
+def test_overlap_report_known_fraction():
+    rep = overlap_report(_synthetic_snapshot(), confirm_workers=2,
+                         n_lanes=1)
+    assert rep is not None
+    assert rep["cycles"] == 1
+    assert rep["window_ms"] == 100.0
+    assert rep["scan_confirm_overlap"] == pytest.approx(20 / 60,
+                                                        abs=1e-4)
+    assert rep["lane_idle_share"]["0"] == pytest.approx(0.5, abs=1e-4)
+    assert rep["drain_occupancy"] == pytest.approx(0.1, abs=1e-4)
+    # confirm (60ms) out-lasts device (50ms): the cycle's critical path
+    assert next(iter(rep["critical_path"])) == "confirm_share"
+    # serialized residue: confirm worker holds the largest exclusive
+    # share (40ms of the 90ms any-busy union)
+    top = rep["serialized_residue"][0]
+    assert top["thread"].startswith("confirm_worker")
+    assert top["exclusive_share"] == pytest.approx(40 / 90, abs=1e-3)
+    b = brief(rep)
+    assert b["scan_confirm_overlap"] == rep["scan_confirm_overlap"]
+    assert b["bounding_thread"]["thread"].startswith("confirm_worker")
+
+
+def test_overlap_spans_and_empty_window():
+    spans = spans_from_events(_synthetic_snapshot())
+    assert len(spans) == 4
+    assert overlap_report({"threads": [], "events": [],
+                           "dropped": 0}) is None
+    # missing report is itself a LOUD claim-check finding
+    assert check_claims(None)
+
+
+def test_check_claims_flags_serialized_thread():
+    snap = _synthetic_snapshot()
+    # remove the confirm span → device alone, 100% exclusive
+    snap["events"] = [e for e in snap["events"] if e[2] != EV_CONFIRM]
+    rep = overlap_report(snap, confirm_workers=4, n_lanes=2)
+    warns = check_claims(rep)
+    assert any("critical path" in w for w in warns)
+
+
+# ------------------------------------------------- escape hatch
+
+def test_no_flight_recorder_zeroes_surface(cr):
+    flight.configure(enabled=False)
+    pipe = DetectionPipeline(cr, mode="block")
+    b = Batcher(pipe, max_batch=8)
+    try:
+        vs = _serve(b, _reqs(16))
+        assert len(vs) == 16              # verdicts unaffected
+        snap = flight.snapshot()
+        assert snap["events"] == []
+        assert snap["threads"] == []
+        assert snap["enabled"] is False
+        ct = flight.chrome_trace()
+        assert ct["traceEvents"] == []
+        # /debug/trace reports disabled with an empty event list
+        from ingress_plus_tpu.serve.server import ServeLoop
+        serve = ServeLoop(b, socket_path="/tmp/ipt-flight-test.sock")
+
+        async def _call():
+            return await serve._route_http("GET", "/debug/trace", b"")
+
+        status, _ctype, body = asyncio.run(_call())
+        assert status.startswith("200")
+        out = json.loads(body)
+        assert out == {"enabled": False, "traceEvents": []}
+        # /healthz pipeline_overlap goes null
+        assert serve._pipeline_overlap_brief() is None
+    finally:
+        b.close()
+
+
+def test_debug_trace_endpoint_perfetto_loadable(cr):
+    pipe = DetectionPipeline(cr, mode="block")
+    b = Batcher(pipe, max_batch=8)
+    try:
+        _serve(b, _reqs(12))
+        from ingress_plus_tpu.serve.server import ServeLoop
+        serve = ServeLoop(b, socket_path="/tmp/ipt-flight-test2.sock")
+
+        async def _call():
+            return await serve._route_http(
+                "GET", "/debug/trace?cycles=8", b"")
+
+        status, ctype, body = asyncio.run(_call())
+        assert status.startswith("200")
+        out = json.loads(body)
+        assert out["traceEvents"]
+        assert {e["ph"] for e in out["traceEvents"]} <= \
+            {"M", "X", "i", "s", "f"}
+        # the healthz brief carries the compact block
+        ov = serve._pipeline_overlap_brief()
+        assert ov is not None and ov["cycles"] >= 1
+    finally:
+        b.close()
+
+
+# ----------------------------------------------- clean-path overhead
+
+def test_clean_path_ab_overhead(cr):
+    """Recorder-on vs recorder-off A/B on the library detect path.
+    The pinned <3% budget is enforced on the bench's same-host A/B
+    (CHANGES.md carries the measured number); this in-suite assertion
+    uses a noise-tolerant bound so a loaded CI host cannot flake it,
+    while still catching an accidentally-hot record path (a 2x
+    regression fails loudly)."""
+    pipe = DetectionPipeline(cr, mode="block")
+    reqs = _reqs(16)
+    pipe.detect(reqs)                      # compile outside the clock
+
+    def measure(enabled, iters=60):
+        flight.configure(enabled=enabled)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                pipe.detect(reqs)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_off = measure(False)
+    t_on = measure(True)
+    ratio = t_on / t_off
+    assert ratio < 1.30, (
+        "flight recorder clean-path overhead ratio %.3f (on=%.4fs "
+        "off=%.4fs) — the record() path got hot" % (ratio, t_on, t_off))
+
+
+# ------------------------------------------- slow-ring satellite dims
+
+def test_slow_ring_carries_worker_tenant_generation(cr):
+    pipe = DetectionPipeline(cr, mode="block", confirm_workers=2)
+    b = Batcher(pipe, max_batch=8, n_lanes=2)
+    try:
+        reqs = _reqs(24)
+        for i, r in enumerate(reqs):
+            r.tenant = i % 3
+        vs = _serve(b, reqs)
+        assert {v.confirm_worker for v in vs if not v.fail_open} \
+            == {0, 1}
+        exemplars = b.slow.snapshot()
+        assert exemplars
+        for e in exemplars:
+            assert "worker" in e and "tenant" in e and "generation" in e
+            assert e["tenant"] in (0, 1, 2)
+            assert e["generation"] == pipe.generation_tag
+            assert e["worker"] in (-1, 0, 1)
+        assert {e["worker"] for e in exemplars} & {0, 1}
+    finally:
+        b.close()
+
+
+def test_dbg_latency_renders_new_dims(cr):
+    from ingress_plus_tpu.control.dbg import render_latency
+    slow = {"slowest": [{"request_id": "r1", "e2e_us": 1200,
+                         "queue_us": 10, "batch": {"prep_us": 1},
+                         "lane": 0, "worker": 1, "tenant": 7,
+                         "generation": "crs-4.3.0+g1",
+                         "rule_ids": [942100]}]}
+    out = render_latency("", slow)
+    assert "wrk" in out and "ten" in out and "gen" in out
+    assert "crs-4.3.0+g" in out and " 7 " in out
+
+
+def test_dbg_timeline_render(cr):
+    pipe = DetectionPipeline(cr, mode="block")
+    b = Batcher(pipe, max_batch=8)
+    try:
+        _serve(b, _reqs(12))
+        ct = flight.chrome_trace(cycles=6)
+    finally:
+        b.close()
+    from ingress_plus_tpu.control.dbg import render_timeline
+    out = render_timeline(ct)
+    assert "cycle " in out
+    assert "device_busy" in out or "host_prep" in out
+    assert "|" in out and "#" in out
+    # disabled surface renders the explanation, not a stack trace
+    assert "disabled" in render_timeline(
+        {"enabled": False, "traceEvents": []})
+
+
+# ------------------------------------------------ promlint satellite
+
+def test_promlint_checker_units():
+    from ingress_plus_tpu.analysis.promlint import check_exposition
+    good = "\n".join([
+        "# HELP ipt_good_total good things",
+        "# TYPE ipt_good_total counter",
+        "ipt_good_total 3",
+        "# HELP ipt_h histogram of things",
+        "# TYPE ipt_h histogram",
+        'ipt_h_bucket{le="1"} 1',
+        'ipt_h_bucket{le="+Inf"} 2',
+        "ipt_h_sum 2",
+        "ipt_h_count 2",
+    ])
+    assert check_exposition(good) == []
+    assert any("namespace prefix" in f for f in check_exposition(
+        "# HELP foo_total x\n# TYPE foo_total counter\nfoo_total 1"))
+    assert any("_total" in f for f in check_exposition(
+        "# HELP ipt_bad x\n# TYPE ipt_bad counter\nipt_bad 1"))
+    assert any("TYPE without # HELP" in f for f in check_exposition(
+        "# TYPE ipt_x_total counter\nipt_x_total 1"))
+    assert any("no # TYPE" in f for f in check_exposition(
+        "ipt_untyped_total 1"))
+    assert any("+Inf" in f for f in check_exposition(
+        "# HELP ipt_h x\n# TYPE ipt_h histogram\n"
+        'ipt_h_bucket{le="1"} 1'))
+    assert any("non-monotonic" in f for f in check_exposition(
+        "# HELP ipt_h x\n# TYPE ipt_h histogram\n"
+        'ipt_h_bucket{le="1"} 5\nipt_h_bucket{le="+Inf"} 2'))
+    # unbounded per-rule series: the satellite's reason to exist
+    unbounded = ["# HELP ipt_rule_total x", "# TYPE ipt_rule_total counter"]
+    unbounded += ['ipt_rule_total{rule="%d"} 1' % i for i in range(50)]
+    assert any("unbounded" in f
+               for f in check_exposition("\n".join(unbounded)))
+
+
+def test_promlint_live_exposition_clean(cr):
+    """The REAL exposition passes its own lint after multi-tenant
+    traffic (the in-process twin of the CI gate, on the small pack)."""
+    from ingress_plus_tpu.analysis.promlint import check_exposition
+    from ingress_plus_tpu.serve.server import ServeLoop
+    pipe = DetectionPipeline(cr, mode="monitoring")
+    b = Batcher(pipe, max_batch=16)
+    try:
+        reqs = _reqs(64)
+        for i, r in enumerate(reqs):
+            r.tenant = i % 48     # past the 30-series fold budget
+        _serve(b, reqs)
+        serve = ServeLoop(b, socket_path="/tmp/ipt-promlint-test.sock")
+        text = serve._metrics_text()
+    finally:
+        b.close()
+    assert check_exposition(text) == []
+    # the tenant fold actually engaged (48 tenants > the 30 budget)
+    assert 'tenant="other"' in text
+    # HELP precedes TYPE for the headline metrics
+    assert "# HELP ipt_requests_total" in text
+
+
+# ---------------------------------------------- bench-trend satellite
+
+def test_bench_trend_gate(tmp_path):
+    from tools.bench_trend import REGRESSION_GATE, load_artifacts, trend
+
+    def art(tag, value, error=None):
+        parsed = {"value": value, "platform": "cpu"}
+        if error:
+            parsed["error"] = error
+        (tmp_path / ("BENCH_%s.json" % tag)).write_text(json.dumps(
+            {"parsed": parsed}))
+
+    # no artifacts → SKIP (a fresh tree never fails CI)
+    assert trend(load_artifacts(str(tmp_path)))["status"] == "SKIP"
+    art("r01", 1000.0)
+    assert trend(load_artifacts(str(tmp_path)))["status"] == "SKIP"
+    # healthy growth → OK
+    art("r02", 1500.0)
+    rep = trend(load_artifacts(str(tmp_path)))
+    assert rep["status"] == "OK" and rep["latest"] == "r02"
+    # >10% regression vs the previous snapshot → FAIL
+    art("r03", 1500.0 * (1 - REGRESSION_GATE) - 1)
+    rep = trend(load_artifacts(str(tmp_path)))
+    assert rep["status"] == "FAIL"
+    assert "regressed" in rep["detail"]
+    # recovery → OK again, with the best-ever note not gating
+    art("r04", 1490.0)
+    rep = trend(load_artifacts(str(tmp_path)))
+    assert rep["status"] == "OK"
+    # a regression measured on a DEGRADED host (the artifact's own
+    # error tag) warns but does not hard-fail CI on infrastructure
+    art("r05", 500.0, error="tpu-unavailable: backend init hung")
+    rep = trend(load_artifacts(str(tmp_path)))
+    assert rep["status"] == "OK"
+    assert any("degraded-host" in w for w in rep["warnings"])

@@ -48,6 +48,14 @@ Four gates, one verdict:
              with zero new false negatives vs the fixed CRS weights,
              and flag strictly fewer benign requests at the calibrated
              threshold (reports/MODELGATE.json)
+  promlint   Prometheus exposition hygiene (analysis/promlint.py):
+             /metrics scraped from an in-process server after real
+             multi-tenant traffic — ipt_ prefix, _total on counters,
+             HELP/TYPE pairs, bounded label cardinality (fails on the
+             first unbounded per-rule/per-tenant series)
+  benchtrend the checked-in BENCH_r*.json req/s/chip trajectory
+             (tools/bench_trend.py): >10% regression vs the previous
+             snapshot fails; SKIPPED with fewer than two artifacts
 
 The container policy is "no new installs": when ruff or mypy are not
 present, those gates report SKIPPED (recorded in the CI report so the
@@ -381,6 +389,86 @@ def run_modelgate(write_report: bool) -> dict:
     return result
 
 
+def run_promlint() -> dict:
+    """Prometheus exposition hygiene gate (ISSUE 12 satellite,
+    analysis/promlint.py): scrape /metrics from an IN-PROCESS serve
+    loop after real multi-tenant traffic — naming (ipt_ prefix, _total
+    on counters), HELP/TYPE pairs, bounded label cardinality
+    (bounded_counter_series respected), histogram shape.  Fails on the
+    first unbounded per-rule or per-tenant series that slips into the
+    text exposition."""
+    t0 = time.time()
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+    from ingress_plus_tpu.analysis.promlint import check_exposition
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.batcher import Batcher
+    from ingress_plus_tpu.serve.server import ServeLoop
+    from ingress_plus_tpu.utils.corpus import generate_corpus
+
+    cr = compile_ruleset(load_bundled_rules())
+    pipe = DetectionPipeline(cr, mode="monitoring")
+    batcher = Batcher(pipe, max_batch=32)
+    try:
+        # multi-tenant traffic so the per-tenant/per-family folds are
+        # EXERCISED, not vacuously bounded: 48 distinct tenants is past
+        # the 30-series budget, so the "other" fold must engage
+        reqs = [lr.request for lr in
+                generate_corpus(n=96, attack_fraction=0.3, seed=7)]
+        for i, r in enumerate(reqs):
+            r.tenant = i % 48
+        futs = [batcher.submit(r) for r in reqs]
+        for f in futs:
+            f.result(timeout=120)
+        serve = ServeLoop(batcher, socket_path="/tmp/ipt-promlint.sock")
+        text = serve._metrics_text()
+    finally:
+        batcher.close()
+    findings = check_exposition(text)
+    return {
+        "status": "FAIL" if findings else "OK",
+        "seconds": round(time.time() - t0, 2),
+        "series_lines": sum(1 for ln in text.splitlines()
+                            if ln and not ln.startswith("#")),
+        "detail": "; ".join(findings[:20]) or
+        "exposition clean: %d series lines, every TYPE has HELP, all "
+        "label sets bounded"
+        % sum(1 for ln in text.splitlines()
+              if ln and not ln.startswith("#")),
+    }
+
+
+def run_benchtrend() -> dict:
+    """Bench trajectory gate (ISSUE 12 satellite, tools/bench_trend.py):
+    the latest checked-in BENCH_r*.json must not regress >10% vs the
+    previous snapshot.  SKIPPED cleanly when fewer than two artifacts
+    exist (a fresh tree has nothing to compare)."""
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "bench_trend.py"),
+         "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    try:
+        report = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        return {"status": "FAIL", "seconds": round(time.time() - t0, 2),
+                "detail": "bench_trend emitted no JSON (rc=%d): %s"
+                          % (proc.returncode,
+                             (proc.stderr or proc.stdout)[-300:])}
+    status = report.get("status", "FAIL")
+    return {
+        "status": {"OK": "OK", "SKIP": "SKIPPED"}.get(status, "FAIL"),
+        "seconds": round(time.time() - t0, 2),
+        "latest": report.get("latest"),
+        "latest_value": report.get("latest_value"),
+        "delta_vs_prev": report.get("delta_vs_prev"),
+        "detail": report.get("detail", ""),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tools/lint.py")
     ap.add_argument("--ci", action="store_true",
@@ -388,7 +476,7 @@ def main(argv=None) -> int:
     ap.add_argument("--only",
                     choices=["ruff", "mypy", "rulecheck", "concheck",
                              "deadrules", "faultmatrix", "swapdrill",
-                             "modelgate"],
+                             "modelgate", "promlint", "benchtrend"],
                     default=None)
     args = ap.parse_args(argv)
 
@@ -409,6 +497,10 @@ def main(argv=None) -> int:
         gates["swapdrill"] = run_swapdrill(write_report=args.ci)
     if args.only in (None, "modelgate"):
         gates["modelgate"] = run_modelgate(write_report=args.ci)
+    if args.only in (None, "promlint"):
+        gates["promlint"] = run_promlint()
+    if args.only in (None, "benchtrend"):
+        gates["benchtrend"] = run_benchtrend()
 
     failed = False
     for name, r in gates.items():
