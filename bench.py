@@ -110,6 +110,37 @@ def probe_backend(timeouts=(60, 90, 120), waits=(20, 40),
     return _PROBE_CACHE
 
 
+def _probe_block(platform: str, backend_err: "str | None",
+                 forced: "str | None" = None) -> dict:
+    """The TPU-probe verdict as an artifact-HEADER block (ISSUE 13
+    satellite): platform, device count and probe latency from the last
+    subprocess probe — so a silently-CPU run is labeled loudly at the
+    top of the BENCH json instead of discovered by reading
+    ``platform: cpu`` at the bottom."""
+    from ingress_plus_tpu.utils.platform import LAST_PROBE
+
+    blk = {
+        "platform": platform,
+        "device_count": LAST_PROBE.get("device_count")
+        if not forced else 1,
+        "probe_s": LAST_PROBE.get("probe_s"),
+        "error": backend_err,
+    }
+    if forced:
+        blk["forced"] = forced
+    if platform == "cpu":
+        if backend_err:
+            blk["note"] = ("CPU-FALLBACK RUN: the TPU probe failed — "
+                           "every throughput number in this artifact "
+                           "is a CPU proxy, not a per-chip claim")
+        elif forced:
+            blk["note"] = "explicit CPU run (%s)" % forced
+        else:
+            blk["note"] = ("no TPU plugin on this host — CPU numbers "
+                           "are a proxy, not a per-chip claim")
+    return blk
+
+
 def _widen_k(timed, d_lo: float, d_hi: float, it: int, tag: str,
              budget_frac: float = 0.5, cap: int = 2048):
     """Grow K 4x at a time until the K-diff clears RTT jitter (0.2s) or
@@ -285,7 +316,7 @@ def run_pack_scale(scales=(0.5, 1.0, 1.5, 2.0), n_req: int = 1024,
         infl = measure_inflation(cr_exact.tables, cr.tables, sample_rows)
         n_sv = cr.rule_sv_mask.shape[1]
         bufs = tuple(
-            (jax.device_put(tokens.astype(np.int32)),
+            (jax.device_put(tokens),   # uint8: raw-byte contract
              jax.device_put(lengths), jax.device_put(rreq),
              jax.device_put(row_sv))
             for _edge, tokens, lengths, rreq, row_sv in bucket_rows_np(
@@ -340,6 +371,9 @@ def run_pack_scale(scales=(0.5, 1.0, 1.5, 2.0), n_req: int = 1024,
 
     result = {"metric": "req/s vs pack scale (fused pair detect step, "
                         "%d-req corpus, CPU-or-live backend)" % n_req,
+              # per-leg backend tag (ISSUE 13 satellite): numbers from
+              # different backends must never be compared as a trend
+              "platform": jax.default_backend(),
               "points": points}
     one = next((p for p in points if p["scale"] == 1.0
                 and p["req_per_s"]), None)
@@ -451,6 +485,8 @@ def run_mesh_scale(points=(1, 2, 4, 8),
         "metric": "aggregate serve-plane req/s vs simulated device "
                   "count (lane-sharded batcher, bundled CRS pack, "
                   "virtual CPU devices)",
+        # per-leg backend tag (ISSUE 13 satellite)
+        "platform": "cpu-virtual",
         "host_cpus": os.cpu_count(),
         "points": results,
     }
@@ -705,6 +741,7 @@ def run_tenant_iso(n_tenants: int = 100, phase_s: float = 6.0,
                   "baseline (tenant-fair admission + flood guard, "
                   "bundled CRS pack, CPU)",
         "n_tenants": n_tenants,
+        "platform": "cpu",   # per-leg backend tag (ISSUE 13 satellite)
         "host_cpus": os.cpu_count(),
         "phase_s": phase_s,
         "victim_rps_offered": victim_rps,
@@ -782,11 +819,13 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
     iters = 129 if quick else 65  # small batches need more reps for signal
 
     global _PLATFORM_USED
+    probe_forced = None
     if force_cpu_err is not None:
         from ingress_plus_tpu.utils.platform import force_cpu_devices
 
         force_cpu_devices(1)
         platform, backend_err = "cpu", force_cpu_err
+        probe_forced = "tpu-dispatch-failed retry"
     elif os.environ.get("BENCH_PLATFORM") == "cpu":
         # explicit CPU run (smoke tests / CI): skip the ~8min TPU probe
         # ladder entirely
@@ -794,11 +833,23 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
 
         force_cpu_devices(1)
         platform, backend_err = "cpu", None
+        probe_forced = "BENCH_PLATFORM=cpu"
     else:
         platform, backend_err = probe_backend()
     _PLATFORM_USED = platform
+    probe_block = _probe_block(platform, backend_err, forced=probe_forced)
     _arm_watchdog()  # probe can eat ~3min of the budget; restart the clock
     log("platform: %s%s" % (platform, " (fallback: %s)" % backend_err if backend_err else ""))
+    if platform == "cpu" and probe_forced is None:
+        # silently-CPU guard (ISSUE 13 satellite): a run that WANTED a
+        # TPU and fell back must say so at the top of the round log,
+        # not just in a json field at the bottom
+        log("=" * 64)
+        log("PLATFORM WARNING: this bench is running on CPU (%s).  "
+            "Every number below is a CPU proxy; the artifact header's "
+            "`probe` block carries the verdict."
+            % (backend_err or "no TPU plugin"))
+        log("=" * 64)
 
     t0 = time.time()
     cr = compile_ruleset(load_bundled_rules())
@@ -830,7 +881,10 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
         for edge, tokens, lengths, rreq, row_sv in bucket_rows_np(
                 dat, req_ids, svs, cr_x.rule_sv_mask.shape[1], edges):
             bufs.append((
-                jax.device_put(tokens.astype(np.int32)),
+                # uint8 end-to-end (ISSUE 13): the raw-byte device
+                # contract — 4x less host→device transfer volume than
+                # the old int32 upcast; every scan impl casts on-device
+                jax.device_put(tokens),
                 jax.device_put(lengths),
                 jax.device_put(rreq),
                 jax.device_put(row_sv),
@@ -846,7 +900,7 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
 
     from ingress_plus_tpu.models.engine import detect_rows
 
-    scanner = scanner2 = None
+    scanner = scanner2 = scanner3 = None
     if platform != "cpu":
         from ingress_plus_tpu.ops.pallas_scan import (
             PallasPairScanner,
@@ -863,6 +917,15 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
             scanner2 = PallasPairScanner(tables.scan)
         except Exception as e:
             log("PallasPairScanner unavailable (non-fatal): %r" % e)
+    try:
+        # built on EVERY platform: the raw-byte scanner serves its XLA
+        # reference lowering on CPU (an explicit --impl=pallas3 CPU run
+        # measures the fused raw-byte program, docs/SCAN_KERNEL.md)
+        from ingress_plus_tpu.ops.pallas_scan import PallasByteScanner
+
+        scanner3 = PallasByteScanner(tables.scan)
+    except Exception as e:
+        log("PallasByteScanner unavailable (non-fatal): %r" % e)
 
     def make_detect_k(impl: str):
         """K state-chained repetitions of the full multi-bucket batch for
@@ -905,6 +968,10 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
                         # pair-kernel contract: sticky match chains; the
                         # dead-class-padded state is not a byte carry
                         match, state = scanner2(tok, lens, match=match)
+                    elif impl == "pallas3":
+                        # raw-byte fused kernel (ISSUE 13): uint8 in,
+                        # byte→reach mapping + padding on-device
+                        match, state = scanner3(tok, lens, match=match)
                     elif impl == "pair":
                         # pair path contract: state=None (request scans
                         # consume only the sticky match, which we chain)
@@ -935,17 +1002,25 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
     # measured-winner-first ordering (pair won r01-r03 on BOTH platforms):
     # if the watchdog fires mid-loop the stashed best-so-far is already
     # the likely champion, not the warm-up act
+    # pallas3 joins the default bake-off on TPU platforms (compiled
+    # kernel); on CPU its lowering is the pair program, so the default
+    # CPU loop skips the duplicate measurement — the `kernel` block
+    # (microbench --scan) carries the CPU A/B, and an explicit
+    # --impl=pallas3 still measures it here
     impls = (["pair"]
+             + (["pallas3"] if scanner3 is not None
+                and platform != "cpu" else [])
              + (["pallas2"] if scanner2 is not None else [])
              + (["pallas"] if scanner is not None else [])
              + ["take"])
     only = [a.split("=", 1)[1] for a in sys.argv if a.startswith("--impl=")]
     if only:
         bad = [i for i in only
-               if i not in ("take", "pair", "pallas", "pallas2")]
+               if i not in ("take", "pair", "pallas", "pallas2",
+                            "pallas3")]
         if bad:
             raise SystemExit("unknown --impl value(s) %s (choose from "
-                             "take/pair/pallas/pallas2)" % bad)
+                             "take/pair/pallas/pallas2/pallas3)" % bad)
         impls = only
     impl_stats: dict = {}
     best_impl, best_rps = None, -1.0
@@ -1008,6 +1083,7 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
                 "unit": "req/s/chip",
                 "vs_baseline": round(rps / 100_000.0, 4),
                 "platform": platform,
+                "probe": probe_block,
                 "scan_impl": impl,
                 "impls": impl_stats,
                 # cross-round auditability: r04 grew the pack 1405 -> 2002
@@ -1126,6 +1202,62 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
                 % _budget_left())
     except Exception as e:
         log("pack-scale leg failed (non-fatal): %r" % (e,))
+
+    # kernel microbench leg (ISSUE 13): the raw-byte fused device path
+    # vs the XLA lax.scan lowering at the dominant bucket tiers, plus
+    # the Mosaic-interpreter parity verdict — recorded as the `kernel`
+    # block.  A fused path LOSING to the baseline lowering is a
+    # regression in the hand-scheduled kernel and is warned about
+    # LOUDLY, never silently recorded.
+    try:
+        if _budget_left() > 150:
+            from ingress_plus_tpu.utils.microbench import bench_scan_modes
+
+            kb = bench_scan_modes(tables=tables.scan, iters=9)
+            result["kernel"] = kb
+            shapes = kb.get("shapes", [])
+            losing = [s for s in shapes
+                      if s.get("fused_vs_xla_scan") is not None
+                      and s["fused_vs_xla_scan"] < 1.0]
+            unmeasured = [s for s in shapes
+                          if s.get("fused_vs_xla_scan") is None]
+            if losing:
+                log("=" * 64)
+                log("KERNEL WARNING: the Pallas fused path LOSES to "
+                    "the XLA lax.scan lowering at %s — a regression "
+                    "in the hand-scheduled kernel (lowering: %s); "
+                    "pick the scan impl by measurement, not by hope."
+                    % ([(s["B"], s["L"]) for s in losing],
+                       kb.get("fused_lowering")))
+                log("=" * 64)
+            elif unmeasured:
+                # a timing failure is a broken MEASUREMENT, not a
+                # kernel regression — do not send the triage hunting
+                # a nonexistent kernel bug (review catch)
+                log("KERNEL WARNING: no timing signal at %s (K-diff "
+                    "<= 0, jitter > compute) — the fused-vs-lax.scan "
+                    "comparison is UNMEASURED at those shapes this "
+                    "round" % [(s["B"], s["L"]) for s in unmeasured])
+            else:
+                log("kernel: fused raw-byte path beats the lax.scan "
+                    "lowering at every dominant shape (%s)"
+                    % ", ".join("%.2fx" % s["fused_vs_xla_scan"]
+                                for s in kb.get("shapes", [])))
+            par = kb.get("interpret_parity") or {}
+            if not par.get("ok", True):
+                log("=" * 64)
+                log("KERNEL WARNING: Mosaic-interpreter parity "
+                    "DIVERGED from the XLA reference — the kernel the "
+                    "TPU would compile does not match the serving "
+                    "math (devicegate should have caught this)")
+                log("=" * 64)
+            _HEADLINE = dict(result)
+        else:
+            log("kernel microbench skipped inline (%.0fs budget "
+                "left); run `python -m ingress_plus_tpu.utils."
+                "microbench --scan` for the A/B" % _budget_left())
+    except Exception as e:
+        log("kernel microbench failed (non-fatal): %r" % (e,))
 
     # mesh-scale leg (ISSUE 7): aggregate serve-plane req/s across
     # 1/2/4/8 simulated devices — the measured multichip trajectory.
@@ -1534,6 +1666,9 @@ def run_latency_leg(cr, scan_impl: str, platform: str,
             "added_latency_p99_us": r["p99_us"],
             "latency_leg": {
                 "path": "loadgen->sidecar->serve(%s)" % platform,
+                # per-leg backend tag (ISSUE 13 satellite)
+                "platform": platform,
+                "scan_impl": scan_impl,
                 "requests": r["requests"], "rps": r["rps"],
                 "p90_us": r["p90_us"], "p999_us": r["p999_us"],
                 "fail_open": r["fail_open"],
@@ -1658,6 +1793,13 @@ def run_latency_leg(cr, scan_impl: str, platform: str,
                    "/".join("%s:%d" % kv
                             for kv in po["critical_path"].items()),
                    top.get("thread"), top.get("exclusive_share", 0.0)))
+            # measured host_prep share (ISSUE 13): the stage-level
+            # ranking the raw-byte offload is judged by — check_claims
+            # below warns when host_prep ranks above the device lanes
+            ss = po.get("stage_shares") or {}
+            log("stage shares (excl): " + " ".join(
+                "%s=%.3f" % (k, v.get("exclusive_share", 0.0))
+                for k, v in ss.items()))
             for w in check_claims(po):
                 log("=" * 64)
                 log("PIPELINE OVERLAP WARNING: %s" % w)

@@ -278,7 +278,11 @@ class DetectionEngine:
     #: able to run the Pallas kernel, picked by measurement, not by hope).
     #: "pallas2" = the round-4 class-pair Pallas kernel (half the serial
     #: steps, class-compressed MXU gather, double-buffered chunk overlap)
-    SCAN_IMPLS = ("pair", "take", "pallas", "pallas2")
+    #: "pallas3" = the raw-byte FUSED kernel (ISSUE 13): uint8 request
+    #: bytes + lengths in, byte→reach mapping and padding handled inside
+    #: the device program — host prep approaches a memcpy; on non-TPU
+    #: backends the same math serves via its XLA reference lowering
+    SCAN_IMPLS = ("pair", "take", "pallas", "pallas2", "pallas3")
 
     def __init__(self, cr: CompiledRuleset, scan_impl: str = "pair"):
         self.ruleset = cr
@@ -295,6 +299,11 @@ class DetectionEngine:
         self.pallas_interpret = False     # tests force True on CPU
         self._pallas = None
         self._pallas2 = None
+        self._pallas3 = None
+        # per-device pallas3 replicas (NamedSharding placement — the
+        # sigpack-replication story extended to the Pallas path):
+        # {device: PallasByteScanner}
+        self._pallas3_dev: dict = {}
         # per-device replicated tables (docs/MESH_SERVING.md): the
         # sigpack rides to each serve lane's chip ONCE, at first use —
         # {device: (tables, head_tables|None)}
@@ -316,6 +325,11 @@ class DetectionEngine:
         t = self.ruleset.tables
         return {
             "scan_impl": self.scan_impl,
+            # what the host ships per dispatch (ISSUE 13): raw uint8
+            # request bytes for the fused kernel, prepped/padded rows
+            # for everything else
+            "scan_contract": ("raw-bytes" if self.scan_impl == "pallas3"
+                              else "prepped-rows"),
             "n_rules": int(self.ruleset.n_rules),
             "n_factors": int(t.n_factors),
             "n_words": int(t.n_words),
@@ -332,7 +346,8 @@ class DetectionEngine:
         them head_only is a no-op, so callers must not key executables
         or warm twins on it)."""
         return (self.head_tables is not None
-                and self.scan_impl not in ("pallas", "pallas2"))
+                and self.scan_impl not in ("pallas", "pallas2",
+                                           "pallas3"))
 
     def swap_ruleset(self, cr: CompiledRuleset) -> None:
         # tables are a jit *argument* (pytree), so a geometry change just
@@ -345,6 +360,8 @@ class DetectionEngine:
             if 0 < cr.tables.n_head_words < cr.tables.n_words else None)
         self._pallas = None
         self._pallas2 = None
+        self._pallas3 = None
+        self._pallas3_dev = {}
         self._device_tables = {}
 
     def tables_for(self, device):
@@ -379,6 +396,31 @@ class DetectionEngine:
             self._pallas2 = PallasPairScanner(self.tables.scan)
         return self._pallas2
 
+    def _pallas_byte_scanner(self, device=None):
+        """The raw-byte fused scanner (scan_impl "pallas3"); ``device``
+        returns (building once per chip per generation) a replica whose
+        packed tables are NamedSharding-placed on that lane's chip."""
+        if self._pallas3 is None:
+            from ingress_plus_tpu.ops.pallas_scan import PallasByteScanner
+            self._pallas3 = PallasByteScanner(self.tables.scan)
+        if device is None:
+            return self._pallas3
+        sc = self._pallas3_dev.get(device)
+        if sc is None:
+            sc = self._pallas3.for_device(device)
+            self._pallas3_dev[device] = sc
+        return sc
+
+    def scan_exec_shape(self, B: int, L: int):
+        """Executable-keying shape of one (B, L) scan dispatch — the
+        pallas3 Mosaic kernel keys on tile-padded rectangles (several
+        bucket shapes share one executable), everything else on the
+        exact bucket shape.  The pipeline recompile gauge reads this
+        so the zero-serve-time-recompile pin counts REAL compiles."""
+        if self.scan_impl == "pallas3":
+            return self._pallas_byte_scanner().exec_shape(B, L)
+        return (B, L)
+
     def drop_compiled(self) -> None:
         """Forget every compiled executable (the recompile_storm fault
         site's hammer; also useful to measure cold-dispatch cost) —
@@ -386,6 +428,8 @@ class DetectionEngine:
         jax.clear_caches()
         self._pallas = None
         self._pallas2 = None
+        self._pallas3 = None
+        self._pallas3_dev = {}
         self._device_tables = {}
 
     def _rule_hits_device(self, tokens, lengths, row_req, row_sv,
@@ -406,6 +450,11 @@ class DetectionEngine:
                                        num_requests)
         if self.scan_impl == "pallas2":
             m, _ = self._pallas_pair_scanner()(
+                tokens, lengths, interpret=self.pallas_interpret)
+            return map_match_words_jit(self.tables, m, row_req, row_sv,
+                                       num_requests)
+        if self.scan_impl == "pallas3":
+            m, _ = self._pallas_byte_scanner()(
                 tokens, lengths, interpret=self.pallas_interpret)
             return map_match_words_jit(self.tables, m, row_req, row_sv,
                                        num_requests)
@@ -453,14 +502,19 @@ class DetectionEngine:
         (docs/MESH_SERVING.md): inputs are device_put there and the
         scan runs against that device's replicated tables
         (``tables_for``), so N lanes' dispatches execute concurrently
-        on N chips.  The Pallas kernels are built on the default
-        device's tables — for them ``device`` is ignored (the serve
-        lanes use pair/take on meshes; documented limitation)."""
+        on N chips.  The legacy pallas/pallas2 kernels are built on
+        the default device's tables — for them ``device`` is ignored
+        (documented limitation); pallas3 honors it via per-device
+        scanner replicas (NamedSharding placement)."""
         faults.sleep_if("dispatch_hang")
         faults.raise_if("dispatch_raise")
-        pallas = self.scan_impl in ("pallas", "pallas2")
+        pallas = self.scan_impl in ("pallas", "pallas2", "pallas3")
+        # pallas3 is device-aware: its packed tables replicate per chip
+        # like the sigpack, so mesh lanes keep the raw-byte path
+        use_device = device is not None and (
+            not pallas or self.scan_impl == "pallas3")
         full_tabs, head_tabs = (self.tables, self.head_tables)
-        if device is not None and not pallas:
+        if use_device:
             full_tabs, head_tabs = self.tables_for(device)
         tabs = (head_tabs
                 if head_only and head_tabs is not None
@@ -470,8 +524,7 @@ class DetectionEngine:
             return jnp.zeros((num_requests, max(R, 1)), bool)
 
         def _dev(x):
-            return (jax.device_put(x, device)
-                    if device is not None and not pallas
+            return (jax.device_put(x, device) if use_device
                     else jnp.asarray(x))
 
         ms, rrs, rss = [], [], []
@@ -480,9 +533,13 @@ class DetectionEngine:
             tok = _dev(tok)
             ln = _dev(ln)
             if pallas:
-                scanner = (self._pallas_scanner()
-                           if self.scan_impl == "pallas"
-                           else self._pallas_pair_scanner())
+                if self.scan_impl == "pallas":
+                    scanner = self._pallas_scanner()
+                elif self.scan_impl == "pallas2":
+                    scanner = self._pallas_pair_scanner()
+                else:
+                    scanner = self._pallas_byte_scanner(
+                        device if use_device else None)
                 m, _ = scanner(tok, ln, interpret=self.pallas_interpret)
             elif self.scan_impl == "take":
                 m, _ = scan_bytes_jit(tabs.scan, tok, ln)
@@ -538,7 +595,7 @@ class DetectionEngine:
             # bake-off at compile, not lose it
             include_pallas = jax.default_backend() in ("tpu", "axon")
         candidates = ["pair", "take"] + (
-            ["pallas", "pallas2"] if include_pallas else [])
+            ["pallas", "pallas2", "pallas3"] if include_pallas else [])
         rng = np.random.default_rng(7)
         tokens = jnp.asarray(rng.integers(32, 127, (B, L)).astype(np.uint8))
         lengths = jnp.asarray(np.full((B,), L, np.int32))
@@ -549,6 +606,8 @@ class DetectionEngine:
         scanner = (self._pallas_scanner() if "pallas" in candidates
                    else None)
         scanner2 = (self._pallas_pair_scanner() if "pallas2" in candidates
+                    else None)
+        scanner3 = (self._pallas_byte_scanner() if "pallas3" in candidates
                     else None)
         interpret = self.pallas_interpret
 
@@ -572,6 +631,12 @@ class DetectionEngine:
                         # pair-kernel state contract (scan_pairs): chain
                         # the sticky match only
                         match, state = scanner2(tok, lens, match=match,
+                                                interpret=interpret)
+                        rh, _, _ = map_match_words(
+                            tabs, match, rreq, rsv, 8)
+                    elif impl == "pallas3":
+                        # raw-byte fused kernel: same sticky-match chain
+                        match, state = scanner3(tok, lens, match=match,
                                                 interpret=interpret)
                         rh, _, _ = map_match_words(
                             tabs, match, rreq, rsv, 8)

@@ -58,8 +58,8 @@ MODE_NAME_STRENGTH = {"off": 0, "monitoring": 1, "safe_blocking": 2,
 from ingress_plus_tpu.ops.scan import pad_rows
 from ingress_plus_tpu.serve.normalize import (
     Request,
-    merge_rows,
-    rows_for_requests,
+    merged_rows_for_requests,
+    needed_variants_by_stream,
 )
 
 
@@ -452,6 +452,9 @@ class DetectionPipeline:
         self.paranoia_mask = ruleset.rule_paranoia <= paranoia_level
         self.needed_sv = set(
             int(sv) for sv in np.nonzero(ruleset.rule_sv_mask.any(axis=0))[0])
+        # per-stream needed-variant tuples, resolved once per install —
+        # the per-cycle host prep iterates these directly (ISSUE 13)
+        self._variants_for = needed_variants_by_stream(self.needed_sv)
         # head-slice qualification bound (docs/SCAN_KERNEL.md): rows
         # whose stream-variant ids all sit below this are uri/args/
         # headers rows and may scan the sliced head words
@@ -556,8 +559,15 @@ class DetectionPipeline:
                     new += 1
                     self._seen_exec.add(key)
             return new
+        # engines whose scan executables key on coarser-than-bucket
+        # shapes (the pallas3 Mosaic kernel keys on tile-padded
+        # rectangles) expose scan_exec_shape — without it the gauge
+        # would count phantom compiles for bucket shapes that share an
+        # already-warm executable (ISSUE 13)
+        shape_fn = getattr(self.engine, "scan_exec_shape", None)
         for B, L in bucket_shapes:
-            key = ("scan", B, L, head_ok, lane_key)
+            kb, kl = shape_fn(B, L) if shape_fn is not None else (B, L)
+            key = ("scan", kb, kl, head_ok, lane_key)
             if key not in self._seen_exec:
                 new += 1
                 self._seen_exec.add(key)
@@ -956,8 +966,11 @@ class DetectionPipeline:
             self.seen_lane_shapes.clear()
             self._seen_exec.clear()
             self.engine.drop_compiled()
-        rows = rows_for_requests(requests, needed_sv=self.needed_sv)
-        data_list, req_list, sv_list = merge_rows(rows)
+        # one-pass normalize+merge (ISSUE 13 host-prep offload): shared
+        # decode intermediates + identity-first dedup, byte-identical
+        # to merge_rows(rows_for_requests(...)) — pinned by test
+        data_list, req_list, sv_list = merged_rows_for_requests(
+            requests, variants_for=self._variants_for)
         Q = len(requests)
         stats = self.stats
         # stage attribution: everything up to here is host prep (the

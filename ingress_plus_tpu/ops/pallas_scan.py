@@ -39,7 +39,7 @@ from jax.experimental.pallas import tpu as pltpu
 _CompilerParams = getattr(pltpu, "CompilerParams", None) \
     or getattr(pltpu, "TPUCompilerParams")
 
-from ingress_plus_tpu.ops.scan import ScanTables, classes_for
+from ingress_plus_tpu.ops.scan import ScanTables, classes_for, scan_pairs_jit
 
 
 def _round_up(x: int, m: int) -> int:
@@ -510,3 +510,203 @@ class PallasPairScanner:
             TB=TB, CL=CL, MR=self.MR, interpret=interpret)
         to_u32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.uint32)
         return to_u32(out_m[:B, :W]), to_u32(out_s[:B, :W])
+
+
+# ---------------------------------------------------------------------------
+# Raw-byte fused kernel (ISSUE 13: "make the device path real")
+# ---------------------------------------------------------------------------
+#
+# The pallas2 host contract still made the caller prep CLASS arrays: an
+# eager (257,)-LUT gather (classes_for), eager padding ops, and an int32
+# upcast — per dispatch, on the host/default-device boundary.  The
+# Hyperflex observation (arXiv:2512.07123) is that for a shift-and NFA
+# packed across vector lanes, any byte-level pre-mapping composes into
+# the per-byte reach fetch: planes_byte[b] == planes_class[byte_class[b]]
+# by construction, so a kernel that one-hots RAW byte values over 257
+# rows (256 bytes + one dead padding index) computes bit-identical reach
+# rows with NO host-side class mapping at all.  The host ships the uint8
+# request bytes and the lengths — a memcpy — and everything else
+# (dead-index padding select, position-major transpose, the MXU reach
+# matmuls, the lane-packed pair chain) lives in ONE device program.
+#
+# The MXU price: the one-hot contraction runs over K1p = 384 padded rows
+# instead of the pack's K1p (128 on the bundled pack) — 3x the stage-1
+# matmul flops.  That stage overlaps the serial VPU chain (the pair
+# kernel's double-buffered prefetch), so the trade buys host-prep and
+# transfer volume with idle MXU cycles.  Measured truth lives in
+# `utils/microbench --scan`; parity is CI-gated (tools/lint.py
+# devicegate) in interpret mode.
+
+#: the reserved dead padding index of the raw-byte planes (row 256 has
+#: all-zero reach — a padded position kills its lane's state and leaves
+#: the sticky match stable, exactly the scan_pairs dead-class contract)
+DEAD_BYTE = 256
+
+
+def pack_byte_pair_tables(byte_table: np.ndarray, init_mask: np.ndarray,
+                          final_mask: np.ndarray):
+    """pack_pair_tables on the RAW byte axis: 257 rows (byte values +
+    the dead padding index LAST), padded to the kernel's 128-lane tiles
+    (K1p = 384).  The byte→class LUT is gone — it composes into the
+    planes (planes[b] = class_planes[byte_class[b]])."""
+    W = byte_table.shape[1]
+    bt = np.zeros((DEAD_BYTE + 1, W), np.uint32)
+    bt[:256] = np.asarray(byte_table)
+    return pack_pair_tables(bt, init_mask, final_mask)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("TB", "CL", "MR", "interpret"))
+def _fused_byte_scan(tokens, lengths, planes, init, final, state, match,
+                     TB: int, CL: int, MR: int, interpret: bool):
+    """Raw-byte fused device program: tokens (B, L) uint8 RAW request
+    bytes, lengths (B,) int32, state/match (B, W) uint32.  The
+    ragged/padding handling is one elementwise select (position >=
+    length → DEAD_BYTE) that XLA fuses into the position-major
+    transpose; the Mosaic pair kernel then needs no validity compares
+    at all.  Returns (match, state) as (B, W) uint32."""
+    B, L = tokens.shape
+    W = state.shape[1]
+    Wp = init.shape[1]
+    Bp = _round_up(max(B, TB), TB)
+    Lp = _round_up(max(L, CL), CL)
+    lengths = lengths.reshape(B)
+    toks = jnp.where(
+        jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None],
+        tokens.astype(jnp.int32), jnp.int32(DEAD_BYTE))
+    cls_p = jnp.full((Bp, Lp), DEAD_BYTE, jnp.int32).at[:B, :L].set(toks)
+    len_p = jnp.zeros((Bp, 1), jnp.int32).at[:B, 0].set(lengths)
+
+    def as_i32p(x):
+        x = jax.lax.bitcast_convert_type(x, jnp.int32)
+        return jnp.zeros((Bp, Wp), jnp.int32).at[:B, :W].set(x)
+
+    out_m, out_s = _pallas_pair_scan(
+        cls_p, len_p, planes, init, final, as_i32p(state), as_i32p(match),
+        TB=TB, CL=CL, MR=MR, interpret=interpret)
+    to_u32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.uint32)
+    return to_u32(out_m[:B, :W]), to_u32(out_s[:B, :W])
+
+
+class PallasByteScanner:
+    """Raw-byte fused scanner — serving name ``pallas3`` (ISSUE 13,
+    docs/SCAN_KERNEL.md "Device path").
+
+    Contract: uint8 request bytes + lengths IN, (match, state) uint32
+    OUT; byte→reach mapping, ragged/padding handling and the
+    lane-packed pair chain all execute inside one device program, so
+    the host path per dispatch approaches a memcpy (see the module
+    comment above for the design and its MXU trade).
+
+    Backend dispatch: on TPU backends the Mosaic kernel compiles; on
+    CPU (or ``mode="reference"``) the SAME math runs as the XLA
+    class-pair lowering (``scan_pairs`` — bit-identical by the plane
+    composition identity, pinned by tests/test_pallas_scan.py and the
+    ``devicegate`` CI gate), so ``--scan-impl pallas3`` serves
+    everywhere and the first real-TPU run is a flag flip, not a
+    porting project.  ``interpret=True`` forces the Mosaic interpreter
+    (the parity-test path).
+
+    State contract = scan_pairs (dead padding): rows shorter than L
+    return state 0 — request scans and equal-length chunk waves, NOT
+    ragged streaming carries (streams keep the byte path)."""
+
+    def __init__(self, tables: ScanTables, TB: int = 64, CL: int = 16,
+                 MR: int = 256):
+        if tables.pair_reach is None:
+            raise ValueError(
+                "tables built without byte classes (the reference "
+                "lowering needs the pair tables)")
+        W = tables.n_words
+        planes, init, final, K1p, Wp = pack_byte_pair_tables(
+            np.asarray(tables.byte_table), np.asarray(tables.init_mask),
+            np.asarray(tables.final_mask))
+        self.W, self.Wp, self.TB, self.CL, self.K1p = W, Wp, TB, CL, K1p
+        self.MR = check_pair_tiling(TB, CL, MR)
+        self.planes = jnp.asarray(planes, jnp.bfloat16)
+        self.init, self.final = jnp.asarray(init), jnp.asarray(final)
+        #: reference-lowering twin (a pytree — passed as a jit ARGUMENT
+        #: so nothing constant-folds, the BENCH_r02 lesson)
+        self.tables = tables
+        self.device = None   # for_device() replicas record their chip
+
+    # ------------------------------------------------------- placement
+
+    def for_device(self, device):
+        """Replica with the packed tables placed on ``device`` via the
+        NamedSharding idiom (SNIPPETS.md [3]): a one-device mesh with a
+        replicated PartitionSpec pins this lane's copy to its own chip,
+        so N serve lanes dispatch the kernel concurrently — the
+        ``tables_for`` sigpack-replication story (docs/MESH_SERVING.md)
+        now covers the Pallas path too."""
+        import copy
+
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        sh = NamedSharding(Mesh(np.asarray([device]), ("lane",)),
+                           PartitionSpec())
+        new = copy.copy(self)
+        new.planes = jax.device_put(self.planes, sh)
+        new.init = jax.device_put(self.init, sh)
+        new.final = jax.device_put(self.final, sh)
+        new.tables = jax.device_put(self.tables, sh)
+        new.device = device
+        return new
+
+    # ------------------------------------------------------- exec keys
+
+    def _use_kernel(self) -> bool:
+        """Mosaic compiles only on TPU platforms ("axon" = this rig's
+        remote-TPU PJRT plugin); everywhere else the reference lowering
+        serves (pallas_call without interpret would raise on CPU)."""
+        return jax.default_backend() in ("tpu", "axon")
+
+    def exec_shape(self, B: int, L: int) -> Tuple[int, int]:
+        """The executable-keying shape of one (B, L) dispatch: the
+        Mosaic kernel keys on the TILE-padded rectangle (several host
+        bucket shapes share one executable), the reference lowering on
+        the exact shape.  The pipeline recompile gauge reads this so
+        pallas3 serving counts real compiles, not phantom ones."""
+        if self._use_kernel():
+            return (_round_up(max(B, self.TB), self.TB),
+                    _round_up(max(L, self.CL), self.CL))
+        return (B, L)
+
+    # --------------------------------------------------------- dispatch
+
+    def __call__(self, tokens, lengths, state=None, match=None,
+                 interpret: bool = False, mode: str = "auto"):
+        """scan_bytes-shaped call: returns (match, state) (B, W) uint32.
+
+        ``mode``: "auto" = Mosaic kernel on TPU backends, reference XLA
+        lowering elsewhere; "kernel" forces the pallas_call (compiled,
+        or Mosaic-interpreted with interpret=True); "reference" forces
+        the XLA lowering."""
+        tokens = jnp.asarray(tokens)
+        B, L = tokens.shape
+        W = self.W
+        lengths = jnp.asarray(lengths).astype(jnp.int32).reshape(B)
+        if mode == "auto":
+            mode = "kernel" if (interpret or self._use_kernel()) \
+                else "reference"
+
+        def as_u32(x):
+            if x is None:
+                return jnp.zeros((B, W), jnp.uint32)
+            x = jnp.asarray(x)
+            return (x if x.dtype == jnp.uint32
+                    else jax.lax.bitcast_convert_type(x, jnp.uint32))
+
+        state, match = as_u32(state), as_u32(match)
+        if mode == "reference":
+            if L % 2:
+                # the pair fold consumes two bytes per step; one extra
+                # column is past every row's length, so classes_for
+                # maps it to the dead class — math unchanged
+                tokens = jnp.pad(tokens, ((0, 0), (0, 1)))
+            return scan_pairs_jit(self.tables, tokens, lengths,
+                                  state, match)
+        return _fused_byte_scan(
+            tokens, lengths, self.planes, self.init, self.final,
+            state, match, TB=self.TB, CL=self.CL, MR=self.MR,
+            interpret=interpret)

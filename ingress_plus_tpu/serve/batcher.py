@@ -1899,6 +1899,27 @@ class Batcher:
             out.append((ts, r, v, lane_idx))
         return out
 
+    def device_path_snapshot(self) -> dict:
+        """What the scan plane actually ships per dispatch (ISSUE 13,
+        docs/SCAN_KERNEL.md "Device path"): scan impl, host contract
+        (raw uint8 bytes vs host-prepped rows), live jax backend, and
+        the per-lane device placement — served under /healthz
+        ``robustness.device_path`` so "is the raw-byte device path
+        live on a real chip" is one probe, not a checkpoint read."""
+        import jax
+
+        eng = self.pipeline.engine
+        impl = getattr(eng, "scan_impl", "?")
+        return {
+            "scan_impl": impl,
+            "scan_contract": ("raw-bytes" if impl == "pallas3"
+                              else "prepped-rows"),
+            "backend": jax.default_backend(),
+            "lane_devices": [
+                str(lane.device) if lane.device is not None
+                else "default" for lane in self.lanes.lanes],
+        }
+
     def warm_lanes(self, max_batch: Optional[int] = None) -> None:
         """Pre-compile every per-lane executable an all-healthy mesh
         dispatch can hit (the mesh twin of server.warmup_pipeline):

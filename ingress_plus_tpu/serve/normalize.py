@@ -19,6 +19,7 @@ per stream.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -126,38 +127,40 @@ def fold_overlong_utf8(data: bytes) -> bytes:
 
 
 def html_entity_decode(data: bytes) -> bytes:
-    """&#NN; / &#xHH; / common named entities (one pass)."""
-    if 0x26 not in data:  # & — one C-level scan, no Python byte loop
+    """&#NN; / &#xHH; / common named entities (one pass).
+
+    Split-based (ISSUE 13 host-prep): every ARGS row contains '&' as
+    the query separator, so the old per-byte Python walk ran on
+    essentially all query traffic — now rows without a ';' return
+    unchanged after two C-level scans, and rows with escapes process
+    per-'&'-segment.  Semantics identical to the byte loop: an entity
+    is a ';' within 9 bytes after the '&'; a failed parse keeps the
+    literal '&' and the segment is emitted as-is (each '&' starts its
+    own segment, so nothing needs rescanning)."""
+    if 0x26 not in data or 0x3B not in data:  # & and ; both required
         return data
-    out = bytearray()
-    i, n = 0, len(data)
-    while i < n:
-        b = data[i]
-        if b != 0x26:  # &
-            out.append(b)
-            i += 1
-            continue
-        j = data.find(b";", i + 1, i + 10)
-        if j < 0:
-            out.append(b)
-            i += 1
-            continue
-        body = data[i + 1 : j]
-        if body[:1] == b"#":
-            num = body[1:]
-            try:
-                code = int(num[1:], 16) if num[:1] in (b"x", b"X") else int(num)
-                out.append(code & 0xFF)
-                i = j + 1
+    parts = data.split(b"&")
+    out = bytearray(parts[0])
+    for p in parts[1:]:
+        j = p.find(b";", 0, 9)
+        if j > 0:
+            body = p[:j]
+            if body[:1] == b"#":
+                num = body[1:]
+                try:
+                    code = (int(num[1:], 16) if num[:1] in (b"x", b"X")
+                            else int(num))
+                    out.append(code & 0xFF)
+                    out += p[j + 1:]
+                    continue
+                except ValueError:
+                    pass
+            elif body.lower() in _NAMED_ENTITIES:
+                out += _NAMED_ENTITIES[body.lower()]
+                out += p[j + 1:]
                 continue
-            except ValueError:
-                pass
-        elif body.lower() in _NAMED_ENTITIES:
-            out += _NAMED_ENTITIES[body.lower()]
-            i = j + 1
-            continue
-        out.append(b)
-        i += 1
+        out.append(0x26)
+        out += p
     return bytes(out)
 
 
@@ -166,6 +169,21 @@ def remove_nulls(data: bytes) -> bytes:
 
 
 _SQUASH_DELETE = bytes(sorted(SQUASH_BYTES))
+
+#: anything the DECODE side of the variant chains reacts to: url-decode
+#: triggers ('+', '%'), nulls, overlong-UTF-8 leads (C0/C1/E0), or a
+#: *decodable-shaped* html entity — '&' with a ';' within the next 9
+#: bytes (html_entity_decode's exact window; a bare '&', the query-arg
+#: separator on virtually every ARGS row, decodes to itself).  No match
+#: ⇒ dec == dec_html == raw, one early-exit C scan (ISSUE 13 benign
+#: fast path).  Over-matching (an entity-shaped span that fails to
+#: parse) only costs the slow path, never correctness.
+_DECODE_SPECIALS = re.compile(rb"(?s)[+%\x00\xc0\xc1\xe0]|&.{0,8};")
+
+#: the squash set as a scan — no match ⇒ squash(x) == x, so the three
+#: squash variants collapse onto their parents
+_SQUASH_SPECIALS = re.compile(
+    b"[" + re.escape(bytes(sorted(SQUASH_BYTES))) + b"]")
 
 
 def squash(data: bytes) -> bytes:
@@ -203,9 +221,12 @@ def headers_blob(headers) -> bytes:
     (unit separator) survives every transform, matches no rule, and
     prevents cross-header false adjacency (\\n would trip the
     CRLF-injection rules on every request)."""
-    return b"\x1f".join(
-        ("%s: %s" % (k, v)).encode("utf-8", "surrogateescape")
-        for k, v in headers.items())
+    # join in str space, encode ONCE (utf-8 is per-character local, so
+    # one encode of the '\x1f'-joined string is byte-identical to
+    # joining per-header encodes — ISSUE 13 host-prep)
+    return "\x1f".join(
+        ["%s: %s" % kv for kv in headers.items()]
+    ).encode("utf-8", "surrogateescape")
 
 
 @dataclass
@@ -408,4 +429,140 @@ def merge_rows(rows: List[ScanRow]) -> Tuple[List[bytes], List[int], List[List[i
         data_list.append(data)
         req_list.append(qi)
         sv_list.append(sorted(set(svs)))
+    return data_list, req_list, sv_list
+
+
+def needed_variants_by_stream(
+        needed_sv: Optional[Iterable[int]]) -> Dict[int, tuple]:
+    """Per-stream-index tuples of the variant ids any rule needs —
+    resolved once per ruleset install (DetectionPipeline caches this)
+    instead of one set-membership test per (row, variant) per cycle."""
+    needed = set(needed_sv) if needed_sv is not None else None
+    nv = len(VARIANTS)
+    return {
+        si: tuple(v for v in range(nv)
+                  if needed is None or si * nv + v in needed)
+        for si in STREAM_INDEX.values()
+    }
+
+
+def merged_rows_for_requests(
+    requests: List[Request],
+    needed_sv: Optional[Iterable[int]] = None,
+    max_row_bytes: int = 1 << 20,
+    variants_for: Optional[Dict[int, tuple]] = None,
+) -> Tuple[List[bytes], List[int], List[List[int]]]:
+    """``merge_rows(rows_for_requests(...))`` in ONE pass — the serving
+    hot path (ISSUE 13 host-prep offload; output is pinned byte- and
+    order-identical to the two-pass composition by
+    tests/test_unpack.py).
+
+    What the fused pass saves, measured as the dominant terms of the
+    profiled ``prep_us`` stage:
+
+    * **shared decode intermediates** — ``variant_chain(raw, v)``
+      recomputed the url-decode for variants 1/2/4/5 and the
+      html-entity decode for 2/4 from scratch per variant; here ``dec``
+      and ``dec_html`` are computed once per stream and every variant
+      derives from them (identical composition order, so bytes cannot
+      differ);
+    * **no intermediate ScanRow materialization** — rows fold straight
+      into the per-request dedup dict (one hash per row instead of
+      dataclass + list append + a second full pass);
+    * **two-tier benign fast path** — a row with no DECODE special
+      (``_DECODE_SPECIALS``: '+', '%', NUL, overlong-UTF-8 leads, or
+      an entity-shaped ``&...;``) has ``dec == dec_html == raw``, so
+      variants 0/1/2 collapse onto raw and 3/4/5 onto ONE
+      ``squash(raw)``; if the squash set is absent too, the whole
+      stream is a single row carrying every needed sv id.  One or two
+      early-exit regex scans replace five decode chains and five dedup
+      hashes on clean traffic (and header rows — always
+      squash-special, never decode-special — pay one squash, not
+      three).
+    """
+    nv = len(VARIANTS)
+    if variants_for is None:
+        variants_for = needed_variants_by_stream(needed_sv)
+    data_list: List[bytes] = []
+    req_list: List[int] = []
+    sv_list: List[List[int]] = []
+    dec_specials = _DECODE_SPECIALS.search
+    sq_specials = _SQUASH_SPECIALS.search
+    stream_index = STREAM_INDEX
+    d_append, r_append, s_append = (data_list.append, req_list.append,
+                                    sv_list.append)
+    for qi, req in enumerate(requests):
+        # dedup scope matches merge_rows' (request, bytes) key: rows
+        # merge across STREAMS of one request, never across requests
+        index: Dict[bytes, int] = {}
+        index_get = index.get
+        for sname, raw in req.streams().items():
+            if not raw:
+                continue
+            if len(raw) > max_row_bytes:
+                raw = raw[:max_row_bytes]
+            si = stream_index[sname]
+            base = si * nv
+            vs = variants_for[si]
+            if not vs:
+                continue
+            if dec_specials(raw) is None:
+                # decode side inert: variants 0/1/2 ARE raw and the
+                # three squash variants share one squash(raw)
+                if sq_specials(raw) is None:
+                    groups = ((raw, [base + v for v in vs]),)
+                else:
+                    sq = raw.translate(None, _SQUASH_DELETE)
+                    groups = (
+                        (raw, [base + v for v in vs if v < 3]),
+                        (sq, [base + v for v in vs if v >= 3]),
+                    )
+                for data, svs in groups:
+                    if not data or not svs:
+                        continue
+                    j = index_get(data)
+                    if j is None:
+                        index[data] = len(data_list)
+                        d_append(data)
+                        r_append(qi)
+                        s_append(svs)
+                    else:
+                        sv_list[j].extend(svs)
+                continue
+            dec: Optional[bytes] = None
+            dec_html: Optional[bytes] = None
+            for v in vs:
+                sv = base + v
+                # variant_chain(raw, v), intermediates shared
+                if v == 0:
+                    data = raw
+                elif v == 3:
+                    data = squash(raw)
+                else:
+                    if dec is None:
+                        dec = remove_nulls(url_decode_uni(raw))
+                    if v == 1:
+                        data = dec
+                    elif v == 5:
+                        data = squash(dec)
+                    else:
+                        if dec_html is None:
+                            dec_html = html_entity_decode(dec)
+                        data = dec_html if v == 2 else squash(dec_html)
+                if not data:
+                    continue
+                j = index_get(data)
+                if j is None:
+                    index[data] = len(data_list)
+                    d_append(data)
+                    r_append(qi)
+                    s_append([sv])
+                else:
+                    sv_list[j].append(sv)
+    # merge_rows sorts each row's sv ids; emission order here is
+    # ascending within a stream but streams of one request may merge
+    # out of si order, so sort the short lists the same way
+    for svs in sv_list:
+        if len(svs) > 1:
+            svs.sort()
     return data_list, req_list, sv_list

@@ -837,6 +837,9 @@ class ServeLoop:
                     # worker exceptions by thread family — nonzero here
                     # means a thread died that nothing else surfaced
                     "thread_uncaught": thread_uncaught_counts(),
+                    # raw-byte device path (ISSUE 13): impl + host
+                    # contract + backend + lane placement in one probe
+                    "device_path": self.batcher.device_path_snapshot(),
                 },
                 # cycle flight recorder (ISSUE 12): the measured
                 # pipeline-overlap brief — scan↔confirm overlap, drain
@@ -1462,11 +1465,12 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
     pipeline = DetectionPipeline(cr, mode=mode, engine=engine,
                                  confirm_workers=confirm_workers)
     if mesh_spec:
-        if scan_impl == "pallas":
-            # the byte kernel has no sharded variant; the class-pair
-            # kernel is its mesh counterpart
-            print("mesh serving: --scan-impl pallas -> pallas2 "
-                  "(sharded variant)", file=sys.stderr)
+        if scan_impl in ("pallas", "pallas3"):
+            # neither the byte kernel nor the raw-byte fused kernel has
+            # a TP-sharded variant; the class-pair kernel is their mesh
+            # counterpart
+            print("mesh serving: --scan-impl %s -> pallas2 "
+                  "(sharded variant)" % scan_impl, file=sys.stderr)
             scan_impl = "pallas2"
     if scan_impl == "auto":
         # startup microbench on the LIVE backend picks the serving scan
@@ -1641,7 +1645,8 @@ def main(argv=None) -> None:
                          "request share open; with the mesh loop, "
                          "confirm overlaps the next cycle's scan")
     ap.add_argument("--scan-impl", default="auto",
-                    choices=["auto", "pair", "take", "pallas", "pallas2"],
+                    choices=["auto", "pair", "take", "pallas", "pallas2",
+                             "pallas3"],
                     help="TPU scan implementation; auto = startup "
                          "microbench on the live backend picks the "
                          "fastest (pallas excluded on cpu)")

@@ -42,7 +42,7 @@ import re
 import struct
 import zlib
 import xml.etree.ElementTree as ET
-from typing import Dict, FrozenSet, Optional
+from typing import Dict, FrozenSet, Optional, Tuple
 
 SEP = b"\x1f"
 PARSERS = ("gzip", "base64", "json", "xml")
@@ -62,6 +62,29 @@ def header_lookup(headers: Dict[str, str], name: str) -> str:
         if k.lower() == name:
             return v
     return ""
+
+
+def content_headers(headers: Dict[str, str]) -> Tuple[str, str]:
+    """(content-type, content-encoding), both lowercased, in ONE pass
+    over the header dict — unpack_body runs on every body'd request's
+    scan AND confirm path, so the two separate case-folding walks it
+    used to do were a measurable slice of host prep (ISSUE 13).
+
+    FIRST match wins, exactly like header_lookup: the streaming path
+    (serve/stream.py) still resolves these headers via header_lookup,
+    and duplicate case-variant headers picking different values per
+    path would give the buffered and streamed scans of identical bytes
+    different parser selection — a bypass-shaped inconsistency."""
+    ct: Optional[str] = None
+    ce: Optional[str] = None
+    for k, v in headers.items():
+        lk = k.lower()
+        if lk == "content-type":
+            if ct is None:
+                ct = v.lower()
+        elif lk == "content-encoding" and ce is None:
+            ce = v.lower()
+    return ct or "", ce or ""
 
 
 def inflate(data: bytes, max_out: int = DEFAULT_MAX_OUT,
@@ -346,8 +369,7 @@ def unpack_body(body: bytes, headers: Dict[str, str],
     if not body:
         return body
     off = parsers_off
-    ct = header_lookup(headers, "content-type").lower()
-    ce = header_lookup(headers, "content-encoding").lower()
+    ct, ce = content_headers(headers)
 
     base = body
     if "gzip" not in off and (

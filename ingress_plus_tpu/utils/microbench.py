@@ -154,6 +154,159 @@ def bench_pallas(tables: ScanTables, batch: int, length: int,
     return batch * length / per_scan / 1e6
 
 
+def bench_scan_modes(tables: ScanTables = None,
+                     shapes=((512, 64), (256, 128), (128, 256)),
+                     iters: int = 17,
+                     interpret_shape=(8, 64)) -> dict:
+    """Scan-path A/B for the raw-byte device path (ISSUE 13,
+    ``--scan``): per (B, L) — the bundled pack's dominant serving
+    bucket tiers — measure
+
+    * ``xla_scan``: ops/scan.py ``scan_bytes``, the per-byte
+      ``lax.scan`` lowering (the baseline the acceptance gate names);
+    * ``fused``: the pallas3 raw-byte fused program — the compiled
+      Mosaic kernel on TPU backends, its XLA reference lowering on CPU
+      (bit-identical math, the class-pair fold; docs/SCAN_KERNEL.md
+      "Device path").  uint8 tokens generated in-program, tables as
+      jit ARGUMENTS (nothing constant-folds — the BENCH_r02 lesson).
+
+    Plus ONE Mosaic-interpreter parity run at a small shape: the
+    kernel code path the TPU lowering compiles, checked bit-identical
+    against the XLA reference (the devicegate CI gate runs the full
+    version of this).  K-diff timing throughout (module docstring).
+    """
+    import functools
+
+    import jax.numpy as jnp
+
+    from ingress_plus_tpu.ops.pallas_scan import PallasByteScanner
+    from ingress_plus_tpu.ops.scan import scan_bytes, scan_pairs
+
+    if tables is None:
+        cr = compile_ruleset(load_bundled_rules())
+        tables = ScanTables.from_bitap(cr.tables)
+    sc = PallasByteScanner(tables)
+    use_kernel = sc._use_kernel()
+    W = tables.n_words
+    out: dict = {
+        "metric": "scan-path MB/s per dominant (B, L) bucket tier, "
+                  "K-diff timed",
+        "backend": jax.default_backend(),
+        "platform": jax.default_backend(),
+        "fused_lowering": ("mosaic-kernel" if use_kernel
+                           else "xla-reference"),
+        "n_words": int(W),
+        "shapes": [],
+    }
+
+    @functools.partial(jax.jit, static_argnames=("k", "B", "L"))
+    def xla_scan_k(key, k, tabs, lengths, B, L):
+        tokens = jax.random.randint(key, (B, L), 32, 127, dtype=jnp.int32)
+
+        def body(i, carry):
+            s, m = carry
+            m, s = scan_bytes(tabs, tokens, lengths, state=s, match=m)
+            return (s, m)
+
+        z = jnp.zeros((B, W), jnp.uint32)
+        s, m = jax.lax.fori_loop(0, k, body, (z, z))
+        return m.sum()
+
+    @functools.partial(jax.jit, static_argnames=("k", "B", "L"))
+    def fused_ref_k(key, k, tabs, lengths, B, L):
+        tokens = jax.random.randint(
+            key, (B, L), 32, 127, dtype=jnp.int32).astype(jnp.uint8)
+
+        def body(i, m):
+            m2, _ = scan_pairs(tabs, tokens, lengths, None, m)
+            return m2
+
+        m = jax.lax.fori_loop(0, k, body, jnp.zeros((B, W), jnp.uint32))
+        return m.sum()
+
+    def fused_kernel_k(B, L):
+        from ingress_plus_tpu.ops.pallas_scan import _fused_byte_scan
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def kk(key, k, planes, init, final, lengths):
+            tokens = jax.random.randint(
+                key, (B, L), 32, 127, dtype=jnp.int32).astype(jnp.uint8)
+
+            def body(i, m):
+                m2, _ = _fused_byte_scan(
+                    tokens, lengths, planes, init, final,
+                    jnp.zeros((B, W), jnp.uint32), m,
+                    TB=sc.TB, CL=sc.CL, MR=sc.MR, interpret=False)
+                return m2
+
+            m = jax.lax.fori_loop(0, k, body,
+                                  jnp.zeros((B, W), jnp.uint32))
+            return m.sum()
+
+        return kk
+
+    fused_wins = True
+    for B, L in shapes:
+        # ragged like serving: 3/4 of the rows fill the tier, the rest
+        # sit at half — both lowerings walk the padded length, so the
+        # comparison stays apples-to-apples
+        lens_np = np.full((B,), L, np.int32)
+        lens_np[::4] = max(1, L // 2)
+        lengths = jnp.asarray(lens_np)
+        row = {"B": B, "L": L}
+        dt = k_diff_time(
+            lambda k, rep: xla_scan_k(
+                jax.random.PRNGKey(100 * k + rep), k, tables, lengths,
+                B, L), iters)
+        row["xla_scan_mb_s"] = (round(B * L / dt / 1e6, 1)
+                                if dt > 0 else None)
+        if use_kernel:
+            kk = fused_kernel_k(B, L)
+            dtf = k_diff_time(
+                lambda k, rep: kk(jax.random.PRNGKey(100 * k + rep), k,
+                                  sc.planes, sc.init, sc.final,
+                                  lengths), iters)
+        else:
+            dtf = k_diff_time(
+                lambda k, rep: fused_ref_k(
+                    jax.random.PRNGKey(100 * k + rep), k, tables,
+                    lengths, B, L), iters)
+        row["fused_mb_s"] = (round(B * L / dtf / 1e6, 1)
+                             if dtf > 0 else None)
+        if row["xla_scan_mb_s"] and row["fused_mb_s"]:
+            row["fused_vs_xla_scan"] = round(
+                row["fused_mb_s"] / row["xla_scan_mb_s"], 3)
+            if row["fused_vs_xla_scan"] < 1.0:
+                fused_wins = False
+        else:
+            row["fused_vs_xla_scan"] = None
+            fused_wins = False
+        out["shapes"].append(row)
+        print("shape B=%-4d L=%-5d  xla_scan=%s MB/s  fused=%s MB/s "
+              "(%sx)" % (B, L, row["xla_scan_mb_s"], row["fused_mb_s"],
+                         row.get("fused_vs_xla_scan")))
+    out["fused_wins_all_shapes"] = fused_wins
+
+    # Mosaic-interpreter parity at a small shape: the kernel CODE PATH,
+    # bit-identical match words vs the XLA reference (full coverage =
+    # the devicegate CI gate)
+    B, L = interpret_shape
+    rng = np.random.default_rng(3)
+    toks = rng.integers(32, 127, (B, L)).astype(np.uint8)
+    lens = np.full((B,), L, np.int32)
+    lens[::3] = L // 3
+    t0 = time.perf_counter()
+    km, _ = sc(toks, lens, interpret=True)
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    rm, _ = sc(toks, lens, mode="reference")
+    ok = bool(np.array_equal(np.asarray(km), np.asarray(rm)))
+    out["interpret_parity"] = {"ok": ok, "B": B, "L": L,
+                               "wall_ms": round(wall_ms, 1)}
+    print("interpret parity (%dx%d): %s (%.0f ms, Mosaic interpreter)"
+          % (B, L, "OK" if ok else "DIVERGED", wall_ms))
+    return out
+
+
 def bench_confirm(n_req: int = 1024, iters: int = 5,
                   flood_dup: int = 4) -> dict:
     """Confirm-stage microbench (docs/CONFIRM_PLANE.md): full CPU
@@ -255,6 +408,13 @@ def main() -> None:
                          "sweep: quick-reject / flood-memo toggles over "
                          "full pipeline.detect (docs/CONFIRM_PLANE.md); "
                          "always CPU")
+    ap.add_argument("--scan", action="store_true",
+                    help="raw-byte device-path A/B (ISSUE 13, "
+                         "docs/SCAN_KERNEL.md 'Device path'): the "
+                         "pallas3 fused program vs the XLA lax.scan "
+                         "lowering at the dominant bucket tiers, plus "
+                         "a Mosaic-interpreter parity run; compiled "
+                         "kernel on TPU, reference lowering on CPU")
     ap.add_argument("--reqs", type=int, default=1024,
                     help="corpus size for --confirm")
     args = ap.parse_args()
@@ -268,6 +428,13 @@ def main() -> None:
         # --iters defaults are tuned for the K-chained scan; a confirm
         # pass is a full corpus detect, so clamp to a sane wall budget
         bench_confirm(n_req=args.reqs, iters=max(2, min(args.iters, 5)))
+        return
+
+    if args.scan:
+        import json
+
+        out = bench_scan_modes(iters=max(3, args.iters))
+        print(json.dumps(out, indent=2))
         return
 
     cr = compile_ruleset(load_bundled_rules())

@@ -192,6 +192,35 @@ def overlap_report(snapshot: dict,
     critical_path = dict(sorted(crit_counts.items(),
                                 key=lambda kv: -kv[1]))
 
+    # --- per-STAGE busy/exclusive shares (ISSUE 13): the stage-level
+    # twin of the thread residue ranking below.  Threads conflate work
+    # kinds (the dispatch thread preps AND launches; a lane worker's
+    # span is the chip), so "is host prep what bounds the pipeline" is
+    # answered here: host_prep ranking above device_scan in exclusive
+    # busy is exactly the condition the raw-byte device path
+    # (scan_impl pallas3) exists to remove — check_claims() warns on it
+    stage_iv: Dict[str, List[Tuple[int, int]]] = {}
+    for code, name in ((EV_PREP, "host_prep"),
+                       (EV_DEVICE, "device_scan"),
+                       (EV_CONFIRM, "confirm"),
+                       (EV_FINALIZE, "finalize"),
+                       (EV_LAUNCH, "lane_launch")):
+        stage_iv[name] = _intersect(_merge(
+            [(s["t0_ns"], s["t1_ns"]) for s in spans
+             if s["code"] == code]), window)
+    any_stage_ns = _total(_merge(
+        [x for iv in stage_iv.values() for x in iv])) or 1
+    stage_shares = {}
+    for name, iv in stage_iv.items():
+        others = _merge([x for n2, iv2 in stage_iv.items()
+                         if n2 != name for x in iv2])
+        busy = _total(iv)
+        exclusive = busy - _total(_intersect(iv, others))
+        stage_shares[name] = {
+            "busy_share": round(busy / any_stage_ns, 4),
+            "exclusive_share": round(exclusive / any_stage_ns, 4),
+        }
+
     # --- serialized residue: per thread, busy-time union and the share
     # of it during which NO other thread was busy.  The all-active
     # union is the denominator so the ranking answers "who bounds
@@ -232,6 +261,7 @@ def overlap_report(snapshot: dict,
         "confirm_busy_ms": round(confirm_ns / 1e6, 3),
         "lane_idle_share": lane_idle,
         "drain_occupancy": drain_occupancy,
+        "stage_shares": stage_shares,
         "critical_path": critical_path,
         "serialized_residue": residue[:8],
         "dropped_events": snapshot.get("dropped", 0),
@@ -265,12 +295,19 @@ def brief(report: Optional[dict]) -> Optional[dict]:
     if report is None:
         return None
     top = report["serialized_residue"][:1]
+    ss = report.get("stage_shares") or {}
     return {
         "cycles": report["cycles"],
         "scan_confirm_overlap": report["scan_confirm_overlap"],
         "drain_occupancy": report["drain_occupancy"],
         "critical_path": report["critical_path"],
         "bounding_thread": (top[0] if top else None),
+        # ISSUE 13: the host-prep-vs-device ranking at a glance — the
+        # raw-byte offload is judged by host_prep staying BELOW device
+        "host_prep_exclusive": (ss.get("host_prep") or {})
+        .get("exclusive_share"),
+        "device_scan_exclusive": (ss.get("device_scan") or {})
+        .get("exclusive_share"),
         "dropped_events": report["dropped_events"],
     }
 
@@ -301,4 +338,19 @@ def check_claims(report: Optional[dict]) -> List[str]:
                 "the overlap machinery cannot help until this thread's "
                 "work shrinks or moves" % (r["thread"],
                                            r["exclusive_share"] * 100))
+    # host-prep-above-the-device-lanes check (ISSUE 13): the measured
+    # stage shares contradicting the raw-byte offload design — host
+    # normalize/merge time exceeding the device scan's exclusive busy
+    # means the host, not the chips, bounds the pipeline
+    ss = report.get("stage_shares") or {}
+    hp, dv = ss.get("host_prep"), ss.get("device_scan")
+    if (hp and dv and hp["exclusive_share"] > 0.05
+            and hp["exclusive_share"] > dv["exclusive_share"]):
+        out.append(
+            "host_prep ranks ABOVE the device lanes (%.0f%% exclusive "
+            "busy vs device_scan's %.0f%%) — host prep bounds the "
+            "pipeline; the raw-byte device path (scan_impl pallas3, "
+            "docs/SCAN_KERNEL.md 'Device path') should be absorbing "
+            "this work" % (hp["exclusive_share"] * 100,
+                           dv["exclusive_share"] * 100))
     return out

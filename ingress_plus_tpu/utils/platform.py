@@ -48,6 +48,13 @@ def force_cpu_devices(n_devices: int = 8) -> None:
 #: must see fresh attempts; they cache their own final verdict.
 _PROBE_OK: Optional[str] = None
 
+#: detail of the LAST completed probe attempt (success or failure):
+#: {platform, device_count, probe_s, error} — the bench artifact header
+#: embeds this so a silently-CPU run is labeled loudly at the TOP of
+#: the json instead of discovered by reading `platform: cpu` at the
+#: bottom (ISSUE 13 satellite)
+LAST_PROBE: dict = {}
+
 
 def probe_backend_once(timeout: int = 60, use_cache: bool = True):
     """``jax.devices()`` in a THROWAWAY SUBPROCESS under a hard timeout.
@@ -67,23 +74,35 @@ def probe_backend_once(timeout: int = 60, use_cache: bool = True):
     """
     import subprocess
     import sys
+    import time
 
     global _PROBE_OK
     if use_cache and _PROBE_OK is not None:
         return _PROBE_OK, None
+    t0 = time.time()
     try:
         p = subprocess.run(
             [sys.executable, "-c",
-             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+             "import jax; d = jax.devices(); "
+             "print('PLATFORM=%s NDEV=%d' % (d[0].platform, len(d)))"],
             capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
-        return None, "backend init hung >%ds" % timeout
+        err = "backend init hung >%ds" % timeout
+        LAST_PROBE.update(platform=None, device_count=None,
+                          probe_s=round(time.time() - t0, 1), error=err)
+        return None, err
     out = [l for l in p.stdout.strip().splitlines()
            if l.startswith("PLATFORM=")]
     if p.returncode == 0 and out:
-        _PROBE_OK = out[-1].split("=", 1)[1]
+        fields = dict(f.split("=", 1) for f in out[-1].split())
+        _PROBE_OK = fields["PLATFORM"]
+        LAST_PROBE.update(platform=_PROBE_OK,
+                          device_count=int(fields.get("NDEV", 1)),
+                          probe_s=round(time.time() - t0, 1), error=None)
         return _PROBE_OK, None
     err = (p.stderr.strip().splitlines() or ["rc=%d" % p.returncode])[-1]
+    LAST_PROBE.update(platform=None, device_count=None,
+                      probe_s=round(time.time() - t0, 1), error=err[:300])
     return None, err[:300]
 
 

@@ -22,7 +22,8 @@ def _verdict_tuple(v):
     return (v.attack, v.blocked, tuple(sorted(v.rule_ids)), v.score)
 
 
-@pytest.mark.parametrize("impl", ["take", "pallas", "pallas2"])
+@pytest.mark.parametrize("impl", ["take", "pallas", "pallas2",
+                                  "pallas3"])
 def test_impl_verdict_parity_with_pair(ruleset, impl):
     """Every impl produces identical verdicts on a mixed corpus (pallas
     runs in interpret mode on the CPU test backend — same kernel code

@@ -512,3 +512,81 @@ def test_bench_trend_gate(tmp_path):
     rep = trend(load_artifacts(str(tmp_path)))
     assert rep["status"] == "OK"
     assert any("degraded-host" in w for w in rep["warnings"])
+
+
+# ------------------------------- stage shares (ISSUE 13 host_prep rank)
+
+def test_overlap_stage_shares_known_values():
+    """device busy [0,50], confirm [30,90] → stage-busy union 90ms:
+    device busy 50/90 with [0,30] exclusive, confirm [50,90]
+    exclusive; no host_prep span recorded → 0 shares."""
+    rep = overlap_report(_synthetic_snapshot(), confirm_workers=2,
+                         n_lanes=1)
+    ss = rep["stage_shares"]
+    assert ss["device_scan"]["busy_share"] == pytest.approx(50 / 90,
+                                                            abs=1e-3)
+    assert ss["device_scan"]["exclusive_share"] == pytest.approx(
+        30 / 90, abs=1e-3)
+    assert ss["confirm"]["exclusive_share"] == pytest.approx(40 / 90,
+                                                             abs=1e-3)
+    assert ss["host_prep"]["busy_share"] == 0.0
+    # healthy structure: host prep does NOT rank above the device
+    assert not any("host_prep" in w for w in check_claims(rep))
+
+
+def test_check_claims_flags_host_prep_above_device():
+    """A timeline where host prep out-ranks the device lanes in
+    exclusive busy must produce the ISSUE 13 claim-check warning — the
+    condition the raw-byte device path exists to remove."""
+    from ingress_plus_tpu.utils.trace import EV_PREP
+
+    threads = [
+        {"tid": 0, "root": "dispatch", "thread": "ipt-batcher",
+         "dropped": 0},
+        {"tid": 1, "root": "lane_worker", "thread": "ipt-device-0",
+         "dropped": 0},
+    ]
+    events = [
+        (0, _ms(0), EV_CYCLE, PH_B, 1, 0, 4),
+        (0, _ms(0), EV_PREP, PH_B, 1, 0, 4),
+        (0, _ms(60), EV_PREP, PH_E, 1, 0, 0),
+        (1, _ms(60), EV_DEVICE, PH_B, 1, 0, 4),
+        (1, _ms(70), EV_DEVICE, PH_E, 1, 0, 0),
+        (0, _ms(100), EV_CYCLE, PH_E, 1, 0, 0),
+    ]
+    snap = {"enabled": True, "ring_kb": 256, "threads": threads,
+            "events": sorted(events, key=lambda e: e[1]), "dropped": 0}
+    rep = overlap_report(snap, confirm_workers=1, n_lanes=1)
+    ss = rep["stage_shares"]
+    assert ss["host_prep"]["exclusive_share"] > \
+        ss["device_scan"]["exclusive_share"]
+    warns = check_claims(rep)
+    assert any("host_prep ranks ABOVE" in w for w in warns)
+
+
+# ------------------------- bench-trend backend guard (ISSUE 13 sat.)
+
+def test_bench_trend_refuses_cross_backend(tmp_path):
+    """A CPU→TPU flip (or the reverse fallback) must never read as a
+    10x win or a regression: the gate refuses the comparison, and the
+    best-ever note only compares same-backend points."""
+    from tools.bench_trend import load_artifacts, trend
+
+    def art(tag, value, platform):
+        (tmp_path / ("BENCH_%s.json" % tag)).write_text(json.dumps(
+            {"parsed": {"value": value, "platform": platform}}))
+
+    art("r01", 1000.0, "cpu")
+    art("r02", 8000.0, "tpu")      # flip up: NOT a 8x win
+    rep = trend(load_artifacts(str(tmp_path)))
+    assert rep["status"] == "SKIP"
+    assert any("not comparable" in w for w in rep["warnings"])
+    art("r03", 900.0, "cpu")       # flip back down: NOT a regression
+    assert trend(load_artifacts(str(tmp_path)))["status"] == "SKIP"
+    art("r04", 950.0, "cpu")       # same backend again: gating resumes
+    rep = trend(load_artifacts(str(tmp_path)))
+    assert rep["status"] == "OK"
+    # the tpu point is not this trajectory's best-ever
+    assert not any("r02" in w for w in rep.get("warnings", []))
+    art("r05", 100.0, "cpu")       # same-backend regression still gates
+    assert trend(load_artifacts(str(tmp_path)))["status"] == "FAIL"

@@ -427,3 +427,51 @@ def test_bare_protobuf_streaming_extracted(pipeline):
                            "User-Agent": "proto-client/1"})
     v = _stream_verdict(pipeline, req, msg, chunk=11)
     assert v.attack and "sqli" in v.classes, (v.classes, v.rule_ids)
+
+
+# --------------------------- fused host-prep path (ISSUE 13 satellite)
+
+def test_merged_rows_identical_to_two_pass(pipeline):
+    """merged_rows_for_requests (the serving hot path's one-pass
+    normalize+merge) is pinned byte- AND order-identical to the
+    two-pass merge_rows(rows_for_requests(...)) composition — the
+    bucket assembly iterates this order, so any drift would reorder
+    device rows."""
+    from ingress_plus_tpu.serve.normalize import (
+        merge_rows,
+        merged_rows_for_requests,
+        rows_for_requests,
+    )
+    from ingress_plus_tpu.utils.corpus import generate_corpus
+
+    reqs = [lr.request for lr in
+            generate_corpus(n=64, attack_fraction=0.3, seed=21)]
+    # adversarial encodings: double-encoding, overlong UTF-8, HTML
+    # entities, '+' folding, form bodies, identical cross-stream rows
+    reqs += [
+        Request(uri="/a?q=%2527%20union%20select%20pass&x=%C0%A7",
+                headers={"X-Note": "a&#x3c;script&gt;b"}),
+        Request(uri="/p?b=" + "%25" * 40,
+                body=b'{"k":"<script>alert(1)</script>"}',
+                headers={"content-type": "application/json"}),
+        Request(uri="/f", body=b"a=1+union%20select+2",
+                headers={"content-type":
+                         "application/x-www-form-urlencoded"}),
+        Request(uri="/dup?x=abc&y=abc"),
+        Request(uri="/nul?q=%00%00"),
+    ]
+    for needed in (pipeline.needed_sv, None):
+        old = merge_rows(rows_for_requests(reqs, needed_sv=needed))
+        new = merged_rows_for_requests(reqs, needed_sv=needed)
+        assert old[0] == new[0]
+        assert old[1] == new[1]
+        assert old[2] == new[2]
+
+
+def test_content_headers_single_pass():
+    from ingress_plus_tpu.serve.unpack import content_headers
+
+    ct, ce = content_headers({"Host": "x", "Content-TYPE": "Text/HTML",
+                              "CONTENT-ENCODING": "GZip"})
+    assert ct == "text/html" and ce == "gzip"
+    assert content_headers({}) == ("", "")

@@ -14,6 +14,12 @@ fails CI on a missing artifact.
 
 Artifacts are either the driver-wrapped shape ``{n, cmd, rc, tail,
 parsed}`` or a bare bench JSON line — both load.
+
+Backend guard (ISSUE 13): when the latest artifact's ``platform``
+differs from the previous one's (a CPU→TPU flip, or the reverse
+fallback), the comparison is REFUSED — status SKIP with an explicit
+warning — because req/s/chip across backends is not one trajectory.
+The best-ever trail note likewise only compares same-backend points.
 """
 
 from __future__ import annotations
@@ -63,7 +69,36 @@ def trend(artifacts: list) -> dict:
                           "compare (%d found)" % len(artifacts),
                 "points": artifacts}
     latest, prev = artifacts[-1], artifacts[-2]
-    best = max(artifacts, key=lambda a: a["value"])
+    # backend guard (ISSUE 13 satellite): req/s/chip measured on
+    # different backends is not one trajectory — a CPU→TPU flip must
+    # not read as a 10x "win", nor the reverse as a regression.  The
+    # gate REFUSES the comparison; re-baseline on the new backend
+    # (legacy artifacts with unknown platform "?" keep comparing).
+    if (latest["platform"] != prev["platform"]
+            and "?" not in (latest["platform"], prev["platform"])):
+        return {
+            "status": "SKIP",
+            "latest": latest["tag"],
+            "latest_value": latest["value"],
+            "prev_value": prev["value"],
+            "delta_vs_prev": None,
+            "best": None,
+            "warnings": [
+                "backend changed %s (%s) -> %s (%s): req/s/chip is "
+                "not comparable across backends — regression NOT "
+                "gated; the next same-backend artifact re-baselines "
+                "the trend" % (prev["platform"], prev["tag"],
+                               latest["platform"], latest["tag"])],
+            "detail": "backend changed %s -> %s — artifacts not "
+                      "comparable, gate skipped"
+                      % (prev["platform"], latest["platform"]),
+            "points": artifacts,
+        }
+    # best-ever trail note: only same-backend points are a trajectory
+    same_backend = [a for a in artifacts
+                    if a["platform"] == latest["platform"]
+                    or "?" in (a["platform"], latest["platform"])]
+    best = max(same_backend, key=lambda a: a["value"])
     drop_vs_prev = 1.0 - latest["value"] / prev["value"] \
         if prev["value"] > 0 else 0.0
     regressed = drop_vs_prev > REGRESSION_GATE

@@ -48,6 +48,13 @@ Four gates, one verdict:
              with zero new false negatives vs the fixed CRS weights,
              and flag strictly fewer benign requests at the calibrated
              threshold (reports/MODELGATE.json)
+  devicegate Pallas device-path parity (ISSUE 13, docs/SCAN_KERNEL.md
+             "Device path"): every Pallas kernel runs in Mosaic
+             INTERPRET mode — the same kernel program the TPU lowering
+             compiles — over a seeded corpus of ragged batches and
+             must produce match words BIT-IDENTICAL to the ops/scan.py
+             XLA reference; divergence fails the build before any TPU
+             time is spent (reports/DEVICEGATE.json)
   promlint   Prometheus exposition hygiene (analysis/promlint.py):
              /metrics scraped from an in-process server after real
              multi-tenant traffic — ipt_ prefix, _total on counters,
@@ -389,6 +396,141 @@ def run_modelgate(write_report: bool) -> dict:
     return result
 
 
+#: seeded SecLang fixture for the devicegate (compact on purpose: the
+#: gate's job is KERNEL parity, not CRS coverage — the bundled-pack
+#: geometry case below covers the multi-tile padding paths)
+_DEVICEGATE_RULES = """
+SecRule ARGS "@rx (?i)union\\s+select" "id:1,phase:2,block,severity:CRITICAL,tag:'attack-sqli'"
+SecRule ARGS "@rx (?i)<script[^>]*>" "id:2,phase:2,block,severity:CRITICAL,tag:'attack-xss'"
+SecRule ARGS "@rx /etc/(?:passwd|shadow)" "id:3,phase:2,block,severity:CRITICAL,tag:'attack-lfi'"
+SecRule ARGS "@pm sleep( benchmark( xp_cmdshell load_file(" "id:4,phase:2,block,severity:ERROR,tag:'attack-sqli'"
+SecRule ARGS "@rx (?:;|\\|)\\s*(?:cat|ls|id)\\b" "id:5,phase:2,block,severity:ERROR,tag:'attack-rce'"
+"""
+
+
+def _devicegate_batches(n_batches: int = 3, n_rows: int = 13):
+    """Deterministic ragged batches: random printable rows with planted
+    payloads at varying offsets, empty rows, and odd lengths."""
+    import numpy as np
+
+    from ingress_plus_tpu.ops.scan import pad_rows
+
+    attacks = [b"1 union  select password from users",
+               b"<script>alert(1)</script>", b"../../etc/passwd",
+               b"; cat /etc/hosts", b"sleep(5) or benchmark(9,1)"]
+    batches = []
+    for seed in range(n_batches):
+        rng = np.random.default_rng(seed)
+        rows = []
+        for i in range(n_rows):
+            body = bytes(rng.integers(
+                32, 127, size=int(rng.integers(0, 300))))
+            if i % 3 == 0 and body:
+                a = attacks[(seed + i) % len(attacks)]
+                pos = int(rng.integers(0, max(1, len(body) - len(a))))
+                body = body[:pos] + a + body[pos + len(a):]
+            rows.append(body)
+        tokens, lengths = pad_rows(rows, round_to=64)
+        batches.append((seed, tokens, lengths))
+    return batches
+
+
+def run_devicegate(write_report: bool) -> dict:
+    """Pallas device-path parity gate (ISSUE 13): interpret-mode
+    kernels — the code path the JAX_PLATFORMS!=cpu lowering compiles —
+    vs the ops/scan.py XLA reference, bit-identical match words over
+    seeded ragged batches, on both the compact fixture pack and the
+    bundled pack's real multi-tile geometry.  Writes
+    reports/DEVICEGATE.json; any divergence fails the build."""
+    t0 = time.time()
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+    import numpy as np
+
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+    from ingress_plus_tpu.ops.pallas_scan import (
+        PallasByteScanner,
+        PallasPairScanner,
+        PallasScanner,
+    )
+    from ingress_plus_tpu.ops.scan import ScanTables, scan_bytes
+
+    tables = ScanTables.from_bitap(
+        compile_ruleset(parse_seclang(_DEVICEGATE_RULES)).tables)
+    kernels = {
+        "pallas": PallasScanner(tables, TB=8, CL=64),
+        "pallas2": PallasPairScanner(tables, TB=8, CL=16, MR=8),
+        "pallas3": PallasByteScanner(tables, TB=8, CL=16, MR=8),
+    }
+    cases = []
+    for seed, tokens, lengths in _devicegate_batches():
+        want_m, want_s = scan_bytes(tables, tokens, lengths)
+        want_m = np.asarray(want_m)
+        for name, sc in kernels.items():
+            got_m, got_s = sc(tokens, lengths, interpret=True)
+            case = {
+                "pack": "fixture", "kernel": name, "seed": seed,
+                "B": int(tokens.shape[0]), "L": int(tokens.shape[1]),
+                "match_equal": bool(
+                    np.array_equal(np.asarray(got_m), want_m)),
+            }
+            if name == "pallas":
+                # the byte kernel preserves the full scan_bytes state
+                # contract; the pair kernels' dead-padding state is a
+                # documented difference (only match is consumed)
+                case["state_equal"] = bool(np.array_equal(
+                    np.asarray(got_s), np.asarray(want_s)))
+            cases.append(case)
+    # bundled-pack geometry: the real serving width (multi-tile Wp,
+    # K1p padding) through the raw-byte kernel — the shapes a first
+    # TPU run would compile
+    cr = compile_ruleset(load_bundled_rules())
+    bt = ScanTables.from_bitap(cr.tables)
+    rng = np.random.default_rng(7)
+    toks = rng.integers(32, 127, (8, 128)).astype(np.uint8)
+    atk = b"1' union select password from users -- "
+    toks[0, :len(atk)] = np.frombuffer(atk, np.uint8)
+    lens = np.asarray([128, 37, 0, 128, 5, 64, 127, 128], np.int32)
+    want_m = np.asarray(scan_bytes(bt, toks, lens)[0])
+    got_m, _ = PallasByteScanner(bt)(toks, lens, interpret=True)
+    cases.append({
+        "pack": "bundled (%d rules, %d words)" % (cr.n_rules,
+                                                  bt.n_words),
+        "kernel": "pallas3", "seed": 7, "B": 8, "L": 128,
+        "match_equal": bool(np.array_equal(np.asarray(got_m), want_m)),
+        "non_vacuous": bool(want_m[0].any()),
+    })
+    bad = [c for c in cases
+           if not c["match_equal"] or c.get("state_equal") is False]
+    report = {
+        "passed": not bad,
+        "cases": cases,
+        "divergent": bad,
+        "note": "interpret mode executes the same Mosaic kernel "
+                "program the TPU lowering compiles — this gate is the "
+                "CI-run exercise of the JAX_PLATFORMS!=cpu code path",
+    }
+    result = {
+        "status": "OK" if not bad else "FAIL",
+        "seconds": round(time.time() - t0, 2),
+        "cases": len(cases),
+        "detail": "; ".join(
+            "%s/%s seed %s DIVERGED" % (c["pack"], c["kernel"],
+                                        c["seed"]) for c in bad) or
+            "%d interpret-vs-reference cases bit-identical (incl. "
+            "bundled-pack geometry)" % len(cases),
+    }
+    if write_report:
+        out = REPO / "reports" / "DEVICEGATE.json"
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(report, indent=2) + "\n")
+        result["report"] = str(out.relative_to(REPO))
+    return result
+
+
 def run_promlint() -> dict:
     """Prometheus exposition hygiene gate (ISSUE 12 satellite,
     analysis/promlint.py): scrape /metrics from an IN-PROCESS serve
@@ -476,7 +618,8 @@ def main(argv=None) -> int:
     ap.add_argument("--only",
                     choices=["ruff", "mypy", "rulecheck", "concheck",
                              "deadrules", "faultmatrix", "swapdrill",
-                             "modelgate", "promlint", "benchtrend"],
+                             "modelgate", "devicegate", "promlint",
+                             "benchtrend"],
                     default=None)
     args = ap.parse_args(argv)
 
@@ -497,6 +640,8 @@ def main(argv=None) -> int:
         gates["swapdrill"] = run_swapdrill(write_report=args.ci)
     if args.only in (None, "modelgate"):
         gates["modelgate"] = run_modelgate(write_report=args.ci)
+    if args.only in (None, "devicegate"):
+        gates["devicegate"] = run_devicegate(write_report=args.ci)
     if args.only in (None, "promlint"):
         gates["promlint"] = run_promlint()
     if args.only in (None, "benchtrend"):
