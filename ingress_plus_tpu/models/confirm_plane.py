@@ -123,6 +123,130 @@ class ConfirmMemo:
             self.suppressed += 1  # concheck: ok telemetry-grade counter race
 
 
+class VerdictCache:
+    """Bounded CROSS-cycle confirm cache keyed ``(generation,
+    rule_index, digest)`` — the promotion of :class:`ConfirmMemo` from
+    per-batch to per-process (ISSUE 15, docs/RETUNE.md).
+
+    Soundness is the memo's second-occurrence argument with the
+    generation folded into the key: within one generation the confirm
+    closures, ctl resolution, and rule-row order are immutable (a swap
+    installs a NEW generation tag), so the outcome for (generation,
+    rule, streams-digest) is a pure function and may be replayed across
+    batches.  Per-request ctl target exclusions still bypass the cache
+    entirely (confirm_one's ``extra_excl`` gate — unchanged).  Swap /
+    rollout boundaries call :meth:`invalidate`; that is HYGIENE (the
+    old generation's entries are unreachable dead weight), never a
+    soundness requirement.
+
+    Unlike the memo, capacity EVICTS oldest-first instead of refusing
+    inserts: a long-running cache must follow the traffic mix as it
+    drifts.  All dict/counter races are GIL-atomic / telemetry-grade,
+    same discipline as ConfirmMemo; ``invalidate`` REBINDS fresh dicts
+    (atomic swap) so racing readers see either generation's view,
+    both sound."""
+
+    __slots__ = ("cap", "hits", "misses", "suppressed", "evicted",
+                 "invalidations", "_d", "_seen")
+
+    def __init__(self, cap: int = 65536) -> None:
+        self.cap = max(1, int(cap))
+        self.hits = 0
+        self.misses = 0
+        self.suppressed = 0
+        self.evicted = 0
+        self.invalidations = 0
+        self._d: Dict[tuple, tuple] = {}
+        # (generation, digest) → True, insertion-ordered: the cross-
+        # cycle second-occurrence gate (a flood recurring every batch
+        # digests once per request but walks confirm only once total)
+        self._seen: Dict[tuple, bool] = {}
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def see(self, key: tuple) -> bool:
+        if key in self._seen:
+            return True
+        if len(self._seen) >= self.cap:
+            try:
+                # concheck: ok oldest-first eviction; a racing del costs one retried insert
+                del self._seen[next(iter(self._seen))]
+            except (KeyError, StopIteration, RuntimeError):
+                pass
+        self._seen[key] = True  # concheck: ok GIL-atomic dict store
+        return False
+
+    def get(self, key: tuple) -> Optional[tuple]:
+        v = self._d.get(key)
+        if v is not None:
+            self.hits += 1  # concheck: ok telemetry-grade counter race
+        return v
+
+    def put(self, key: tuple, value: tuple) -> None:
+        if len(self._d) >= self.cap:
+            try:
+                # concheck: ok oldest-first eviction under the GIL
+                del self._d[next(iter(self._d))]
+                self.evicted += 1
+            except (KeyError, StopIteration, RuntimeError):
+                self.suppressed += 1
+                return
+        self.misses += 1  # concheck: ok telemetry-grade counter race
+        # concheck: ok GIL-atomic dict store; racers store the identical value for the key
+        self._d[key] = value
+
+    def invalidate(self, reason: str = "") -> None:
+        """Drop every entry (swap / promote / rollback hygiene).  The
+        rebind is one GIL-atomic store per dict, so in-flight views
+        keep reading a consistent (old or new) snapshot."""
+        self._d = {}
+        self._seen = {}
+        self.invalidations += 1
+
+    def view(self, generation: str) -> "_CycleView":
+        """Per-finalize-batch adapter speaking ConfirmMemo's interface
+        with this pipeline generation folded into every key — the
+        confirm walk (confirm_one) and the stats fold (finalize_join's
+        per-job hit/miss deltas) run unchanged."""
+        return _CycleView(self, generation)
+
+    def snapshot(self) -> dict:
+        return {"entries": len(self._d), "cap": self.cap,
+                "hits": self.hits, "misses": self.misses,
+                "suppressed": self.suppressed, "evicted": self.evicted,
+                "invalidations": self.invalidations}
+
+
+class _CycleView(ConfirmMemo):
+    """One batch's handle on the shared VerdictCache: delegates storage
+    to the cache (generation-prefixed keys) while keeping its OWN
+    hit/miss counters, which finalize_join folds as per-batch deltas —
+    exactly what it did with a per-cycle ConfirmMemo."""
+
+    __slots__ = ("cache", "gen")
+
+    def __init__(self, cache: VerdictCache, generation: str) -> None:
+        super().__init__(cap=cache.cap)
+        self.cache = cache
+        self.gen = generation
+
+    def see(self, digest: bytes) -> bool:
+        return self.cache.see((self.gen, digest))
+
+    def get(self, key: tuple) -> Optional[tuple]:
+        r, digest = key
+        v = self.cache.get((self.gen, r, digest))
+        if v is not None:
+            self.hits += 1  # concheck: ok telemetry-grade counter race
+        return v
+
+    def put(self, key: tuple, value: tuple) -> None:
+        r, digest = key
+        self.misses += 1  # concheck: ok telemetry-grade counter race
+        self.cache.put((self.gen, r, digest), value)
+
+
 def streams_digest(streams: Dict[str, bytes]) -> bytes:
     """Content digest of one request's confirm streams (sorted keys,
     length-framed values — no concatenation ambiguity)."""
@@ -343,9 +467,16 @@ def launch_confirm(pl, requests, rule_hits: np.ndarray) -> ConfirmJob:
     flight confirm with the next cycle's scan dispatch, the same
     software-pipelining move PR 7 made for host→device transfer."""
     job = ConfirmJob(requests, rule_hits)
-    cap = getattr(pl, "confirm_memo_entries", 0)
-    if cap and len(requests) > 1:
-        job.memo = ConfirmMemo(cap)
+    cache = getattr(pl, "confirm_cache", None)
+    if cache is not None and len(requests):
+        # cross-cycle verdict cache: engages even for 1-request batches
+        # (the reuse is across cycles) and takes precedence over the
+        # per-cycle memo — it subsumes it
+        job.memo = cache.view(pl.generation_tag)
+    else:
+        cap = getattr(pl, "confirm_memo_entries", 0)
+        if cap and len(requests) > 1:
+            job.memo = ConfirmMemo(cap)
     memo = job.memo
     pool = pl.confirm_pool
     t0 = time.perf_counter()

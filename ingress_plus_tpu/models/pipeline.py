@@ -33,6 +33,7 @@ from ingress_plus_tpu.models.acl import AclStore
 from ingress_plus_tpu.models.confirm import ConfirmRule, parse_exclusion_token
 from ingress_plus_tpu.models.confirm_plane import (
     ConfirmPool,
+    VerdictCache,
     launch_confirm,
     join_confirm,
 )
@@ -360,6 +361,7 @@ class DetectionPipeline:
         confirm_workers: int = 1,
         confirm_hang_budget_s: float = 30.0,
         confirm_memo_entries: int = 4096,
+        confirm_cache_entries: int = 0,
     ):
         # ``engine``: pre-built engine to serve with (e.g. the batcher
         # hot-swap passing a mesh-backed MeshEngine.rebuilt) — skips
@@ -401,6 +403,14 @@ class DetectionPipeline:
                                         hang_budget_s=confirm_hang_budget_s)
         #: per-cycle flood-memo capacity; 0 disables memoization
         self.confirm_memo_entries = int(confirm_memo_entries)
+        # cross-cycle verdict cache (ISSUE 15, docs/RETUNE.md): opt-in
+        # (0 = off, the default — per-cycle memo behavior unchanged).
+        # Generation-keyed, so a hot swap never needs to invalidate for
+        # soundness; swap/rollback still clear it for hygiene.  The
+        # batcher carries ONE cache across hot swaps like the stats
+        # object and the confirm pool.
+        self.confirm_cache = (VerdictCache(int(confirm_cache_entries))
+                              if confirm_cache_entries else None)
         # brownout ladder (docs/ROBUSTNESS.md): the serve batcher feeds
         # queue-delay observations and detect() consults the level; a
         # hot-swap carries the controller over with the stats object so
@@ -510,6 +520,11 @@ class DetectionPipeline:
         frozen = self.rule_stats.freeze()
         self._install(ruleset, paranoia_level)
         self.frozen_rule_stats = frozen
+        # cross-cycle verdict cache: generation-keyed entries from the
+        # old pack can never serve the new one (soundness is in the
+        # key), but they are dead weight — drop them at the boundary
+        if self.confirm_cache is not None:
+            self.confirm_cache.invalidate("swap_ruleset")
 
     def set_scoring_head(self, head) -> None:
         """Install (or with ``None`` clear) a learned scoring head on
@@ -972,6 +987,10 @@ class DetectionPipeline:
         data_list, req_list, sv_list = merged_rows_for_requests(
             requests, variants_for=self._variants_for)
         Q = len(requests)
+        # MeasuredProfile byte axis (docs/RETUNE.md): fold the scanned
+        # bytes into the sampled histogram — budgeted, so this is a
+        # no-op once a few MiB of traffic shape have been observed
+        self.rule_stats.observe_bytes(data_list)
         stats = self.stats
         # stage attribution: everything up to here is host prep (the
         # per-bucket pad/pack below is interleaved with async dispatch

@@ -171,6 +171,13 @@ class RuleStats:
         # opt-in raw-bitmap capture (learned-scorer feature source);
         # None = off, the serve-plane default
         self.capture: Optional[BitmapRing] = None
+        # sampled scanned-byte histogram — the byte-frequency axis of
+        # the MeasuredProfile export (compiler/profile.py).  Budgeted:
+        # once ``byte_sample_budget`` bytes have been folded the fold
+        # is a no-op, so the steady-state dispatch cost is zero
+        self.byte_hist = np.zeros((256,), dtype=np.int64)
+        self.byte_sampled = 0
+        self.byte_sample_budget = 4 << 20
         self._lock = named_lock("RuleStats._lock")
 
     # ---------------------------------------------------------- update
@@ -211,8 +218,33 @@ class RuleStats:
                 for r in c.walk_chain():
                     r.qr_skips = 0
                     r.qr_evals = 0
+            self.byte_hist[:] = 0
+            self.byte_sampled = 0
             if self.capture is not None:
                 self.capture.clear()
+
+    def observe_bytes(self, rows: Sequence[bytes]) -> None:
+        """Fold scanned request bytes into the sampled histogram (one
+        vectorized bincount per row, dispatch-thread side).  Stops dead
+        once the per-generation budget is spent — profile quality needs
+        a few MiB of traffic shape, not an unbounded tax."""
+        if self.byte_sampled >= self.byte_sample_budget:
+            return
+        h = np.zeros((256,), dtype=np.int64)
+        n = 0
+        for r in rows:
+            if len(r) == 0:
+                continue
+            h += np.bincount(np.frombuffer(r, dtype=np.uint8),
+                             minlength=256)
+            n += len(r)
+            if self.byte_sampled + n >= self.byte_sample_budget:
+                break
+        if n == 0:
+            return
+        with self._lock:
+            self.byte_hist += h
+            self.byte_sampled += n
 
     def observe_finalize(self, rule_hits: np.ndarray,
                          confirmed_idx: Sequence[int],

@@ -954,6 +954,12 @@ class Batcher:
         # must not orphan N worker threads per reload
         new.confirm_pool = old.confirm_pool
         new.confirm_memo_entries = old.confirm_memo_entries
+        # the cross-cycle verdict cache spans swaps like the pool (its
+        # keys carry the generation, so old entries can never serve the
+        # new pack); dropped entries are hygiene, not soundness
+        if getattr(old, "confirm_cache", None) is not None:
+            old.confirm_cache.invalidate("hot_swap")
+            new.confirm_cache = old.confirm_cache
         # break-glass force swap during a staged rollout: the candidate
         # generation is aborted (quarantined, reason exported) BEFORE the
         # new pack installs — after the fault site and the build, so a

@@ -825,6 +825,11 @@ class ServeLoop:
                         **pipeline.confirm_pool.snapshot(),
                         "hangs": pipeline.stats.confirm_hangs,
                         "memo_entries": pipeline.confirm_memo_entries,
+                        # cross-cycle verdict cache (docs/RETUNE.md)
+                        "verdict_cache": (
+                            pipeline.confirm_cache.snapshot()
+                            if getattr(pipeline, "confirm_cache", None)
+                            is not None else None),
                     },
                     # tenant isolation (docs/ROBUSTNESS.md): guard
                     # policy + who is quarantined right now; the full
@@ -1036,11 +1041,24 @@ class ServeLoop:
             except ValueError:
                 n = 0
             rs = pipeline.rule_stats
+            if (q.get("format") or [""])[0] == "profile":
+                # MeasuredProfile export (docs/RETUNE.md): the content-
+                # hashed telemetry artifact tools/retune.py feeds back
+                # into the compiler — canonical bytes, so the hash an
+                # operator records here matches the pack provenance
+                from ingress_plus_tpu.compiler.profile import (
+                    MeasuredProfile)
+                prof = MeasuredProfile.from_rule_stats(rs)
+                return ("200 OK", "application/json",
+                        prof.to_json().encode())
+            cache = getattr(pipeline, "confirm_cache", None)
             body = {
                 "version": rs.version,
                 "requests": rs.requests,
                 "device": pipeline.engine.device_info(),
                 "efficiency": device_efficiency(pipeline.stats),
+                "verdict_cache": (cache.snapshot()
+                                  if cache is not None else None),
                 "rules": rs.rules_json(limit=max(n, 0)),
             }
             return ("200 OK", "application/json",
@@ -1402,6 +1420,7 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
                           n_lanes: int = 1,
                           scoring_head_path: Optional[str] = None,
                           confirm_workers: int = 1,
+                          confirm_cache_entries: int = 0,
                           tenant_queue_cap: int = 0,
                           tenant_weights: Optional[str] = None,
                           tenant_guard: str = "prefilter_only") -> Batcher:
@@ -1462,8 +1481,9 @@ def build_default_batcher(mode: str = "block", rules_dir: Optional[str] = None,
         confirm_workers = max(1, min(8, _os.cpu_count() or 1))
         print("confirm plane: auto -> %d confirm workers"
               % confirm_workers, file=sys.stderr)
-    pipeline = DetectionPipeline(cr, mode=mode, engine=engine,
-                                 confirm_workers=confirm_workers)
+    pipeline = DetectionPipeline(
+        cr, mode=mode, engine=engine, confirm_workers=confirm_workers,
+        confirm_cache_entries=confirm_cache_entries)
     if mesh_spec:
         if scan_impl in ("pallas", "pallas3"):
             # neither the byte kernel nor the raw-byte fused kernel has
@@ -1644,6 +1664,14 @@ def main(argv=None) -> None:
                          "inline.  A wedged worker fails only its "
                          "request share open; with the mesh loop, "
                          "confirm overlaps the next cycle's scan")
+    ap.add_argument("--confirm-cache", type=int, default=0,
+                    help="cross-cycle verdict cache entries "
+                         "(docs/RETUNE.md): bounded confirm-outcome "
+                         "cache keyed (generation, rule, stream "
+                         "digest) that survives across batches — "
+                         "repeated identical traffic stops paying "
+                         "confirm entirely.  0 (default) keeps the "
+                         "per-cycle flood memo only")
     ap.add_argument("--scan-impl", default="auto",
                     choices=["auto", "pair", "take", "pallas", "pallas2",
                              "pallas3"],
@@ -1802,6 +1830,7 @@ def main(argv=None) -> None:
         n_lanes=_parse_lanes(args.lanes),
         scoring_head_path=args.scoring_head,
         confirm_workers=_parse_confirm_workers(args.confirm_workers),
+        confirm_cache_entries=max(0, args.confirm_cache),
         tenant_queue_cap=args.tenant_queue_cap,
         tenant_weights=args.tenant_weights,
         tenant_guard=args.tenant_guard)

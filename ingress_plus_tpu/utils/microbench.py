@@ -390,6 +390,86 @@ def bench_confirm(n_req: int = 1024, iters: int = 5,
     return out
 
 
+def bench_retune(n_req: int = 1024, iters: int = 5, flood_dup: int = 4,
+                 cache_entries: int = 65536) -> dict:
+    """Profile-guided retuning A/B (ISSUE 15, docs/RETUNE.md): static
+    vs profile-priced pack, crossed with the cross-cycle verdict cache
+    off/on, over the same mixed + flood corpora as ``bench_confirm``.
+    The profile is bootstrapped from a telemetry replay of the mixed
+    corpus through the static pack — the exact loop tools/retune.py
+    closes — so the delta is the measured value of closing it.  Each
+    arm gets its own pipeline (the pack IS the variable; attribute
+    toggling can't swap tables), warmed before timing."""
+    import random
+
+    from ingress_plus_tpu.compiler.profile import MeasuredProfile
+    from ingress_plus_tpu.compiler.reduce import ReductionConfig
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.utils.corpus import generate_corpus
+
+    rules = load_bundled_rules()
+    static_cr = compile_ruleset(rules)
+    corpus = generate_corpus(n=n_req, attack_fraction=0.2, seed=42)
+    reqs = [lr.request for lr in corpus]
+    flood = [lr.request for lr in corpus[:max(1, n_req // flood_dup)]
+             ] * flood_dup
+    random.Random(7).shuffle(flood)
+
+    # telemetry replay → profile → retuned pack (the closed loop)
+    prof_pipe = DetectionPipeline(static_cr, mode="block")
+    for i in range(0, len(reqs), 64):
+        prof_pipe.detect(reqs[i:i + 64])
+    prof = MeasuredProfile.from_rule_stats(prof_pipe.rule_stats)
+    retuned_cr = compile_ruleset(
+        rules, reduction=ReductionConfig(profile=prof))
+
+    out: dict = {"n_req": n_req, "iters": iters, "flood_dup": flood_dup,
+                 "profile_hash": prof.content_hash(),
+                 "static_fingerprint": static_cr.version,
+                 "retuned_fingerprint": retuned_cr.version,
+                 "reduction": retuned_cr.reduction}
+    base: dict = {}
+    for pack_tag, cr in (("static", static_cr), ("retuned", retuned_cr)):
+        for cache_tag, cache in (("nocache", 0),
+                                 ("cache", cache_entries)):
+            pipe = DetectionPipeline(cr, mode="block",
+                                     confirm_cache_entries=cache)
+            pipe.detect(reqs[:256])
+            pipe.detect(reqs)
+            pipe.detect(flood)
+            if pipe.confirm_cache is not None:
+                # warmup hits would flatter the timed runs unevenly
+                pipe.confirm_cache.invalidate("bench_warm")
+            for corpus_tag, batch in (("mixed", reqs), ("flood", flood)):
+                best, conf_us, hits = float("inf"), 0, 0
+                for _ in range(iters):
+                    c0 = pipe.stats.confirm_us
+                    m0 = pipe.stats.confirm_memo_hits
+                    t0 = time.perf_counter()
+                    pipe.detect(batch)
+                    dt = time.perf_counter() - t0
+                    if dt < best:
+                        best = dt
+                        conf_us = pipe.stats.confirm_us - c0
+                        hits = pipe.stats.confirm_memo_hits - m0
+                key = "%s/%s/%s" % (corpus_tag, pack_tag, cache_tag)
+                rps = len(batch) / best
+                if pack_tag == "static" and cache_tag == "nocache":
+                    base[corpus_tag] = rps
+                rec = {"req_per_s": round(rps, 1),
+                       "confirm_ms": round(conf_us / 1e3, 1),
+                       "cache_hits": hits,
+                       "speedup_vs_static": round(rps / base[corpus_tag],
+                                                  3)}
+                out[key] = rec
+                print("corpus=%-5s pack=%-7s cache=%-7s %8.1f req/s  "
+                      "confirm=%7.1f ms  hits=%-6d speedup=%.3fx"
+                      % (corpus_tag, pack_tag, cache_tag, rps,
+                         rec["confirm_ms"], hits,
+                         rec["speedup_vs_static"]))
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=256)
@@ -415,11 +495,15 @@ def main() -> None:
                          "lowering at the dominant bucket tiers, plus "
                          "a Mosaic-interpreter parity run; compiled "
                          "kernel on TPU, reference lowering on CPU")
+    ap.add_argument("--retune", action="store_true",
+                    help="profile-guided retuning A/B (docs/RETUNE.md): "
+                         "static vs profile-priced pack x verdict cache "
+                         "off/on over mixed + flood corpora; always CPU")
     ap.add_argument("--reqs", type=int, default=1024,
-                    help="corpus size for --confirm")
+                    help="corpus size for --confirm / --retune")
     args = ap.parse_args()
 
-    if args.platform == "cpu" or args.confirm:
+    if args.platform == "cpu" or args.confirm or args.retune:
         from ingress_plus_tpu.utils.platform import force_cpu_devices
 
         force_cpu_devices(1)
@@ -428,6 +512,14 @@ def main() -> None:
         # --iters defaults are tuned for the K-chained scan; a confirm
         # pass is a full corpus detect, so clamp to a sane wall budget
         bench_confirm(n_req=args.reqs, iters=max(2, min(args.iters, 5)))
+        return
+
+    if args.retune:
+        import json
+
+        out = bench_retune(n_req=args.reqs,
+                           iters=max(2, min(args.iters, 5)))
+        print(json.dumps(out, indent=2))
         return
 
     if args.scan:

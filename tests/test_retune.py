@@ -1,0 +1,221 @@
+"""Profile-guided pack retuning (ISSUE 15, docs/RETUNE.md).
+
+Covers the telemetry→compiler loop: MeasuredProfile roundtrip/versioning
+/hashing, the profile-priced reduction's determinism (same profile BYTES
+→ same pack fingerprint) and soundness (zero lost candidates vs the
+exact compile, verdict parity vs the static-model pack), hot-rule
+window pinning and quick-reject relaxation provenance, the
+/rules/stats?format=profile export surface, and the tools/retune.py
+library gates on a small pack.
+"""
+
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ingress_plus_tpu.compiler.profile import (
+    PROFILE_VERSION,
+    MeasuredProfile,
+)
+from ingress_plus_tpu.compiler.reduce import (
+    ReductionConfig,
+    byte_model,
+    measure_inflation,
+)
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.normalize import Request
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+RULES = r"""
+SecRule ARGS|REQUEST_BODY "@rx (?i)union\s+select" \
+    "id:942100,phase:2,block,t:urlDecodeUni,t:lowercase,severity:CRITICAL,tag:'attack-sqli'"
+SecRule ARGS|REQUEST_BODY "@rx (?i)<script[^>]*>" \
+    "id:941100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-xss'"
+SecRule REQUEST_URI|ARGS "@rx /etc/(?:passwd|shadow)" \
+    "id:930120,phase:2,block,severity:CRITICAL,tag:'attack-lfi'"
+SecRule ARGS "@rx (?i)(?:sleep|benchmark)\(\d+" \
+    "id:942150,phase:2,block,severity:ERROR,tag:'attack-sqli'"
+SecRule REQUEST_URI "@rx \.(?:bak|old|orig)$" \
+    "id:930130,phase:1,block,severity:ERROR,tag:'attack-disclosure'"
+"""
+
+ATTACKS = ["/q?a=1+union+select+2", "/p?x=<script>alert(1)</script>",
+           "/f?name=../../etc/passwd", "/s?id=sleep(5)--"]
+
+
+@pytest.fixture(scope="module")
+def cr():
+    return compile_ruleset(parse_seclang(RULES))
+
+
+def _traffic(n=64):
+    out = []
+    for i in range(n):
+        uri = ATTACKS[i % len(ATTACKS)] if i % 4 == 0 \
+            else "/benign/page?q=hello+world+%d" % i
+        out.append(Request(uri=uri, request_id="t%d" % i,
+                           headers={"host": "a.example",
+                                    "user-agent": "ua/1.0"}))
+    return out
+
+
+def _profiled_pipe(cr, n=64):
+    pipe = DetectionPipeline(cr, mode="block")
+    pipe.detect(_traffic(n))
+    return pipe
+
+
+# ------------------------------------------------ profile artifact
+
+def test_profile_roundtrip_hash_and_save(cr, tmp_path):
+    prof = MeasuredProfile.from_rule_stats(_profiled_pipe(cr).rule_stats)
+    assert prof.requests == 64
+    assert 942100 in prof.rules          # the hot rule made it in
+    assert prof.rules[942100]["candidate_rate"] > 0
+    # canonical-bytes roundtrip: same content, same hash
+    clone = MeasuredProfile.from_json(prof.to_json())
+    assert clone.to_json() == prof.to_json()
+    assert clone.content_hash() == prof.content_hash()
+    p = tmp_path / "prof.json"
+    prof.save(p)
+    assert MeasuredProfile.load(p).content_hash() == prof.content_hash()
+
+
+def test_profile_version_gate():
+    d = {"version": PROFILE_VERSION + 1, "source": "future",
+         "requests": 1, "rules": {}, "byte_freq": []}
+    with pytest.raises(ValueError):
+        MeasuredProfile.from_dict(d)
+
+
+def test_profile_byte_mu_blend(cr):
+    prof = MeasuredProfile.from_rule_stats(_profiled_pipe(cr).rule_stats)
+    mu = prof.byte_mu()
+    assert mu is not None and mu.shape == (256,)
+    assert abs(float(mu.sum()) - 1.0) < 1e-6
+    # observed traffic shifts the distribution off the static prior
+    assert not np.allclose(mu, byte_model())
+    # no byte axis → no mu (caller falls back to the static model)
+    empty = MeasuredProfile(source="x", requests=0, rules={},
+                            byte_freq=[])
+    assert empty.byte_mu() is None
+
+
+def test_rule_weights_hot_and_expensive(cr):
+    prof = MeasuredProfile.from_rule_stats(_profiled_pipe(cr).rule_stats)
+    ids = [int(r) for r in cr.rule_ids]
+    w = prof.rule_weights(ids)
+    assert w.shape == (len(ids),)
+    assert float(w.min()) >= 0.25 and float(w.max()) <= 8.0
+    hot = prof.hot_rule_ids(0.5)
+    assert hot and hot <= set(prof.rules)
+    # deterministic tie-break: two calls, same order
+    assert prof.top_expensive_confirms(4) == prof.top_expensive_confirms(4)
+
+
+# --------------------------------------- profile-priced compilation
+
+def test_profile_priced_compile_deterministic_and_sound(cr):
+    prof = MeasuredProfile.from_rule_stats(_profiled_pipe(cr).rule_stats)
+    rules = parse_seclang(RULES)
+    cfg_a = ReductionConfig(profile=prof)
+    cfg_b = ReductionConfig(
+        profile=MeasuredProfile.from_json(prof.to_json()))
+    cr_a = compile_ruleset(rules, reduction=cfg_a)
+    cr_b = compile_ruleset(rules, reduction=cfg_b)
+    # same profile bytes → same pack fingerprint (retunegate's contract)
+    assert cr_a.version == cr_b.version
+    # provenance chain present
+    assert cr_a.reduction["profile_hash"] == prof.content_hash()
+    # soundness: the reduced tables never lose a candidate
+    exact = compile_ruleset(rules, reduction=ReductionConfig.off())
+    rows = [r.uri.encode() for r in _traffic(48)]
+    infl = measure_inflation(exact.tables, cr_a.tables, rows)
+    assert infl["lost_candidates"] == 0
+    # verdict parity vs the static-model pack over mixed traffic
+    reqs = _traffic(48)
+    vs = DetectionPipeline(cr, mode="block").detect(reqs)
+    vr = DetectionPipeline(cr_a, mode="block").detect(reqs)
+    for a, b in zip(vs, vr):
+        assert (a.attack, a.blocked, a.score, sorted(a.rule_ids)) == \
+            (b.attack, b.blocked, b.score, sorted(b.rule_ids)), \
+            a.request_id
+
+
+def test_qr_relax_provenance_and_literals(cr):
+    prof = MeasuredProfile.from_rule_stats(_profiled_pipe(cr).rule_stats)
+    cr_r = compile_ruleset(parse_seclang(RULES),
+                           reduction=ReductionConfig(profile=prof))
+    assert cr_r.reduction["qr_relaxed"] >= 0
+    relaxed = [int(cr_r.rule_ids[i]) for i, m in enumerate(cr_r.rules)
+               if m.confirm.get("qr_relax")]
+    # every relax-flagged rule is one the profile ranked expensive
+    expensive = set(prof.top_expensive_confirms(16))
+    for rid in relaxed:
+        assert rid in expensive
+    # qr_relax is fingerprint-covered: stripping it changes the pack
+    cr_plain = compile_ruleset(parse_seclang(RULES),
+                               reduction=ReductionConfig(
+                                   profile=prof, qr_relax_top=0))
+    if relaxed:
+        assert cr_plain.version != cr_r.version
+
+
+def test_hot_rules_keep_exact_windows(cr):
+    """Hot factors are pinned out of the approximate passes: with every
+    rule hot, the profile-priced tables equal the default reduction's
+    only where merging never fired — assert the report says so."""
+    prof = MeasuredProfile.from_rule_stats(_profiled_pipe(cr).rule_stats)
+    cr_r = compile_ruleset(parse_seclang(RULES),
+                           reduction=ReductionConfig(profile=prof,
+                                                     hot_frac=1.0))
+    assert cr_r.reduction["hot_factors"] > 0
+
+
+# --------------------------------------------------- export surface
+
+def test_rules_stats_profile_export(cr, tmp_path):
+    from ingress_plus_tpu.serve.batcher import Batcher
+    from ingress_plus_tpu.serve.server import ServeLoop
+
+    pipe = DetectionPipeline(cr, mode="block")
+    b = Batcher(pipe, max_delay_s=0.001)
+    serve = ServeLoop(b, str(tmp_path / "ipt.sock"))
+    try:
+        for r in _traffic(16):
+            b.submit(r).result(30)
+        _status, _ctype, body = asyncio.run(serve._route_http(
+            "GET", "/rules/stats?format=profile", b""))
+        prof = MeasuredProfile.from_json(body)
+        assert prof.requests == 16
+        # the export IS the canonical bytes — hash-stable provenance
+        assert body == prof.to_json().encode()
+    finally:
+        b.close()
+
+
+# -------------------------------------------------- retuner library
+
+def test_retune_library_gates(tmp_path):
+    import retune as rt
+
+    rules = parse_seclang(RULES)
+    prof = MeasuredProfile.from_rule_stats(
+        _profiled_pipe(compile_ruleset(rules)).rule_stats)
+    report = rt.retune(rules=rules, profile=prof, staged=False, ab=False)
+    assert report["ok"], report
+    assert report["replay"]["new_fns"] == 0
+    assert report["replay"]["new_blocks"] == 0
+    assert report["inflation"]["retuned"]["lost_candidates"] == 0
+    assert report["profile"]["hash"] == prof.content_hash()
+    cr_out = report.pop("_retuned_cr")
+    assert cr_out.version == report["retuned_fingerprint"]
+    # the report is json-serializable once the pack ref is stripped
+    json.dumps(report)

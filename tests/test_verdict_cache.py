@@ -1,0 +1,281 @@
+"""Cross-cycle verdict cache (models/confirm_plane.py VerdictCache,
+ISSUE 15, docs/RETUNE.md "Verdict cache").
+
+The cache promotes PR 9's per-cycle ConfirmMemo to a bounded
+cross-cycle store keyed (generation, rule, streams-digest).  Soundness
+is the memo's second-occurrence argument with the generation folded
+into the key, so the tests here are differential: cache-on must be
+byte-identical to cache-off in every verdict field, across detect
+cycles and across every generation boundary the serve plane has —
+hot swap, staged promote, rollback, tenant quarantine — plus the
+eviction/bound/invalidation mechanics as units.
+"""
+
+import random
+import time
+
+import pytest
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.control.rollout import (
+    _DRILL_CANDIDATE,
+    _DRILL_INCUMBENT,
+    LIVE,
+    REJECTED,
+    ROLLED_BACK,
+    RolloutConfig,
+    RolloutController,
+)
+from ingress_plus_tpu.models.confirm_plane import VerdictCache
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.normalize import Request
+from ingress_plus_tpu.utils.faults import _collect, _mk_batcher, _requests
+
+
+@pytest.fixture(scope="module")
+def packs():
+    return {"inc": compile_ruleset(parse_seclang(_DRILL_INCUMBENT)),
+            "cand": compile_ruleset(parse_seclang(_DRILL_CANDIDATE))}
+
+
+def _vt(v):
+    return (v.attack, v.blocked, v.score, tuple(sorted(v.rule_ids)),
+            v.fail_open, v.degraded)
+
+
+def _mixed(n, tag, seed=11):
+    reqs = []
+    for i in range(n):
+        uri = ("/q?a=1+union+select+%d" % (i % 3) if i % 3 == 0
+               else "/p?x=<script>%d" % i if i % 7 == 0
+               else "/ok?i=%d" % i)
+        reqs.append(Request(uri=uri, request_id="%s-%d" % (tag, i)))
+    random.Random(seed).shuffle(reqs)
+    return reqs
+
+
+# ------------------------------------------------------------ units
+
+def test_cache_eviction_oldest_first_and_bound():
+    c = VerdictCache(cap=8)
+    for i in range(50):
+        c.put(("g", i, b"d%d" % i), (False, ()))
+    assert len(c) == 8
+    assert c.evicted == 42
+    # oldest gone, newest retained
+    assert c.get(("g", 0, b"d0")) is None
+    assert c.get(("g", 49, b"d49")) is not None
+    # the seen-gate honors the same cap
+    for i in range(50):
+        c.see(("g", b"s%d" % i))
+    assert len(c._seen) <= 8
+
+
+def test_cache_invalidate_rebinds_and_counts():
+    c = VerdictCache(cap=16)
+    c.put(("g", 1, b"x"), (True, (1,)))
+    hits0 = c.hits
+    assert c.get(("g", 1, b"x")) is not None
+    c.invalidate("test")
+    assert len(c) == 0 and len(c._seen) == 0
+    assert c.invalidations == 1
+    assert c.get(("g", 1, b"x")) is None
+    # counters survive invalidation (telemetry is cumulative)
+    assert c.hits == hits0 + 1
+
+
+def test_cache_generation_keying():
+    """Same rule + digest under different generations never collide —
+    the entire soundness-across-swap story in one assert."""
+    c = VerdictCache(cap=16)
+    va = c.view("gen-a")
+    vb = c.view("gen-b")
+    va.put((3, b"digest"), (True, (942100,)))
+    assert va.get((3, b"digest")) == (True, (942100,))
+    assert vb.get((3, b"digest")) is None
+    assert vb.see(b"digest") is False    # seen-gate is per-generation too
+    assert va.see(b"digest") is False and va.see(b"digest") is True
+
+
+def test_cycle_view_delta_counters():
+    """finalize_join folds per-batch deltas off the view; the shared
+    cache keeps cumulative totals."""
+    c = VerdictCache(cap=16)
+    v1 = c.view("g")
+    v1.put((1, b"d"), (False, ()))
+    assert v1.get((1, b"d")) is not None
+    assert (v1.hits, v1.misses) == (1, 1)
+    v2 = c.view("g")
+    assert v2.get((1, b"d")) is not None   # cross-view (cross-cycle) hit
+    assert (v2.hits, v2.misses) == (1, 0)
+    assert c.hits == 2 and c.misses == 1
+
+
+# ---------------------------------------- pipeline-level differential
+
+def test_cross_cycle_hits_and_parity(packs):
+    """The cache's reason to exist: a flood recurring across detect
+    CYCLES confirms once total; verdicts stay byte-identical to the
+    cache-off pipeline, including matches."""
+    flood = [Request(uri="/f?q=1+union+select+pw", request_id="f%d" % i)
+             for i in range(16)]
+    ref = DetectionPipeline(packs["inc"], mode="block")
+    cached = DetectionPipeline(packs["inc"], mode="block",
+                               confirm_cache_entries=256)
+    for cycle in range(3):
+        want = [_vt(v) for v in ref.detect(flood)]
+        got = [_vt(v) for v in cached.detect(flood)]
+        assert got == want, "cycle %d" % cycle
+    assert any(w[0] for w in want)          # the flood really hits
+    snap = cached.confirm_cache.snapshot()
+    # cycles 2 and 3 are pure replays: cross-cycle hits happened
+    assert snap["hits"] > 0
+    assert snap["entries"] <= 256
+
+
+def test_swap_invalidation_and_parity(packs):
+    """pipeline.swap_ruleset is a generation boundary: the cache is
+    invalidated (hygiene) and verdicts keep matching the cache-off
+    twin under the NEW pack."""
+    reqs = _mixed(24, "sw")
+    ref = DetectionPipeline(packs["inc"], mode="block")
+    cached = DetectionPipeline(packs["inc"], mode="block",
+                               confirm_cache_entries=256)
+    assert [_vt(v) for v in cached.detect(reqs)] == \
+        [_vt(v) for v in ref.detect(reqs)]
+    cached.swap_ruleset(packs["cand"])
+    ref.swap_ruleset(packs["cand"])
+    assert cached.confirm_cache.invalidations >= 1
+    for cycle in range(2):
+        assert [_vt(v) for v in cached.detect(reqs)] == \
+            [_vt(v) for v in ref.detect(reqs)], "post-swap cycle %d" % cycle
+    assert cached.confirm_cache.snapshot()["hits"] > 0
+
+
+# ----------------------------------------- serve-plane differential
+
+def _pair_batchers(packs, entries=512):
+    """(cache-on, cache-off) batchers over the same incumbent pack."""
+    bc = _mk_batcher(cr=packs["inc"])
+    bc.pipeline.confirm_cache = VerdictCache(entries)
+    b0 = _mk_batcher(cr=packs["inc"])
+    return bc, b0
+
+
+def _submit_both(bc, b0, reqs, timeout_s=30):
+    fc = [bc.submit(r) for r in reqs]
+    f0 = [b0.submit(r) for r in reqs]
+    vc, viol_c = _collect(fc, timeout_s=timeout_s)
+    v0, viol_0 = _collect(f0, timeout_s=timeout_s)
+    assert not viol_c and not viol_0, (viol_c, viol_0)
+    want = {v.request_id: _vt(v) for v in v0}
+    for v in vc:
+        assert _vt(v) == want[v.request_id], v.request_id
+    return vc
+
+
+def test_hot_swap_boundary_differential(packs):
+    """Differential fuzz across Batcher.swap_ruleset: identical traffic
+    into a cache-on and a cache-off batcher, a hot swap mid-stream,
+    verdicts byte-identical throughout; the cache object survives the
+    swap (carried to the new pipeline) and was invalidated."""
+    bc, b0 = _pair_batchers(packs)
+    cache = bc.pipeline.confirm_cache
+    try:
+        _submit_both(bc, b0, _mixed(24, "pre") + _mixed(24, "pre", 12))
+        bc.swap_ruleset(packs["cand"])
+        b0.swap_ruleset(packs["cand"])
+        assert bc.pipeline.confirm_cache is cache   # carried
+        assert cache.invalidations >= 1
+        _submit_both(bc, b0, _mixed(24, "post"))
+        _submit_both(bc, b0, _mixed(24, "post", 13))  # replay → hits
+        assert cache.snapshot()["hits"] > 0
+    finally:
+        bc.close()
+        b0.close()
+
+
+def _fast_ro(b):
+    ro = RolloutController(b, RolloutConfig(
+        steps=(0.25, 1.0), step_min_requests=8, shadow_min_requests=4,
+        shadow_sample=1.0, corpus_n=32, diff_min_compared=4))
+    b.rollout = ro
+    return ro
+
+
+def test_staged_promote_boundary_differential(packs):
+    """The promote boundary: drive a staged rollout to LIVE on both
+    batchers with identical traffic — shadow, canary split, and the
+    promotion swap all happen with the cache live — verdicts stay
+    byte-identical to the cache-off twin, and the cache is carried
+    across promote."""
+    bc, b0 = _pair_batchers(packs)
+    cache = bc.pipeline.confirm_cache
+    roc, ro0 = _fast_ro(bc), _fast_ro(b0)
+    try:
+        roc.admit(ruleset=packs["cand"])
+        ro0.admit(ruleset=packs["cand"])
+        deadline = time.monotonic() + 60
+        wave = 0
+        while (roc.state not in (LIVE, REJECTED, ROLLED_BACK)
+               or ro0.state not in (LIVE, REJECTED, ROLLED_BACK)) \
+                and time.monotonic() < deadline:
+            _submit_both(bc, b0,
+                         _requests(24, attack_every=4, tag="pw%d" % wave))
+            wave += 1
+        assert roc.state == LIVE and ro0.state == LIVE
+        assert bc.pipeline.confirm_cache is cache   # carried by promote
+        assert cache.invalidations >= 1
+        _submit_both(bc, b0, _requests(24, attack_every=4, tag="post"))
+    finally:
+        bc.close()
+        b0.close()
+
+
+def test_rollback_boundary_differential(packs):
+    """The rollback boundary: an admitted candidate is rolled back
+    mid-shadow on both batchers; the incumbent (and its cache) keeps
+    serving byte-identical verdicts — rollback never touches the
+    incumbent's entries (they are still the live generation)."""
+    bc, b0 = _pair_batchers(packs)
+    roc, ro0 = _fast_ro(bc), _fast_ro(b0)
+    try:
+        _submit_both(bc, b0, _mixed(24, "rb-pre"))
+        roc.admit(ruleset=packs["cand"])
+        ro0.admit(ruleset=packs["cand"])
+        roc.rollback("drill")
+        ro0.rollback("drill")
+        assert roc.state == ROLLED_BACK and ro0.state == ROLLED_BACK
+        _submit_both(bc, b0, _mixed(24, "rb-pre", 12))  # replay → hits
+        assert bc.pipeline.confirm_cache.snapshot()["hits"] > 0
+    finally:
+        bc.close()
+        b0.close()
+
+
+def test_tenant_quarantine_boundary_differential(packs):
+    """The quarantine boundary: a quarantined tenant's traffic rides
+    the degraded lane while other tenants get full verdicts — the
+    cache-on batcher must mirror the cache-off one for BOTH classes
+    (degraded verdicts never enter the confirm walk, so the cache can
+    neither serve nor poison them)."""
+    bc, b0 = _pair_batchers(packs)
+    try:
+        now = time.monotonic()
+        for b in (bc, b0):
+            b.tenant_guard._quarantined[1] = now
+        reqs = (_requests(16, attack_every=4, tag="t0-", tenant=0)
+                + _requests(16, attack_every=4, tag="t1-", tenant=1))
+        random.Random(3).shuffle(reqs)
+        vs = _submit_both(bc, b0, reqs)
+        by_tenant = {0: [], 1: []}
+        for v in vs:
+            by_tenant[0 if v.request_id.startswith("t0-") else 1].append(v)
+        # the boundary really exercised both lanes
+        assert any(v.degraded or v.fail_open for v in by_tenant[1])
+        assert all(not v.degraded and not v.fail_open
+                   for v in by_tenant[0])
+    finally:
+        bc.close()
+        b0.close()

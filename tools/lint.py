@@ -60,6 +60,14 @@ Four gates, one verdict:
              multi-tenant traffic — ipt_ prefix, _total on counters,
              HELP/TYPE pairs, bounded label cardinality (fails on the
              first unbounded per-rule/per-tenant series)
+  retunegate profile-guided retuning loop (ISSUE 15, docs/RETUNE.md):
+             a deterministic mini-retune on the bundled pack — profile
+             built once from a bench-corpus telemetry replay, compiled
+             twice (fingerprint must reproduce), zero lost candidates
+             vs the exact compile, zero new false negatives on the
+             golden replay, and the retuned pack's measured candidate
+             load must not exceed the static pack's
+             (reports/RETUNE.json)
   benchtrend the checked-in BENCH_r*.json req/s/chip trajectory
              (tools/bench_trend.py): >10% regression vs the previous
              snapshot fails; SKIPPED with fewer than two artifacts
@@ -583,6 +591,94 @@ def run_promlint() -> dict:
     }
 
 
+def run_retunegate(write_report: bool) -> dict:
+    """Profile-guided retuning gate (ISSUE 15, docs/RETUNE.md): a
+    deterministic mini-retune on the bundled pack.  The profile is
+    built ONCE from a bench-corpus telemetry replay (profile TIMINGS
+    are measurements and legitimately differ between replays — the
+    determinism contract is same profile BYTES → same pack), then the
+    compiler runs twice from those bytes and must (1) reproduce the
+    pack fingerprint, (2) lose ZERO candidates vs the exact compile,
+    (3) replay the golden corpus with ZERO new false negatives vs the
+    static-model pack, and (4) not exceed the static pack's measured
+    candidate load (the deterministic throughput proxy — fewer
+    candidates IS the mechanism of the confirm-stage win)."""
+    t0 = time.time()
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+    sys.path.insert(0, str(REPO / "tools"))
+    import retune as rt
+
+    from ingress_plus_tpu.compiler.profile import MeasuredProfile
+    from ingress_plus_tpu.compiler.reduce import (
+        ReductionConfig,
+        measure_inflation,
+    )
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.normalize import merge_rows, \
+        rows_for_requests
+
+    rules = rt._load_rules()
+    prof = rt.build_profile(rules, corpus_n=192, seed=42)
+    prof_bytes = prof.to_json()
+
+    cr_a = compile_ruleset(rules, reduction=ReductionConfig(profile=prof))
+    cr_b = compile_ruleset(rules, reduction=ReductionConfig(
+        profile=MeasuredProfile.from_json(prof_bytes)))
+    static_cr = compile_ruleset(rules)
+    exact_cr = compile_ruleset(rules, reduction=ReductionConfig.off())
+
+    rows = merge_rows(rows_for_requests(rt._corpus(192, 43)))[0]
+    infl_static = measure_inflation(exact_cr.tables, static_cr.tables,
+                                    rows)
+    infl = measure_inflation(exact_cr.tables, cr_a.tables, rows)
+
+    replay = rt._replay_fns(DetectionPipeline(static_cr, mode="detect"),
+                            DetectionPipeline(cr_a, mode="detect"),
+                            rt._corpus(192, 20260804,
+                                       attack_fraction=0.5))
+
+    checks = {
+        "fingerprint_stable": cr_a.version == cr_b.version,
+        "zero_lost_candidates": infl["lost_candidates"] == 0,
+        "zero_new_fns": replay["new_fns"] == 0,
+        "candidate_load_not_worse":
+            infl["candidates_reduced"]
+            <= infl_static["candidates_reduced"],
+    }
+    report = {
+        "profile_hash": prof.content_hash(),
+        "profile_rules": len(prof.rules),
+        "static_fingerprint": static_cr.version,
+        "retuned_fingerprint": cr_a.version,
+        "retrain_fingerprint": cr_b.version,
+        "inflation": {"static": infl_static, "retuned": infl},
+        "replay": replay,
+        "reduction": cr_a.reduction,
+        "checks": checks,
+        "passed": all(checks.values()),
+    }
+    failed = [k for k, ok in checks.items() if not ok]
+    result = {
+        "status": "OK" if report["passed"] else "FAIL",
+        "seconds": round(time.time() - t0, 2),
+        "detail": ("; ".join(failed) if failed else
+                   "profile %s -> pack %s reproducible, lost=0, "
+                   "new_fns=0, candidates %d <= static %d"
+                   % (report["profile_hash"], cr_a.version,
+                      infl["candidates_reduced"],
+                      infl_static["candidates_reduced"])),
+    }
+    if write_report:
+        out = REPO / "reports" / "RETUNE.json"
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(report, indent=2, default=str) + "\n")
+        result["report"] = str(out.relative_to(REPO))
+    return result
+
+
 def run_benchtrend() -> dict:
     """Bench trajectory gate (ISSUE 12 satellite, tools/bench_trend.py):
     the latest checked-in BENCH_r*.json must not regress >10% vs the
@@ -619,7 +715,7 @@ def main(argv=None) -> int:
                     choices=["ruff", "mypy", "rulecheck", "concheck",
                              "deadrules", "faultmatrix", "swapdrill",
                              "modelgate", "devicegate", "promlint",
-                             "benchtrend"],
+                             "benchtrend", "retunegate"],
                     default=None)
     args = ap.parse_args(argv)
 
@@ -644,6 +740,8 @@ def main(argv=None) -> int:
         gates["devicegate"] = run_devicegate(write_report=args.ci)
     if args.only in (None, "promlint"):
         gates["promlint"] = run_promlint()
+    if args.only in (None, "retunegate"):
+        gates["retunegate"] = run_retunegate(write_report=args.ci)
     if args.only in (None, "benchtrend"):
         gates["benchtrend"] = run_benchtrend()
 
