@@ -1493,6 +1493,36 @@ def run_bench(force_cpu_err: str | None = None) -> dict:
     except Exception as e:
         log("quality artifact embed failed (non-fatal): %r" % (e,))
 
+    # evasion-closure leg (ISSUE 17, docs/ANALYSIS.md "Evasion
+    # analysis"): the seeded mutation harness replays the golden corpus
+    # re-encoded per evasion family through detect_cpu_only — per-family
+    # retention lands in the driver artifact next to the quality story.
+    # A smaller corpus than the evasiongate CI run (this is a bench leg,
+    # not the gate); the gate's full numbers live in
+    # reports/EVASION.json.
+    try:
+        from ingress_plus_tpu.utils.evasion import mutation_harness
+
+        t_ev = time.time()
+        ev = mutation_harness(pipeline, n=600, attack_fraction=0.4)
+        result["evasion"] = {
+            "min_retention": ev["min_retention"],
+            "per_family_retention": {
+                fam: st["retention"]
+                for fam, st in ev["families"].items()},
+            "base_detected": ev["corpus"]["base_detected"],
+            "escapes": sum(st["escapes_total"]
+                           for st in ev["families"].values()),
+            "harness_s": round(time.time() - t_ev, 1),
+            "artifact": "reports/EVASION.json",
+        }
+        log("evasion retention: min %.3f over %d families (%d escapes)"
+            % (ev["min_retention"], len(ev["families"]),
+               result["evasion"]["escapes"]))
+        _HEADLINE = dict(result)
+    except Exception as e:
+        log("evasion leg failed (non-fatal): %r" % (e,))
+
     # added-latency leg (BASELINE.md north star row 2: <2ms p99 added):
     # C++ loadgen -> C++ sidecar -> in-process serve loop — the full
     # production boundary chain.  Never fatal; the throughput headline

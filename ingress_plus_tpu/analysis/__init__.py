@@ -18,7 +18,12 @@ The package also hosts ``concheck`` — the concurrency static analyzer
 over the serve-plane SOURCES (analysis/concheck.py + threadmap.py,
 docs/ANALYSIS.md "Concurrency analysis"): ``run_concheck()``,
 ``python -m ingress_plus_tpu.analysis --conc``, ``dbg concheck``, and
-its own ``concheck`` gate in ``tools/lint.py --ci``.
+its own ``concheck`` gate in ``tools/lint.py --ci`` — and
+``evadecheck``, the evasion-closure analyzer (analysis/evadecheck.py,
+docs/ANALYSIS.md "Evasion analysis"): ``run_evadecheck()``,
+``python -m ingress_plus_tpu.analysis --evade``, ``dbg evadecheck``,
+and the ``evasiongate`` gate (static findings + the utils/evasion.py
+mutation-harness retention floor).
 """
 
 from __future__ import annotations
@@ -37,6 +42,9 @@ from ingress_plus_tpu.analysis.findings import (  # noqa: F401 (public API)
 )
 from ingress_plus_tpu.analysis.concheck import (  # noqa: F401 (public API)
     run_concheck,
+)
+from ingress_plus_tpu.analysis.evadecheck import (  # noqa: F401 (public API)
+    run_evadecheck,
 )
 from ingress_plus_tpu.analysis.lanecheck import check_lanes
 from ingress_plus_tpu.analysis.prefilter_audit import audit_prefilter

@@ -144,8 +144,8 @@ class Report:
     baseline_path: str = ""
     n_rules: int = 0
     pack_version: str = ""
-    #: which analyzer produced this report ("rulecheck" | "concheck") —
-    #: renderers brand their headers/driver from it
+    #: which analyzer produced this report ("rulecheck" | "concheck" |
+    #: "evadecheck") — renderers brand their headers/driver from it
     tool: str = "rulecheck"
     #: tool-specific provenance (concheck: analyzed files, the thread
     #: -root registry, the lock-order edge list)
@@ -198,6 +198,12 @@ class Report:
                      "%d thread roots"
                      % (m.get("functions", 0), len(m.get("files", ())),
                         len(m.get("thread_roots", ())))]
+        elif self.tool == "evadecheck":
+            m = self.meta or {}
+            lines = ["evadecheck: %d rules, pack %s, "
+                     "%d corroborated by runtime escapes"
+                     % (self.n_rules, self.pack_version or "?",
+                        m.get("corroborated", 0))]
         else:
             lines = ["rulecheck: %d rules, pack %s" %
                      (self.n_rules, self.pack_version or "?")]

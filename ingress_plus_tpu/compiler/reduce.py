@@ -260,6 +260,37 @@ def _sig(seq: ClassSeq) -> bytes:
     return bytes(out)
 
 
+# Ubiquitous wire tokens: strings present in essentially every normalized
+# request row (header names, protocol/UA boilerplate).  The independence
+# assumption in _seq_prob cannot see that a merged union's positions
+# correlate into one of these, so a union that happens to cover e.g.
+# "user-agent" is priced as astronomically rare while actually firing on
+# every row — the one failure mode where the greedy merge can silently
+# destroy a prefilter group's selectivity.  Merges whose OUTPUT matches a
+# wire token (when no input did) are vetoed outright instead of priced.
+_WIRE_LITERALS: Tuple[bytes, ...] = (
+    b"user-agent", b"accept-encoding", b"accept-language", b"accept",
+    b"content-type", b"content-length", b"connection", b"keep-alive",
+    b"cookie", b"referer", b"host", b"mozilla/", b"http/1.",
+    b"gzip, deflate", b"text/html", b"charset", b"multipart/form-data",
+    b"x-www-form-urlencoded", b"applewebkit", b"gecko",
+)
+
+
+def _matches_wire_literal(seq: ClassSeq) -> bool:
+    """True if ``seq`` (case-folded) can match inside any ubiquitous wire
+    token — i.e. the factor would fire on essentially every request."""
+    folded = [_fold_close(c) for c in seq]
+    n = len(folded)
+    for lit in _WIRE_LITERALS:
+        if len(lit) < n:
+            continue
+        for off in range(len(lit) - n + 1):
+            if all(lit[off + j] in folded[j] for j in range(n)):
+                return True
+    return False
+
+
 def _apply_mapping(mapping: Dict[ClassSeq, ClassSeq],
                    seq: ClassSeq) -> ClassSeq:
     """Chase merge chains (A→B, B→C ⇒ A→C), path-compressing."""
@@ -369,6 +400,9 @@ def reduce_rule_groups(
             distinct = [m for m in members if m != canon]
             if len(members) < 2 or not distinct:
                 continue
+            if _matches_wire_literal(canon) and not any(
+                    _matches_wire_literal(m) for m in members):
+                continue   # widening would cover request boilerplate
             total = sum(uni[m] for m in members)
             d = _seq_prob(canon, mu) * total - sum(
                 _seq_prob(m, mu) * uni[m] for m in members)
@@ -409,6 +443,10 @@ def reduce_rule_groups(
                     if not ok:
                         continue
                     useq = tuple(u)
+                    if _matches_wire_literal(useq) and not (
+                            _matches_wire_literal(a)
+                            or _matches_wire_literal(b)):
+                        continue   # union would cover request boilerplate
                     d = (_seq_prob(useq, mu) * (uni[a] + uni[b])
                          - _seq_prob(a, mu) * uni[a]
                          - _seq_prob(b, mu) * uni[b])

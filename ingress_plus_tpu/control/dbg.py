@@ -13,6 +13,8 @@ state.  Same idea against our HTTP plane:
         [--paranoia 2]
     python -m ingress_plus_tpu.control.dbg rulecheck [--rules path] \
         [--fail-on error]
+    python -m ingress_plus_tpu.control.dbg evadecheck [--rules path] \
+        [--fail-on error]
     python -m ingress_plus_tpu.control.dbg rules    [--server host:port]
     python -m ingress_plus_tpu.control.dbg drift    [--server host:port]
     python -m ingress_plus_tpu.control.dbg scoring  [--swap head.npz] [--force]
@@ -47,6 +49,9 @@ clears — the deterministic fault-injection plan (``/faults``).
 see docs/ANALYSIS.md) locally over a rules tree (default: the bundled
 CRS tree) and renders the findings table; exit code mirrors the CI
 gate (nonzero on unsuppressed findings at/above ``--fail-on``).
+``evadecheck`` does the same for the evasion-closure analyzer
+(docs/ANALYSIS.md "Evasion analysis"); ``concheck`` for the
+serve-plane concurrency analyzer.
 """
 
 from __future__ import annotations
@@ -510,8 +515,8 @@ def main(argv=None) -> int:
     ap.add_argument("cmd",
                     choices=["conf", "health", "metrics", "latency",
                              "tenants", "ruleset", "acl", "rulecheck",
-                             "concheck", "rules", "drift", "breaker",
-                             "faults", "rollout", "scoring",
+                             "concheck", "evadecheck", "rules", "drift",
+                             "breaker", "faults", "rollout", "scoring",
                              "timeline"])
     ap.add_argument("--cycles", type=int, default=6,
                     help="timeline: how many recent cycles to render "
@@ -539,7 +544,7 @@ def main(argv=None) -> int:
                          "--status-port JSON at this host:port")
     args = ap.parse_args(argv)
 
-    if args.cmd in ("rulecheck", "concheck"):
+    if args.cmd in ("rulecheck", "concheck", "evadecheck"):
         # local analysis, no serve plane involved — delegate to the
         # analyzer CLI so dbg and `python -m ingress_plus_tpu.analysis`
         # render and gate identically
@@ -547,8 +552,11 @@ def main(argv=None) -> int:
         rc_args = ["--fail-on", args.fail_on]
         if args.cmd == "concheck":
             rc_args.append("--conc")
-        elif args.rules:
-            rc_args += ["--rules", args.rules]
+        else:
+            if args.cmd == "evadecheck":
+                rc_args.append("--evade")
+            if args.rules:
+                rc_args += ["--rules", args.rules]
         return rc_main(rc_args)
 
     try:

@@ -153,12 +153,18 @@ def _sqli_token_patterns(tokens: List[Tuple[str, bytes]]) -> bool:
                     "alter", "exec", "execute", "declare", "truncate")
                    for r in rest[:3]):
                 return True
-    # boolean glue + comparison: (OR|AND) value cmp value
+    # boolean glue + comparison: (OR|AND) value cmp value.  Inline
+    # comments are token separators in every SQL dialect
+    # (OR/**/1/**/=/**/1 ≡ OR 1=1), so they are dropped before the
+    # comparison-shape test — the TRUNCATION test below still sees them
+    # in place (evadecheck evade.literal-fragility, corroborated by the
+    # comment mutation family: /files/1/**/OR/**/1=1 escaped).
     for i, k in enumerate(kinds):
         if k in ("kw:or", "kw:and") and i + 3 <= len(tokens):
             rest = tokens[i + 1 :]
-            if len(rest) >= 3 and _is_value(rest[0]) and \
-               rest[1][1].lower() in _CMP_OPS and _is_value(rest[2]):
+            vals = [t for t in rest if t[0] != "comment"]
+            if len(vals) >= 3 and _is_value(vals[0]) and \
+               vals[1][1].lower() in _CMP_OPS and _is_value(vals[2]):
                 return True
             # OR 'a' / OR 1 — bare truthy value then TRUNCATION: end of
             # input, a line comment anywhere, or an inline comment that

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from ingress_plus_tpu.serve.normalize import Request
 
@@ -170,7 +170,17 @@ def _benign(rng: random.Random, i: int) -> Request:
                    request_id="benign-%d" % i)
 
 
-def _attack(rng: random.Random, i: int) -> LabeledRequest:
+#: payload mutation hook (utils/evasion.py mutation harness): called as
+#: ``mutate(payload, attack_class, carrier)`` AFTER the carrier slot is
+#: drawn and BEFORE placement, where carrier ∈ {"query", "body", "path",
+#: "header"}.  The hook must not touch the shared rng — every rng draw
+#: happens before it runs, so a mutated corpus keeps the golden corpus'
+#: exact placements (same requests, only the payload bytes differ).
+PayloadMutator = Callable[[str, str, str], str]
+
+
+def _attack(rng: random.Random, i: int,
+            mutate: Optional[PayloadMutator] = None) -> LabeledRequest:
     cls, payloads = _ATTACKS[rng.randrange(len(_ATTACKS))]
     payload = rng.choice(payloads)
     slot = rng.random()
@@ -184,16 +194,20 @@ def _attack(rng: random.Random, i: int) -> LabeledRequest:
         slot = rng.random() * 0.8
     headers = {"host": "shop.example.com",
                "user-agent": rng.choice(_BENIGN_AGENTS)}
+    carrier = ("query" if slot < 0.5 else "body" if slot < 0.8
+               else "path" if slot < 0.9 else "header")
+    if mutate is not None:
+        payload = mutate(payload, cls, carrier)
     method, uri, body = "GET", "/", b""
-    if slot < 0.5:  # query arg
+    if carrier == "query":
         uri = "/search?q=" + payload.replace(" ", "+")
-    elif slot < 0.8:  # body
+    elif carrier == "body":
         method = "POST"
         uri = "/api/v1/comments"
         body = ("comment=" + payload).encode("utf-8", "surrogateescape")
         headers["content-length"] = str(len(body))
         headers["content-type"] = "application/x-www-form-urlencoded"
-    elif slot < 0.9:  # uri path
+    elif carrier == "path":
         uri = "/files/" + payload
     else:  # header
         headers["user-agent"] = payload
@@ -209,14 +223,18 @@ def generate_corpus(
     attack_fraction: float = 0.2,
     seed: int = 20260729,
     tenants: int = 1,
+    payload_mutator: Optional[PayloadMutator] = None,
 ) -> List[LabeledRequest]:
     """Deterministic labeled corpus; ``tenants`` spreads requests across
-    tenant ids for the EP/multi-tenant configs."""
+    tenant ids for the EP/multi-tenant configs.  ``payload_mutator``
+    rewrites attack payloads in place (see :data:`PayloadMutator`) —
+    the evasion-mutation harness replays the SAME corpus with only the
+    payload bytes re-encoded."""
     rng = random.Random(seed)
     out: List[LabeledRequest] = []
     for i in range(n):
         if rng.random() < attack_fraction:
-            lr = _attack(rng, i)
+            lr = _attack(rng, i, mutate=payload_mutator)
         else:
             lr = LabeledRequest(request=_benign(rng, i), is_attack=False)
         lr.request.tenant = rng.randrange(tenants) if tenants > 1 else 0

@@ -28,13 +28,15 @@ and the pins in ``tests/test_quality.py``.
 from __future__ import annotations
 
 import base64
+import hashlib
 import json
 import random
+import re
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ingress_plus_tpu.serve.normalize import Request
-from ingress_plus_tpu.utils.corpus import LabeledRequest
+from ingress_plus_tpu.utils.corpus import LabeledRequest, generate_corpus
 
 # --------------------------------------------------------------------------
 # Classic payloads (public-knowledge attack strings; NOT template output)
@@ -507,3 +509,351 @@ def generate_benign(n: int = 10_000, seed: int = 20260731
                             body=body, request_id="benign-q-%d" % i),
             is_attack=False))
     return out
+
+
+# ==========================================================================
+# Seeded mutation harness — evadecheck's runtime twin (ISSUE 17)
+# ==========================================================================
+# The CLASSIC leg above answers "do we catch well-known public payloads?".
+# This section answers the harder question ROADMAP item 5 asks: does the
+# GOLDEN corpus detection survive re-encoding?  Composable, deterministic,
+# seeded payload mutators are applied to the golden attack corpus
+# (utils/corpus.py generate_corpus, payload_mutator hook — identical rng
+# draws, so placements never change), the mutants replay through
+# ``DetectionPipeline.detect_cpu_only`` (exact confirm semantics, zero
+# device dispatch), and each mutation FAMILY gets a retention score:
+#
+#     retention = detected(mutant) / detected(base)   over attacks the
+#     family actually mutated (identity mutations are excluded from the
+#     denominator — they would inflate retention for free).
+#
+# Families are SEMANTIC-PRESERVING per attack class and carrier: a
+# %-encoded User-Agent is not a shellshock attack (no backend decodes
+# header bytes), entity-splicing a shell command is noise — so each
+# family declares which (class, carrier) pairs it may rewrite, mirroring
+# _CTX_TRANSFORMS above.  reports/EVASION.json (tools/lint.py
+# ``evasiongate``) holds every family to a ≥0.95 floor, and
+# analysis/evadecheck.py uses the per-escape rule attribution to
+# corroborate its static findings.
+
+#: gate families, in report order.  Each maps to a static evadecheck
+#: check class: url/html/unicode → evade.transform-closure, comment/
+#: whitespace → evade.literal-fragility, case → evade.case-hole,
+#: split → evade.anchor-hazard (+ the future chunk-window seam).
+MUTATION_FAMILIES: Tuple[str, ...] = (
+    "case", "comment", "whitespace", "url", "html", "unicode", "split")
+
+#: attack classes a family can rewrite without breaking the attack at
+#: its sink (SQL keywords are case-insensitive; shell commands are NOT;
+#: /**/ is a token separator only in SQL; entities only decode in an
+#: HTML sink; %uXXXX only on IIS-era stacks, which serve SQLi/XSS
+#: targets, not shell sinks).  java/nodejs are excluded from "case":
+#: Java class names, JS identifiers and base64 gadget blobs are all
+#: case-SENSITIVE — a flipped rO0AB… is not a serialized stream any
+#: more (first probe run surfaced exactly those as false escapes).
+_FAMILY_CLASSES: Dict[str, frozenset] = {
+    "case": frozenset({"sqli", "xss", "php"}),
+    "comment": frozenset({"sqli"}),
+    "whitespace": frozenset({"sqli", "xss"}),
+    "url": frozenset({"sqli", "xss", "lfi", "rce", "php", "rfi",
+                      "traversal", "protocol", "nodejs", "java"}),
+    "html": frozenset({"xss"}),
+    "unicode": frozenset({"sqli", "xss"}),
+    "split": frozenset({"sqli", "lfi"}),
+}
+
+#: carriers whose server-side decode chain actually undoes the family's
+#: encoding (query/body/path values are url-decoded by every backend;
+#: NOTHING decodes header bytes, so only byte-identity families apply
+#: there).
+_FAMILY_CARRIERS: Dict[str, frozenset] = {
+    "case": frozenset({"query", "body", "path", "header"}),
+    "comment": frozenset({"query", "body", "path"}),
+    "whitespace": frozenset({"query", "body"}),
+    "url": frozenset({"query", "body", "path"}),
+    "html": frozenset({"query", "body"}),
+    "unicode": frozenset({"query", "body", "path"}),
+    "split": frozenset({"query", "body", "path"}),
+}
+
+
+def _m_case(p: str, rng: random.Random, carrier: str) -> str:
+    """Case flip: ~half the letters swap case (keyword matchers without
+    a case-folded lane lose them)."""
+    return "".join(
+        (c.lower() if c.isupper() else c.upper()) if c.isalpha()
+        and rng.random() < 0.5 else c
+        for c in p)
+
+
+def _m_comment(p: str, rng: random.Random, carrier: str) -> str:
+    """SQL inline comments as token separators: spaces → ``/**/``.
+    Semantic-preserving (a comment separates SQL tokens exactly like
+    whitespace); keyword SPLITTING (``UN/**/ION``) is deliberately not
+    done — it breaks the statement on every mainstream SQL engine, so a
+    miss there would be noise, not a detection gap."""
+    return "".join("/**/" if c == " " and rng.random() < 0.8 else c
+                   for c in p)
+
+
+_WS_BYTES_SUBS = ["\t", "\n", "\r", "\x0b", "\x0c"]
+
+
+def _m_whitespace(p: str, rng: random.Random, carrier: str) -> str:
+    """Whitespace churn: spaces → tab/newline/VT/FF (SQL and HTML treat
+    them all as separators; a regex requiring a literal 0x20 does not)."""
+    return "".join(rng.choice(_WS_BYTES_SUBS) if c == " " else c
+                   for c in p)
+
+
+_PCT_SEQ = re.compile(r"%(?:[0-9a-fA-F]{2}|u[0-9a-fA-F]{4})")
+
+
+def _m_url(p: str, rng: random.Random, carrier: str) -> str:
+    """N-layer URL encoding.  Layer 1 percent-encodes every raw
+    non-alnum byte (+ ~30% of letters); pre-existing ``%XX``/``%uXXXX``
+    sequences pass through UNTOUCHED — re-encoding them would demand a
+    decode layer the backend never performs, breaking the attack (first
+    probe run produced exactly those triple-encoded false escapes).  In
+    query/body carriers, and only when the payload carried no encoding
+    of its own, a second layer (``%`` → ``%25``) rides on top ~half the
+    time — the double-decode stacks t:urlDecodeUni exists for."""
+    had_enc = bool(_PCT_SEQ.search(p))
+    out = []
+    i = 0
+    while i < len(p):
+        m = _PCT_SEQ.match(p, i)
+        if m:
+            out.append(m.group(0))
+            i = m.end()
+            continue
+        ch = p[i]
+        i += 1
+        if ch.isalnum() and rng.random() >= 0.3:
+            out.append(ch)
+        elif carrier == "path" and ch == "/":
+            out.append(ch)  # keep path structure routable
+        else:
+            out.append("".join(
+                "%%%02x" % b
+                for b in ch.encode("utf-8", "surrogateescape")))
+    enc = "".join(out)
+    if not had_enc and carrier in ("query", "body") and rng.random() < 0.5:
+        enc = enc.replace("%", "%25")
+    return enc
+
+
+def _m_html(p: str, rng: random.Random, carrier: str) -> str:
+    """HTML entity splicing (hex/decimal) over letters and the XSS
+    metacharacters the browser decodes in attribute context."""
+    out = []
+    for c in p:
+        if (c.isalpha() or c in "()=:") and rng.random() < 0.4:
+            out.append("&#x%x;" % ord(c) if rng.random() < 0.5
+                       else "&#%d;" % ord(c))
+        else:
+            out.append(c)
+    return "".join(out)
+
+
+def _m_unicode(p: str, rng: random.Random, carrier: str) -> str:
+    """%uXXXX (IIS) encoding of metacharacters — lenient decoders map
+    them back to the ASCII byte.  Pre-existing percent sequences pass
+    through untouched (same single-decode-layer argument as _m_url)."""
+    out = []
+    i = 0
+    while i < len(p):
+        m = _PCT_SEQ.match(p, i)
+        if m:
+            out.append(m.group(0))
+            i = m.end()
+            continue
+        c = p[i]
+        i += 1
+        if not c.isalnum() and c != " " and rng.random() < 0.8:
+            out.append("%%u%04x" % ord(c))
+        else:
+            out.append(c)
+    return "".join(out)
+
+
+def _m_split(p: str, rng: random.Random, carrier: str) -> str:
+    """Boundary splitting: NUL splices inside keywords (folded away by
+    removeNulls after decode — the C-string truncation classic) plus a
+    benign prefix pad (defeats ``^``/start-of-row anchoring and stands
+    in for the chunk-boundary splits ROADMAP item 3's windowed scanning
+    must stay closed under)."""
+    words = p.split(" ")
+    out = []
+    for w in words:
+        if len(w) > 4 and rng.random() < 0.6:
+            cut = rng.randrange(1, len(w))
+            nul = "%00" if carrier in ("query", "path") else "\x00"
+            w = w[:cut] + nul + w[cut:]
+        out.append(w)
+    pad = rng.choice(["note ", "ref 12 ", "a "])
+    return pad + " ".join(out)
+
+
+_MUTATORS: Dict[str, Callable[[str, random.Random, str], str]] = {
+    "case": _m_case,
+    "comment": _m_comment,
+    "whitespace": _m_whitespace,
+    "url": _m_url,
+    "html": _m_html,
+    "unicode": _m_unicode,
+    "split": _m_split,
+}
+
+
+def mutate_payload(payload: str, attack_class: str, carrier: str,
+                   families: Sequence[str], seed: int = 0) -> str:
+    """Apply each applicable family in order (composable).  Deterministic
+    in (payload, class, carrier, families, seed) alone — per-payload rng
+    reseeding makes the result independent of call order, so subsetting
+    the corpus can never shift another payload's mutation."""
+    for fam in families:
+        if fam not in _MUTATORS:
+            raise ValueError("unknown mutation family %r (known: %s)"
+                             % (fam, ", ".join(MUTATION_FAMILIES)))
+        if attack_class not in _FAMILY_CLASSES[fam]:
+            continue
+        if carrier not in _FAMILY_CARRIERS[fam]:
+            continue
+        key = "%d|%s|%s|%s|%s" % (seed, fam, attack_class, carrier, payload)
+        rng = random.Random(key)
+        payload = _MUTATORS[fam](payload, rng, carrier)
+    return payload
+
+
+def family_mutator(families: Sequence[str], seed: int = 0):
+    """A ``utils.corpus.PayloadMutator`` applying ``families`` in order."""
+    fams = tuple(families)
+
+    def _mutate(payload: str, attack_class: str, carrier: str) -> str:
+        return mutate_payload(payload, attack_class, carrier, fams, seed)
+
+    return _mutate
+
+
+def request_digest(requests: Sequence[Request]) -> str:
+    """Canonical sha256 over a request list — the determinism pin (same
+    seed ⇒ byte-identical corpus)."""
+    h = hashlib.sha256()
+    for r in requests:
+        h.update(r.method.encode())
+        h.update(b"\x00")
+        h.update(r.uri.encode("utf-8", "surrogateescape"))
+        h.update(b"\x00")
+        for k in sorted(r.headers):
+            h.update(("%s=%s" % (k, r.headers[k])).encode(
+                "utf-8", "surrogateescape"))
+            h.update(b"\x01")
+        h.update(b"\x00")
+        h.update(r.body)
+        h.update(b"\x02")
+    return h.hexdigest()
+
+
+def _infer_carrier(req: Request) -> str:
+    if req.body:
+        return "body"
+    if "?" in req.uri:
+        return "query"
+    if req.uri.startswith("/files/"):
+        return "path"
+    return "header"
+
+
+def retention_score(base_detected: int, retained: int) -> float:
+    """Family retention: retained / base-detected, 1.0 when the family
+    mutated nothing it had detected (vacuously closed)."""
+    if base_detected <= 0:
+        return 1.0
+    return retained / base_detected
+
+
+def mutation_harness(pipeline, families: Optional[Sequence[str]] = None,
+                     n: int = 1200, attack_fraction: float = 0.4,
+                     corpus_seed: int = 20260729, seed: int = 20260807,
+                     batch: int = 128, max_escape_records: int = 40) -> dict:
+    """Replay the golden attack corpus, mutated per family, through
+    ``pipeline.detect_cpu_only``; score per-family retention and record
+    every escape with the base verdict's rule attribution (what
+    evadecheck corroborates its static findings against)."""
+    families = list(families) if families is not None \
+        else list(MUTATION_FAMILIES)
+    golden = [lr for lr in generate_corpus(
+        n=n, attack_fraction=attack_fraction, seed=corpus_seed)
+        if lr.is_attack]
+    base_reqs = [lr.request for lr in golden]
+
+    def _detect(reqs):
+        out = []
+        for i in range(0, len(reqs), batch):
+            out.extend(pipeline.detect_cpu_only(reqs[i:i + batch]))
+        return out
+
+    base_verdicts = _detect(base_reqs)
+    base_attack = [v.attack for v in base_verdicts]
+
+    fam_out: Dict[str, dict] = {}
+    for fam in families:
+        mutated = [lr for lr in generate_corpus(
+            n=n, attack_fraction=attack_fraction, seed=corpus_seed,
+            payload_mutator=family_mutator([fam], seed))
+            if lr.is_attack]
+        assert len(mutated) == len(golden)
+        # only actually-mutated, base-detected attacks enter the score
+        idx = [i for i in range(len(golden))
+               if base_attack[i]
+               and (mutated[i].request.uri != golden[i].request.uri
+                    or mutated[i].request.body != golden[i].request.body
+                    or mutated[i].request.headers
+                    != golden[i].request.headers)]
+        mut_verdicts = _detect([mutated[i].request for i in idx])
+        retained = 0
+        escapes = []
+        by_class: Dict[str, List[int]] = {}
+        for j, i in enumerate(idx):
+            cls = golden[i].attack_class
+            d, t = by_class.setdefault(cls, [0, 0])
+            t += 1
+            if mut_verdicts[j].attack:
+                retained += 1
+                d += 1
+            else:
+                escapes.append({
+                    "request_id": golden[i].request.request_id,
+                    "attack_class": cls,
+                    "carrier": _infer_carrier(golden[i].request),
+                    "base_rule_ids": [int(r) for r
+                                      in base_verdicts[i].rule_ids],
+                    "base_score": int(base_verdicts[i].score),
+                })
+            by_class[cls] = [d, t]
+        fam_out[fam] = {
+            "base_detected": len(idx),
+            "retained": retained,
+            "retention": round(retention_score(len(idx), retained), 4),
+            "unmutated_detected": sum(base_attack) - len(idx),
+            "per_class": {c: {"retained": d, "mutated": t,
+                              "retention": round(retention_score(t, d), 4)}
+                          for c, (d, t) in sorted(by_class.items())},
+            "escapes": escapes[:max_escape_records],
+            "escapes_total": len(escapes),
+        }
+
+    return {
+        "corpus": {
+            "n": n, "attack_fraction": attack_fraction,
+            "corpus_seed": corpus_seed, "mutation_seed": seed,
+            "attacks": len(golden),
+            "base_detected": sum(base_attack),
+            "base_detection_rate": round(
+                sum(base_attack) / max(len(golden), 1), 4),
+        },
+        "families": fam_out,
+        "min_retention": min(
+            (f["retention"] for f in fam_out.values()), default=1.0),
+    }

@@ -292,8 +292,17 @@ bool SqliTokenPatterns(const std::vector<Token>& toks) {
     size_t rest = toks.size() - (i + 1);
     if (rest < 2) continue;  // python guard: i + 3 <= len(tokens), so a
                              // bare "AND word" at end-of-input is no hit
-    if (rest >= 3 && IsValue(toks[i + 1]) && IsCmpText(toks[i + 2].text) &&
-        IsValue(toks[i + 3]))
+    // comparison shape over the first 3 NON-comment tokens: inline
+    // comments are token separators (OR/**/1/**/=/**/1 ≡ OR 1=1); the
+    // truncation test below still reads positions with comments intact
+    size_t v[3];
+    int nv = 0;
+    for (size_t j = i + 1; j < toks.size() && nv < 3; ++j) {
+      if (toks[j].kind == Kind::kComment) continue;
+      v[nv++] = j;
+    }
+    if (nv == 3 && IsValue(toks[v[0]]) && IsCmpText(toks[v[1]].text) &&
+        IsValue(toks[v[2]]))
       return true;
     // bare truthy value then TRUNCATION: a line comment anywhere, or
     // an inline comment that ENDS the input.  A mid-expression /**/ is

@@ -1,0 +1,449 @@
+"""evadecheck — the static evasion-closure analyzer (ISSUE 17) and its
+runtime twin, the utils/evasion.py seeded mutation harness.
+
+Every check class gets a FAILING synthetic fixture plus a clean
+counterpart; the bundled CRS tree is pinned fully baselined at warning
+severity (the evasiongate contract); the escapes the analyzer found and
+this PR fixed (comment-glue SQLi, %-encoded raw-uri payloads, entity-
+encoded header markup) are pinned by pipeline-level regressions; and the
+harness itself is pinned deterministic (same seed => byte-identical
+mutated corpus)."""
+
+from __future__ import annotations
+
+import ctypes
+import json
+from pathlib import Path
+
+import pytest
+
+from ingress_plus_tpu.analysis import run_evadecheck
+from ingress_plus_tpu.analysis.evadecheck import BASELINE, FAMILY_CHECK
+from ingress_plus_tpu.analysis.findings import Baseline
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+from ingress_plus_tpu.models.libdetect import detect_sqli_py
+from ingress_plus_tpu.models.pipeline import DetectionPipeline
+from ingress_plus_tpu.serve.normalize import Request
+from ingress_plus_tpu.utils.corpus import generate_corpus
+from ingress_plus_tpu.utils.evasion import (
+    MUTATION_FAMILIES,
+    family_mutator,
+    mutate_payload,
+    mutation_harness,
+    request_digest,
+    retention_score,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    return DetectionPipeline(compile_ruleset(load_bundled_rules()),
+                             mode="monitoring")
+
+
+def _tree(tmp_path, text):
+    (tmp_path / "rules.conf").write_text(text)
+    return tmp_path
+
+
+def _run(tmp_path, text, **kw):
+    return run_evadecheck(rules_path=_tree(tmp_path, text),
+                          baseline_path=None, **kw)
+
+
+def _checks(report, severity=None):
+    return {(f.check, f.subject) for f in report.findings
+            if severity is None or f.severity == severity}
+
+
+# ------------------------------------------- 1. evade.transform-closure
+
+
+def test_raw_uri_without_decode_flagged(tmp_path):
+    rep = _run(tmp_path,
+               'SecRule REQUEST_URI "@rx (?i)/etc/passwd" '
+               '"id:1,phase:1,block,severity:CRITICAL,tag:\'attack-lfi\'"')
+    assert ("evade.transform-closure", "missing-url-decode") \
+        in _checks(rep, "warning")
+
+
+def test_raw_uri_with_decode_clean(tmp_path):
+    rep = _run(tmp_path,
+               'SecRule REQUEST_URI "@rx (?i)/etc/passwd" '
+               '"id:1,phase:1,block,t:urlDecodeUni,severity:CRITICAL,'
+               'tag:\'attack-lfi\'"')
+    assert ("evade.transform-closure", "missing-url-decode") \
+        not in _checks(rep)
+
+
+def test_encoding_detector_exempt_from_decode_check(tmp_path):
+    # a rule that MATCHES percent-forms models encoding by design
+    rep = _run(tmp_path,
+               'SecRule REQUEST_URI "@rx (?i)%2e%2e%2f" '
+               '"id:1,phase:1,block,severity:CRITICAL,tag:\'attack-lfi\'"')
+    assert ("evade.transform-closure", "missing-url-decode") \
+        not in _checks(rep)
+
+
+def test_xss_markup_without_html_decode_flagged(tmp_path):
+    rep = _run(tmp_path,
+               'SecRule ARGS "@rx (?i)<script" '
+               '"id:2,phase:2,block,severity:CRITICAL,tag:\'attack-xss\'"')
+    assert ("evade.transform-closure", "missing-html-decode") \
+        in _checks(rep, "notice")
+
+
+def test_xss_markup_with_html_decode_clean(tmp_path):
+    rep = _run(tmp_path,
+               'SecRule ARGS "@rx (?i)<script" '
+               '"id:2,phase:2,block,t:htmlEntityDecode,'
+               'severity:CRITICAL,tag:\'attack-xss\'"')
+    assert ("evade.transform-closure", "missing-html-decode") \
+        not in _checks(rep)
+
+
+# ------------------------------------------- 2. evade.literal-fragility
+
+
+def test_spaced_literal_without_comment_transform_flagged(tmp_path):
+    rep = _run(tmp_path,
+               'SecRule ARGS "@rx (?i)union select" '
+               '"id:3,phase:2,block,t:lowercase,severity:CRITICAL,'
+               'tag:\'attack-sqli\'"')
+    got = _checks(rep)
+    assert ("evade.literal-fragility", "comment-severable") in got
+    assert ("evade.literal-fragility", "whitespace-severable") in got
+
+
+def test_comment_transform_silences_comment_severable(tmp_path):
+    rep = _run(tmp_path,
+               'SecRule ARGS "@rx (?i)union select" '
+               '"id:3,phase:2,block,t:lowercase,t:replaceComments,'
+               't:compressWhitespace,severity:CRITICAL,'
+               'tag:\'attack-sqli\'"')
+    assert ("evade.literal-fragility", "comment-severable") \
+        not in _checks(rep)
+    assert ("evade.literal-fragility", "whitespace-severable") \
+        not in _checks(rep)
+
+
+def test_gapless_literal_not_fragile(tmp_path):
+    rep = _run(tmp_path,
+               'SecRule ARGS "@rx (?i)xp_cmdshell" '
+               '"id:3,phase:2,block,t:lowercase,severity:CRITICAL,'
+               'tag:\'attack-sqli\'"')
+    assert ("evade.literal-fragility", "comment-severable") \
+        not in _checks(rep)
+
+
+# ------------------------------------------------- 3. evade.case-hole
+
+
+def test_case_sensitive_keyword_flagged(tmp_path):
+    rep = _run(tmp_path,
+               'SecRule ARGS "@rx select.+from" '
+               '"id:4,phase:2,block,severity:CRITICAL,'
+               'tag:\'attack-sqli\'"')
+    assert ("evade.case-hole", "case-sensitive-keyword") \
+        in _checks(rep, "notice")
+
+
+def test_lowercase_transform_silences_case_hole(tmp_path):
+    rep = _run(tmp_path,
+               'SecRule ARGS "@rx select.+from" '
+               '"id:4,phase:2,block,t:lowercase,severity:CRITICAL,'
+               'tag:\'attack-sqli\'"')
+    assert ("evade.case-hole", "case-sensitive-keyword") \
+        not in _checks(rep)
+
+
+def test_inline_ignorecase_silences_case_hole(tmp_path):
+    rep = _run(tmp_path,
+               'SecRule ARGS "@rx (?i)select.+from" '
+               '"id:4,phase:2,block,severity:CRITICAL,'
+               'tag:\'attack-sqli\'"')
+    assert ("evade.case-hole", "case-sensitive-keyword") \
+        not in _checks(rep)
+
+
+def test_wire_token_rule_exempt_from_case_hole(tmp_path):
+    # REQUEST_METHOD is a case-sensitive wire token by HTTP grammar —
+    # 'get' is not a miscased GET, it is a different (invalid) method
+    rep = _run(tmp_path,
+               'SecRule REQUEST_METHOD "@rx ^(?:CONNECT|TRACE)$" '
+               '"id:5,phase:1,block,severity:CRITICAL,'
+               'tag:\'attack-protocol\'"')
+    assert ("evade.case-hole", "case-sensitive-keyword") \
+        not in _checks(rep)
+    assert ("evade.anchor-hazard", "start-anchored") not in _checks(rep)
+
+
+# --------------------------------------------- 4. evade.anchor-hazard
+
+
+def test_start_anchored_args_rule_flagged(tmp_path):
+    rep = _run(tmp_path,
+               'SecRule ARGS "@rx ^(?:debug|admin)$" '
+               '"id:6,phase:2,block,t:lowercase,severity:CRITICAL,'
+               'tag:\'attack-protocol\'"')
+    assert ("evade.anchor-hazard", "start-anchored") \
+        in _checks(rep, "notice")
+
+
+def test_unanchored_args_rule_clean(tmp_path):
+    rep = _run(tmp_path,
+               'SecRule ARGS "@rx (?:debug|admin)" '
+               '"id:6,phase:2,block,t:lowercase,severity:CRITICAL,'
+               'tag:\'attack-protocol\'"')
+    assert ("evade.anchor-hazard", "start-anchored") not in _checks(rep)
+
+
+def test_anchored_uri_rule_not_flagged(tmp_path):
+    # uri rows start at the request line's fixed framing: the attacker
+    # cannot pad in front of the method/path, so ^ is safe there
+    rep = _run(tmp_path,
+               'SecRule REQUEST_URI "@rx ^/admin" '
+               '"id:6,phase:1,block,t:urlDecodeUni,severity:CRITICAL,'
+               'tag:\'attack-protocol\'"')
+    assert ("evade.anchor-hazard", "start-anchored") not in _checks(rep)
+
+
+# ------------------------------------------------ corroboration plumbing
+
+
+def test_runtime_escape_corroborates_static_finding(tmp_path):
+    text = ('SecRule REQUEST_URI "@rx (?i)/etc/passwd" '
+            '"id:1,phase:1,block,severity:CRITICAL,tag:\'attack-lfi\'"')
+    escape = {"family": "url", "base_rule_ids": [1],
+              "request_id": "atk-7", "attack_class": "lfi",
+              "carrier": "path"}
+    rep = _run(tmp_path, text, escapes=[escape])
+    f = next(f for f in rep.findings
+             if f.check == "evade.transform-closure" and f.rule_id == 1)
+    assert f.severity == "error"
+    assert "CORROBORATED" in f.message and "atk-7" in f.message
+    assert rep.meta["corroborated"] == 1
+
+
+def test_unrelated_escape_does_not_corroborate(tmp_path):
+    text = ('SecRule REQUEST_URI "@rx (?i)/etc/passwd" '
+            '"id:1,phase:1,block,severity:CRITICAL,tag:\'attack-lfi\'"')
+    # comment-family escape maps to literal-fragility, not closure
+    escape = {"family": "comment", "base_rule_ids": [1],
+              "request_id": "atk-8"}
+    rep = _run(tmp_path, text, escapes=[escape])
+    f = next(f for f in rep.findings
+             if f.check == "evade.transform-closure" and f.rule_id == 1)
+    assert f.severity == "warning"
+    assert rep.meta["corroborated"] == 0
+
+
+def test_family_check_map_covers_every_family():
+    assert set(FAMILY_CHECK) == set(MUTATION_FAMILIES)
+
+
+# --------------------------------------------------- bundled-tree pins
+
+
+def test_crs_tree_fully_baselined_at_warning():
+    """The evasiongate contract: every surviving static finding on the
+    bundled pack carries a reasoned baseline entry."""
+    rep = run_evadecheck()
+    assert rep.tool == "evadecheck"
+    assert rep.n_rules > 200
+    assert rep.gating("warning") == []
+    assert rep.gating("info") == []  # notices/infos baselined too
+    suppressed = [f for f in rep.findings if f.suppressed]
+    assert suppressed, "baseline should be exercised, not empty"
+    assert all(f.suppress_reason for f in suppressed)
+
+
+def test_baseline_file_is_valid_and_fully_used():
+    bl = Baseline.load(BASELINE)
+    rep = run_evadecheck(baseline_path=None)
+    # every entry matches at least one live finding — no stale entries
+    for entry in bl.entries:
+        solo = Baseline(entries=[entry])
+        assert any(solo.match(f) for f in rep.findings), \
+            "stale baseline entry: %r" % entry["reason"][:60]
+
+
+def test_missing_tree_is_operational_error(tmp_path):
+    with pytest.raises(OSError):
+        run_evadecheck(rules_path=tmp_path / "nope", baseline_path=None)
+
+
+# ------------------------------------- fixed-escape pipeline regressions
+
+
+def test_comment_glue_sqli_detected(pipeline):
+    """The comment-family escape this PR fixed: /**/ as keyword glue
+    (942110/942310 t:replaceComments + libdetect comment-skip)."""
+    for uri in ("/search?q=1/**/OR/**/1=1",
+                "/search?q='/**/OR/**/'a'='a"):
+        req = Request(method="GET", uri=uri,
+                      headers={"host": "a"}, body=b"")
+        v = pipeline.detect_cpu_only([req])[0]
+        assert v.attack, uri
+        assert set(v.rule_ids) & {942110, 942111, 942300, 942310}, uri
+
+
+def test_libdetect_comment_glue_positive_and_benign():
+    assert detect_sqli_py(b"1/**/OR/**/1=1")
+    assert detect_sqli_py(b"'/**/OR/**/'a'='a")
+    # glob-style path text must NOT become a false positive
+    assert not detect_sqli_py(b"src/**/lib or docs/**/api")
+    assert not detect_sqli_py(b"black or white")
+
+
+def test_native_twin_agrees_on_comment_glue():
+    so = REPO / "native" / "confirm" / "libiptdetect.so"
+    if not so.exists():
+        pytest.skip("native twin not built")
+    lib = ctypes.CDLL(str(so))
+    lib.ipt_detect_sqli.restype = ctypes.c_int
+    lib.ipt_detect_sqli.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    for data, want in ((b"1/**/OR/**/1=1", 1),
+                       (b"'/**/OR/**/'a'='a", 1),
+                       (b"src/**/lib or docs/**/api", 0)):
+        assert lib.ipt_detect_sqli(data, len(data)) == want, data
+
+
+def test_encoded_raw_uri_escapes_detected(pipeline):
+    """The url-family escapes this PR fixed by adding t:urlDecodeUni
+    (944130 serialized-java magic, 913140 backup probe, 930160
+    dotfiles, 920440 extension policy)."""
+    cases = [("/files/r%4f0ABXQAB", 944130),
+             ("/index.php%2Ebak", 913140),
+             ("/.%67it/config", 930160),
+             ("/index.php%2Ebak", 920440)]
+    for uri, rid in cases:
+        req = Request(method="GET", uri=uri,
+                      headers={"host": "a"}, body=b"")
+        v = pipeline.detect_cpu_only([req])[0]
+        assert v.attack and rid in v.rule_ids, (uri, rid, v.rule_ids)
+
+
+def test_entity_encoded_header_markup_detected(pipeline):
+    """941250 (<script in headers) gained t:htmlEntityDecode."""
+    req = Request(method="GET", uri="/",
+                  headers={"host": "a",
+                           "referer": "&#x3c;script&#x3e;alert(1)"
+                                      "&#x3c;/script&#x3e;"},
+                  body=b"")
+    v = pipeline.detect_cpu_only([req])[0]
+    assert v.attack and 941250 in v.rule_ids
+
+
+# ------------------------------------------------ mutation harness twin
+
+
+def test_mutate_payload_deterministic():
+    a = mutate_payload("1 OR 1=1 -- x", "sqli", "query",
+                       ("comment", "url"), seed=11)
+    b = mutate_payload("1 OR 1=1 -- x", "sqli", "query",
+                       ("comment", "url"), seed=11)
+    c = mutate_payload("1 OR 1=1 -- x", "sqli", "query",
+                       ("comment", "url"), seed=12)
+    assert a == b
+    assert a != c  # seed must actually steer the mutation
+
+
+def test_mutate_payload_respects_family_gates():
+    # comment mutation is SQL-sink-only: an xss payload passes through
+    assert mutate_payload("<svg onload=alert(1)>", "xss", "query",
+                          ("comment",), seed=3) == "<svg onload=alert(1)>"
+    # header carrier never gets url-encoding (no backend decodes it)
+    assert mutate_payload("() { :; }; id", "rce", "header",
+                          ("url",), seed=3) == "() { :; }; id"
+
+
+def test_mutated_corpus_is_deterministic():
+    fams = ("case", "comment", "url", "split")
+    c1 = generate_corpus(n=80, attack_fraction=0.5, seed=9,
+                         payload_mutator=family_mutator(fams, seed=21))
+    c2 = generate_corpus(n=80, attack_fraction=0.5, seed=9,
+                         payload_mutator=family_mutator(fams, seed=21))
+    c3 = generate_corpus(n=80, attack_fraction=0.5, seed=9,
+                         payload_mutator=family_mutator(fams, seed=22))
+    d = request_digest([lr.request for lr in c1])
+    assert d == request_digest([lr.request for lr in c2])
+    assert d != request_digest([lr.request for lr in c3])
+
+
+def test_retention_score_math():
+    assert retention_score(0, 0) == 1.0  # nothing to lose
+    assert retention_score(100, 95) == 0.95
+    assert retention_score(4, 4) == 1.0
+
+
+def test_harness_holds_retention_floor_on_bundled_pack(pipeline):
+    res = mutation_harness(pipeline, n=400, attack_fraction=0.4)
+    assert res["corpus"]["base_detection_rate"] == 1.0
+    assert set(res["families"]) == set(MUTATION_FAMILIES)
+    for fam, st in res["families"].items():
+        assert st["retention"] >= 0.95, (fam, st["escapes"][:3])
+    assert res["min_retention"] >= 0.95
+
+
+# ------------------------------------------------- CLI / renderer pins
+
+
+def test_cli_evade_clean_with_baseline(capsys):
+    from ingress_plus_tpu.analysis.__main__ import main
+    assert main(["--evade"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("evadecheck:")
+
+
+def test_cli_evade_gates_without_baseline(capsys):
+    from ingress_plus_tpu.analysis.__main__ import main
+    assert main(["--evade", "--baseline", "none",
+                 "--fail-on", "warning"]) == 1
+
+
+def test_cli_evade_json_and_sarif_roundtrip(tmp_path, capsys):
+    from ingress_plus_tpu.analysis.__main__ import main
+    jout = tmp_path / "e.json"
+    assert main(["--evade", "--format", "json",
+                 "--output", str(jout)]) == 0
+    capsys.readouterr()
+    doc = json.loads(jout.read_text())
+    assert doc["tool"] == "evadecheck"
+    assert doc["meta"]["corroborated"] == 0
+
+    sout = tmp_path / "e.sarif"
+    assert main(["--evade", "--format", "sarif",
+                 "--output", str(sout)]) == 0
+    capsys.readouterr()
+    sarif = json.loads(sout.read_text())
+    driver = sarif["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "evadecheck"
+    # suppressed findings carry their baseline reason into SARIF
+    sup = [r for r in sarif["runs"][0]["results"]
+           if r.get("suppressions")]
+    assert sup and all(s["suppressions"][0]["justification"]
+                       for s in sup)
+
+
+def test_cli_operational_error_is_rc2(tmp_path, capsys):
+    from ingress_plus_tpu.analysis.__main__ import main
+    assert main(["--evade", "--rules",
+                 str(tmp_path / "missing")]) == 2
+    capsys.readouterr()
+
+
+def test_cli_conc_and_evade_mutually_exclusive(capsys):
+    from ingress_plus_tpu.analysis.__main__ import main
+    with pytest.raises(SystemExit):
+        main(["--conc", "--evade"])
+    capsys.readouterr()
+
+
+def test_dbg_evadecheck_renders(capsys):
+    from ingress_plus_tpu.control.dbg import main as dbg_main
+    assert dbg_main(["evadecheck"]) == 0
+    assert capsys.readouterr().out.startswith("evadecheck:")

@@ -90,6 +90,23 @@ def test_budget_zero_reduce_is_identity():
     assert rep.truncated == rep.fold_merged == rep.pair_merged == 0
 
 
+def test_pair_merge_vetoes_wire_literal_unions():
+    """Regression (retunegate): a profile-priced pair merge once produced
+    a union whose positionwise classes covered "user-agent", firing on
+    every request's header row while _seq_prob's independent-byte model
+    priced it as astronomically rare.  Unions covering ubiquitous wire
+    tokens must be vetoed, not priced."""
+    a, b = _lit("usem-agent"), _lit("user-agemt")
+    out, rep = reduce_rule_groups([[a], [b]], ReductionConfig(budget=1.0))
+    assert out == [[a], [b]]          # union would cover "user-agent"
+    assert rep.pair_merged == 0
+    # same shape with no wire token in the union still merges — the
+    # veto is targeted, not a blanket pair-merge disable
+    c, d = _lit("benchmark("), _lit("benchmqrk(")
+    _, rep2 = reduce_rule_groups([[c], [d]], ReductionConfig(budget=1.0))
+    assert rep2.pair_merged == 2
+
+
 # ------------------------------------------------------ prefix merging
 
 

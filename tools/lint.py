@@ -20,6 +20,15 @@ Four gates, one verdict:
              lock-order cycles, thread-lifecycle lint — zero
              unsuppressed error-severity findings required
              (reports/CONCHECK.json)
+  evasiongate the evasion-closure pair (docs/ANALYSIS.md "Evasion
+             analysis"): evadecheck — the static analyzer deciding per
+             rule whether detection is closed under the modeled evasion
+             families — must have zero unsuppressed findings at warning
+             or above (every accepted weakness carries a reason in
+             analysis/evadecheck-baseline.json), AND the utils/evasion.py
+             seeded mutation harness replaying the golden corpus through
+             detect_cpu_only must retain >= 95% detection in EVERY
+             mutation family (reports/EVASION.json)
   deadrules  the RUNTIME twin of rulecheck (docs/OBSERVABILITY.md,
              detection-plane telemetry): the bench corpus runs through
              a CPU pipeline and any runtime-dead rule (confirm regex
@@ -192,6 +201,75 @@ def run_concheck_gate(write_report: bool) -> dict:
         out = REPO / "reports" / "CONCHECK.json"
         out.parent.mkdir(parents=True, exist_ok=True)
         out.write_text(report.to_json())
+        result["report"] = str(out.relative_to(REPO))
+    return result
+
+
+#: per-family retention floor for the mutation harness (ISSUE 17): a
+#: rule-pack or normalizer change that lets any modeled evasion family
+#: strip >5% of detected attacks fails CI before it ships
+EVASION_RETENTION_FLOOR = 0.95
+
+
+def run_evasiongate(write_report: bool) -> dict:
+    """Evasion-closure gate (ISSUE 17, docs/ANALYSIS.md "Evasion
+    analysis"): the static evadecheck findings gate at WARNING (every
+    accepted weakness must carry a reasoned baseline entry), and the
+    seeded mutation harness must hold the per-family retention floor
+    on the bundled pack.  The harness escapes feed back into the
+    static report as corroboration, so a real runtime escape both
+    drops retention and escalates its static finding to error."""
+    t0 = time.time()
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+    from ingress_plus_tpu.analysis import run_evadecheck as ec
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.utils.evasion import mutation_harness
+
+    pipe = DetectionPipeline(compile_ruleset(load_bundled_rules()),
+                             mode="monitoring")
+    harness = mutation_harness(pipe)
+    escapes = [e for fam in harness["families"].values()
+               for e in fam["escapes"]]
+    report = ec(escapes=escapes)
+    gating = report.gating("warning")
+
+    weak = {fam: st["retention"]
+            for fam, st in harness["families"].items()
+            if st["retention"] < EVASION_RETENTION_FLOOR}
+    problems = ["%s %s (rule %s)" % (f.severity, f.check,
+                                     f.rule_id or f.subject)
+                for f in gating]
+    problems += ["family %s retention %.3f < %.2f"
+                 % (fam, r, EVASION_RETENTION_FLOOR)
+                 for fam, r in sorted(weak.items())]
+    result = {
+        "status": "OK" if not problems else "FAIL",
+        "seconds": round(time.time() - t0, 2),
+        "counts": report.counts(),
+        "suppressed": sum(report.counts(suppressed=True).values()),
+        "corroborated": (report.meta or {}).get("corroborated", 0),
+        "min_retention": harness["min_retention"],
+        "retention_floor": EVASION_RETENTION_FLOOR,
+        "detail": "; ".join(problems) or
+                  "%d findings all baselined, min retention %.3f over "
+                  "%d families (%d base-detected attacks)"
+                  % (len(report.findings), harness["min_retention"],
+                     len(harness["families"]),
+                     harness["corpus"]["base_detected"]),
+    }
+    if write_report:
+        out = REPO / "reports" / "EVASION.json"
+        out.parent.mkdir(parents=True, exist_ok=True)
+        payload = {
+            "static": json.loads(report.to_json()),
+            "harness": harness,
+            "retention_floor": EVASION_RETENTION_FLOOR,
+        }
+        out.write_text(json.dumps(payload, indent=2) + "\n")
         result["report"] = str(out.relative_to(REPO))
     return result
 
@@ -713,9 +791,9 @@ def main(argv=None) -> int:
                     help="CI mode: also write reports/RULECHECK.json")
     ap.add_argument("--only",
                     choices=["ruff", "mypy", "rulecheck", "concheck",
-                             "deadrules", "faultmatrix", "swapdrill",
-                             "modelgate", "devicegate", "promlint",
-                             "benchtrend", "retunegate"],
+                             "evasiongate", "deadrules", "faultmatrix",
+                             "swapdrill", "modelgate", "devicegate",
+                             "promlint", "benchtrend", "retunegate"],
                     default=None)
     args = ap.parse_args(argv)
 
@@ -728,6 +806,8 @@ def main(argv=None) -> int:
         gates["rulecheck"] = run_rulecheck(write_report=args.ci)
     if args.only in (None, "concheck"):
         gates["concheck"] = run_concheck_gate(write_report=args.ci)
+    if args.only in (None, "evasiongate"):
+        gates["evasiongate"] = run_evasiongate(write_report=args.ci)
     if args.only in (None, "deadrules"):
         gates["deadrules"] = run_dead_rules()
     if args.only in (None, "faultmatrix"):
