@@ -815,6 +815,286 @@ def run_tenant_iso(n_tenants: int = 100, phase_s: float = 6.0,
     return result
 
 
+#: rules for the --fleet-obs nodes: every node serves the sqli rule;
+#: the LAST node also loads the xss file, so its pack generation
+#: differs and the aggregator's cross-check must flag exactly it
+_FLEET_TINY_RULES = """
+SecRule REQUEST_URI|ARGS|REQUEST_BODY "@rx (?i)union\\s+select" \\
+    "id:942100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-sqli'"
+"""
+_FLEET_EXTRA_RULES = """
+SecRule REQUEST_URI|ARGS "@rx (?i)<script" \\
+    "id:941100,phase:2,block,t:urlDecodeUni,severity:CRITICAL,tag:'attack-xss'"
+"""
+
+
+def run_fleet_obs(n_nodes: int = 3, out_path: str | None = None) -> dict:
+    """FLEETOBS leg (ISSUE 18): the fleet telemetry plane measured over
+    REAL serve processes — ``n_nodes`` subprocess serve loops on the
+    UDS protocol, each exposing its own HTTP observability surface, and
+    a FleetObserver scraping/merging them from this process.  The one
+    JSON line proves, on live traffic:
+
+    * **conservation** — fleet ``ipt_requests_total`` equals the sum of
+      the per-node addends equals the requests this driver counted on
+      the wire, three times over: full fleet, a cycle with one node
+      faulted stale mid-run (``scrape_5xx`` site), and post-recovery;
+    * **merge determinism** — the traffic-weighted MeasuredProfile
+      merge reproduces the same content hash with the argument order
+      reversed;
+    * **skew** — the last node serves one extra rule file on purpose,
+      so the generation cross-check must flag it (and only it);
+    * **SLO burn** — two scrape cycles with traffic between them give
+      the burn engine real deltas; ``ipt_slo_*`` series must appear on
+      the aggregated exposition;
+    * **scrape overhead** — best-of-N A/B wall time of an identical
+      wave with and without a 0.2s-interval background scraper; the
+      budget is < 3% (being observed must cost ~nothing).
+
+    Writes reports/FLEETBENCH.json."""
+    import shutil
+    import socket as socket_mod
+    import subprocess
+    import tempfile
+
+    from ingress_plus_tpu.compiler.profile import MeasuredProfile
+    from ingress_plus_tpu.control.fleetobs import FleetObserver
+    from ingress_plus_tpu.serve.normalize import Request
+    from ingress_plus_tpu.serve.protocol import (
+        RESP_MAGIC, FrameReader, decode_response, encode_request)
+    from ingress_plus_tpu.utils import faults
+    from ingress_plus_tpu.utils.faults import FaultPlan
+
+    base_port = 19961
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="ipt-fleetbench-")
+    procs: list = []
+    socks: list = []
+    sent = [0] * n_nodes
+    rid_ctr = [5000]
+    saved_plan = faults.active()
+    faults.clear()
+    obs = FleetObserver()
+    try:
+        log("FLEETOBS: launching %d serve nodes..." % n_nodes)
+        for i in range(n_nodes):
+            rules_dir = os.path.join(tmp, "rules%d" % i)
+            os.makedirs(rules_dir)
+            with open(os.path.join(rules_dir, "tiny.conf"), "w") as f:
+                f.write(_FLEET_TINY_RULES)
+            if i == n_nodes - 1:
+                with open(os.path.join(rules_dir, "extra.conf"),
+                          "w") as f:
+                    f.write(_FLEET_EXTRA_RULES)
+            sock = os.path.join(tmp, "n%d.sock" % i)
+            env = dict(os.environ)
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "ingress_plus_tpu.serve",
+                 "--socket", sock, "--http-port", str(base_port + i),
+                 "--rules-dir", rules_dir, "--platform", "cpu",
+                 "--max-delay-us", "1000", "--no-warmup"],
+                cwd=repo, env=env))
+            socks.append(sock)
+            obs.add_node("n%d" % i,
+                         target="127.0.0.1:%d" % (base_port + i))
+        for i, sock in enumerate(socks):
+            for _ in range(600):
+                if os.path.exists(sock):
+                    try:
+                        s = socket_mod.socket(socket_mod.AF_UNIX)
+                        s.connect(sock)
+                        s.close()
+                        break
+                    except OSError:
+                        pass
+                if procs[i].poll() is not None:
+                    raise RuntimeError("fleet node %d died at startup"
+                                       % i)
+                time.sleep(0.1)
+            else:
+                raise RuntimeError("fleet node %d socket never appeared"
+                                   % i)
+
+        def wave(per_node: int = 32) -> float:
+            """One identical traffic wave to every node (mixed benign +
+            sqli); returns wall seconds and counts what was SENT — the
+            independent side of the conservation audit."""
+            t0 = time.perf_counter()
+            for i, sock in enumerate(socks):
+                reqs = []
+                for j in range(per_node):
+                    rid = rid_ctr[0]
+                    rid_ctr[0] += 1
+                    uri = ("/q?a=1+union+select+%d" % rid if j % 5 == 0
+                           else "/item/%d?q=benign" % rid)
+                    reqs.append((Request(uri=uri,
+                                         headers={"Host": "fleet.example"},
+                                         tenant=1 + j % 8,
+                                         request_id=str(rid)), rid))
+                s = socket_mod.socket(socket_mod.AF_UNIX)
+                s.connect(sock)
+                s.settimeout(120)
+                for req, rid in reqs:
+                    s.sendall(encode_request(req, req_id=rid))
+                reader, got = FrameReader(RESP_MAGIC), 0
+                while got < len(reqs):
+                    for fr in reader.feed(s.recv(65536)):
+                        decode_response(fr)
+                        got += 1
+                s.close()
+                sent[i] += per_node
+            return time.perf_counter() - t0
+
+        def conservation() -> dict:
+            fleet, per_node = obs.counters_snapshot()
+            addends = per_node.get("ipt_requests_total", {})
+            reachable_sent = sum(c for i, c in enumerate(sent)
+                                 if obs.nodes[i].up)
+            total = fleet.get("ipt_requests_total", -1.0)
+            return {
+                "sent_reachable": reachable_sent,
+                "fleet_total": total,
+                "per_node": {k: addends[k] for k in sorted(addends)},
+                "ok": (total == float(reachable_sent)
+                       and sum(addends.values())
+                       == float(reachable_sent)),
+            }
+
+        # --- leg 1: traffic, two scrape cycles (SLO deltas need two),
+        # full-fleet conservation, skew, profile-merge determinism
+        log("FLEETOBS: warm wave + scrape cycle 1...")
+        wave()
+        obs.scrape()
+        time.sleep(0.3)
+        log("FLEETOBS: wave + scrape cycle 2...")
+        wave()
+        health = obs.scrape()
+        cons_full = conservation()
+        gen_skew = [f for f in health["skew_findings"]
+                    if f["kind"] == "generation_skew"]
+        profs = [n.profile for n in obs.nodes if n.profile is not None]
+        merged = obs.merged_profile()
+        merge_hashes = []
+        if len(profs) == n_nodes:
+            merge_hashes = [
+                MeasuredProfile.merge(profs).content_hash(),
+                MeasuredProfile.merge(list(reversed(profs)))
+                .content_hash()]
+        fleet_text = obs.fleet_metrics()
+        slo = obs.fleet_slo()
+
+        # --- leg 2: one node faulted stale mid-run; conservation must
+        # hold over the reachable subset, then recover to the full sum
+        log("FLEETOBS: stale drill (scrape_5xx on the next cycle)...")
+        faults.install(FaultPlan.from_spec("scrape_5xx:times=1"))
+        wave()
+        stale_health = obs.scrape()
+        faults.clear()
+        cons_stale = conservation()
+        stale_names = [n.name for n in obs.nodes if n.stale]
+        wave()
+        obs.scrape()
+        cons_recovered = conservation()
+
+        # --- leg 3: A/B scrape overhead on an identical wave (nodes
+        # are warm by now; best-of keeps host noise out of the number)
+        log("FLEETOBS: A/B scrape-overhead wave (unscraped)...")
+        best_off = min(wave(per_node=48) for _ in range(3))
+        log("FLEETOBS: A/B scrape-overhead wave (scraped @0.2s)...")
+        obs.start_scraping(interval_s=0.2)
+        try:
+            best_on = min(wave(per_node=48) for _ in range(3))
+        finally:
+            obs.close()
+        overhead = best_on / best_off - 1.0
+
+        result = {
+            "metric": "fleet telemetry plane: counter conservation, "
+                      "merge determinism, skew + SLO burn over %d "
+                      "serve nodes" % n_nodes,
+            "platform": "cpu",
+            "n_nodes": n_nodes,
+            "fleet": {
+                "conservation_full": cons_full,
+                "conservation_one_stale": cons_stale,
+                "conservation_recovered": cons_recovered,
+                "stale_drill": {
+                    "nodes_up": stale_health["nodes_up"],
+                    "nodes_stale": stale_health["nodes_stale"],
+                    "stale_nodes": stale_names,
+                },
+                "skew_findings": health["skew_findings"],
+                "generation_skew_nodes": sorted(
+                    f["node"] for f in gen_skew),
+                "merged_profile": health["merged_profile"],
+                "merge_hashes": merge_hashes,
+                "merge_deterministic": (len(merge_hashes) == 2
+                                        and merge_hashes[0]
+                                        == merge_hashes[1]),
+                "slo": slo,
+                "slo_series_exposed": "ipt_slo_burn_rate" in fleet_text,
+                "scrape_overhead": {
+                    "best_unscraped_s": round(best_off, 4),
+                    "best_scraped_s": round(best_on, 4),
+                    "overhead_frac": round(overhead, 4),
+                    "budget_frac": 0.03,
+                    "ok": overhead < 0.03,
+                },
+            },
+        }
+        ok = (cons_full["ok"] and cons_stale["ok"]
+              and cons_recovered["ok"]
+              and stale_health["nodes_stale"] == 1
+              and result["fleet"]["merge_deterministic"]
+              and bool(gen_skew)
+              and result["fleet"]["slo_series_exposed"]
+              and overhead < 0.03)
+        result["fleet"]["ok"] = ok
+        if not ok:
+            log("=" * 64)
+            log("FLEETOBS WARNING: an acceptance leg failed — see the "
+                "fleet block (conservation %s/%s/%s, stale=%d, "
+                "merge_det=%s, gen_skew=%s, slo_series=%s, "
+                "overhead=%.4f)"
+                % (cons_full["ok"], cons_stale["ok"],
+                   cons_recovered["ok"], stale_health["nodes_stale"],
+                   result["fleet"]["merge_deterministic"],
+                   bool(gen_skew),
+                   result["fleet"]["slo_series_exposed"], overhead))
+            log("=" * 64)
+        else:
+            log("FLEETOBS: all legs ok (fleet total %s == sent %s; "
+                "merge hash %s; scrape overhead %.2f%%)"
+                % (cons_recovered["fleet_total"],
+                   cons_recovered["sent_reachable"],
+                   merge_hashes[0] if merge_hashes else "?",
+                   overhead * 100.0))
+        if out_path is None:
+            out_path = os.path.join(repo, "reports", "FLEETBENCH.json")
+        try:
+            os.makedirs(os.path.dirname(out_path), exist_ok=True)
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2)
+            log("FLEETBENCH written to %s" % out_path)
+        except OSError as e:
+            log("FLEETBENCH write failed (non-fatal): %r" % (e,))
+        return result
+    finally:
+        faults.clear()
+        if saved_plan is not None:
+            faults.install(saved_plan)
+        obs.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:    # noqa: BLE001 — teardown best-effort
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_bench(force_cpu_err: str | None = None) -> dict:
     """Measure and return the result dict.  ``force_cpu_err`` non-None
     means a prior attempt failed at dispatch time despite a good probe
@@ -2057,6 +2337,22 @@ def main() -> None:
         except BaseException as e:  # noqa: BLE001 — one JSON line always
             traceback.print_exc(file=sys.stderr)
             emit(_fallback_result("tenant-iso: %s: %s"
+                                  % (type(e).__name__, str(e)[:300])))
+        if _WATCHDOG_TIMER is not None:
+            _WATCHDOG_TIMER.cancel()
+        return
+    if "--fleet-obs" in sys.argv:
+        # standalone FLEETOBS mode (ISSUE 18): CPU-pinned, own
+        # watchdog, one JSON line = the fleet telemetry acceptance leg
+        _arm_watchdog()
+        from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices(1)
+        try:
+            emit(run_fleet_obs())
+        except BaseException as e:  # noqa: BLE001 — one JSON line always
+            traceback.print_exc(file=sys.stderr)
+            emit(_fallback_result("fleet-obs: %s: %s"
                                   % (type(e).__name__, str(e)[:300])))
         if _WATCHDOG_TIMER is not None:
             _WATCHDOG_TIMER.cancel()

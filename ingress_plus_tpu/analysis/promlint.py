@@ -2,7 +2,10 @@
 
 The /metrics exposition grew hand-rolled across 11 PRs; nothing ever
 checked it against the conventions scrapers and dashboards assume.
-This checker parses one text-format scrape and enforces:
+This checker decodes one text-format scrape (via the shared
+``utils/promparse`` parser — the same decode path the fleet
+aggregator merges through, so what the lint accepts is exactly what
+the fleet plane can aggregate) and enforces:
 
 * **naming** — every metric carries the ``ipt_`` namespace prefix;
   counters end in ``_total`` (or ``_sum``/``_count`` — the cumulative
@@ -17,6 +20,13 @@ This checker parses one text-format scrape and enforces:
   budget is 30 + the "other" fold, so a per-rule or per-tenant series
   slipping into the exposition unfolded fails on its FIRST scrape, not
   after a dashboard dies;
+* **aggregation safety** (ISSUE 18) — counters and gauges must be
+  summable across instances: a node-unique label (``instance``,
+  ``host``, ``pid``, ...) on a per-node exposition makes the fleet
+  sum double-count identity instead of traffic.  ``fleet=True``
+  relaxes the check for the labels the aggregator itself adds
+  deliberately (``node=`` per-node detail, ``agg=`` rollups — bounded
+  by fleet size, which the cardinality cap still polices);
 * **histogram shape** — ``_bucket`` series carry ``le``, include
   ``+Inf``, and the cumulative counts are monotonic;
 * **values parse** — every sample value is a float (NaN allowed: the
@@ -24,108 +34,72 @@ This checker parses one text-format scrape and enforces:
 
 ``check_exposition`` returns finding strings (empty = clean); the
 ``promlint`` gate in tools/lint.py scrapes an in-process ServeLoop
-after real traffic so the tenant/family folds are actually exercised.
+after real traffic so the tenant/family folds are actually exercised,
+and the ``fleetgate`` gate runs the same check (``fleet=True``) over
+the aggregated ``/fleet/metrics`` exposition.
 """
 
 from __future__ import annotations
 
 import math
-import re
 from typing import Dict, List, Set, Tuple
+
+from ingress_plus_tpu.utils.promparse import (
+    base_name, group_key, parse_exposition)
 
 #: bounded_counter_series caps at 30 verbatim + "other"; lanes and
 #: stages are small closed sets.  Anything past this is an unbounded
 #: label escaping the budget.
 DEFAULT_SERIES_CAP = 40
 
-_SERIES_RE = re.compile(
-    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)\s*$")
-_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
-_META_RE = re.compile(
-    r"^# (?P<kind>TYPE|HELP) (?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\s+(?P<rest>.*))?$")
-
-#: suffixes that resolve a series back to its declared metric family
-_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
-
 #: counter naming: _total is the convention; _sum/_count are accepted
 #: for cumulative histogram-component counters (documented above)
 _COUNTER_SUFFIXES = ("_total", "_sum", "_count")
 
+#: labels that identify the emitting node rather than the traffic —
+#: a counter/gauge split on one cannot be summed across the fleet
+NODE_IDENTITY_LABELS = ("instance", "node", "host", "hostname",
+                       "pod", "pid")
 
-def _base_name(name: str, types: Dict[str, str]) -> str:
-    """Resolve a series name to the declared metric it samples
-    (histogram/summary components strip their suffix)."""
-    if name in types:
-        return name
-    for suf in _HIST_SUFFIXES:
-        if name.endswith(suf) and name[: -len(suf)] in types:
-            return name[: -len(suf)]
-    return name
+#: labels the fleet aggregator adds on purpose (per-node detail +
+#: rollup axis); only legitimate on the AGGREGATED exposition
+_FLEET_LABELS = ("node", "agg")
 
 
 def check_exposition(text: str,
                      prefix: str = "ipt_",
-                     series_cap: int = DEFAULT_SERIES_CAP) -> List[str]:
-    findings: List[str] = []
-    types: Dict[str, str] = {}
-    helps: Set[str] = set()
+                     series_cap: int = DEFAULT_SERIES_CAP,
+                     fleet: bool = False) -> List[str]:
+    exp = parse_exposition(text)
+    findings: List[str] = list(exp.errors)
+    types = exp.types
+    helps = set(exp.helps)
     #: (metric, label) -> distinct values
     label_values: Dict[Tuple[str, str], Set[str]] = {}
     #: histogram buckets: (metric, non-le labelset) -> [(le, value)]
     buckets: Dict[Tuple[str, str], List[Tuple[float, float]]] = {}
     seen_series: Set[str] = set()
 
-    for lineno, raw in enumerate(text.splitlines(), 1):
-        line = raw.rstrip()
-        if not line:
-            continue
-        if line.startswith("#"):
-            m = _META_RE.match(line)
-            if m is None:
-                findings.append("line %d: malformed comment %r"
-                                % (lineno, line[:60]))
-                continue
-            if m.group("kind") == "TYPE":
-                types[m.group("name")] = (m.group("rest") or "").strip()
-            else:
-                helps.add(m.group("name"))
-            continue
-        m = _SERIES_RE.match(line)
-        if m is None:
-            findings.append("line %d: unparsable series line %r"
-                            % (lineno, line[:60]))
-            continue
-        name = m.group("name")
-        seen_series.add(name)
-        try:
-            val = float(m.group("value"))
-        except ValueError:
-            findings.append("line %d: %s value %r is not a float"
-                            % (lineno, name, m.group("value")))
-            continue
-        labels = dict(_LABEL_RE.findall(m.group("labels") or ""))
-        base = _base_name(name, types)
-        for k, v in labels.items():
+    for s in exp.samples:
+        seen_series.add(s.name)
+        base = base_name(s.name, types)
+        for k, v in s.labels.items():
             if k == "le":
                 continue
             label_values.setdefault((base, k), set()).add(v)
-        if name.endswith("_bucket"):
-            le = labels.get("le")
+        if s.name.endswith("_bucket"):
+            le = s.labels.get("le")
             if le is None:
                 findings.append("line %d: %s has no le label"
-                                % (lineno, name))
+                                % (s.lineno, s.name))
             else:
-                key = (base, ",".join(
-                    "%s=%s" % kv for kv in sorted(labels.items())
-                    if kv[0] != "le"))
+                key = (base, group_key(s.labels))
                 lev = math.inf if le == "+Inf" else float(le)
-                buckets.setdefault(key, []).append((lev, val))
+                buckets.setdefault(key, []).append((lev, s.value))
 
     # naming + metadata per declared or sampled metric family
     for name in sorted(seen_series):
-        base = _base_name(name, types)
+        base = base_name(name, types)
         if not name.startswith(prefix):
             findings.append("%s: missing the %s namespace prefix"
                             % (name, prefix))
@@ -148,6 +122,23 @@ def check_exposition(text: str,
                 "%s{%s=}: %d distinct label values (cap %d) — an "
                 "unbounded series escaped the bounded_counter_series "
                 "fold" % (base, label, len(values), series_cap))
+
+    # aggregation safety (ISSUE 18): counters/gauges keyed by node
+    # identity cannot be summed across the fleet — the merge would
+    # count nodes, not traffic.  Histograms are exempt (their le axis
+    # merges bucket-wise); the aggregator's own node=/agg= labels are
+    # legitimate only on the aggregated exposition (fleet=True).
+    for (base, label), _values in sorted(label_values.items()):
+        if label not in NODE_IDENTITY_LABELS:
+            continue
+        if fleet and label in _FLEET_LABELS:
+            continue
+        if types.get(base) == "histogram":
+            continue
+        findings.append(
+            "%s{%s=}: node-identity label breaks cross-instance "
+            "aggregation (counters/gauges must be summable across "
+            "the fleet)" % (base, label))
 
     # histogram shape: +Inf present, cumulative counts monotonic
     for (base, labelset), pts in sorted(buckets.items()):

@@ -41,9 +41,23 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["MeasuredProfile", "PROFILE_VERSION"]
+__all__ = ["MeasuredProfile", "ProfileVersionError", "PROFILE_VERSION"]
 
 PROFILE_VERSION = 1
+
+
+class ProfileVersionError(ValueError):
+    """Structured merge rejection: profiles from different schema
+    versions measure different things, so a cross-version merge is a
+    hard error (never a silent best-effort).  Carries the conflicting
+    version set so the fleet plane can report which node is behind."""
+
+    def __init__(self, versions):
+        self.versions = tuple(sorted(set(int(v) for v in versions)))
+        super().__init__(
+            "cannot merge MeasuredProfiles across schema versions %s "
+            "(this compiler speaks v%d)"
+            % (list(self.versions), PROFILE_VERSION))
 
 #: blend weight of the observed byte distribution against the static
 #: prior when building the pricing vector: the prior keeps every byte's
@@ -116,6 +130,104 @@ class MeasuredProfile:
                 if h.sum() > 0 else [])
         return cls(source=source, requests=len(rows),
                    rules=dict(rules or {}), byte_freq=freq)
+
+    @classmethod
+    def merge(cls, profiles, weights=None) -> "MeasuredProfile":
+        """Traffic-weighted merge of per-node profiles into one fleet
+        profile (the artifact ROADMAP item 4's continuous-retune daemon
+        consumes).
+
+        Weights default to each profile's ``requests`` field — the
+        per-generation traffic weight exported in the canonical bytes —
+        so a node that served 10x the traffic moves the merged rates
+        10x as much.  Semantics per field:
+
+        * per-request rates (``candidate_rate``, ``confirmed_rate``)
+          average over ALL weight (a rule absent from a node's profile
+          contributed zero candidates on that node's traffic);
+        * ``confirm_us_per_candidate`` is a per-*candidate* quantity,
+          so it averages weighted by each node's candidate volume
+          (weight x candidate_rate);
+        * ``qr_skip_rate`` averages over the nodes that observed the
+          rule at all;
+        * ``byte_freq`` is the weighted average distribution,
+          renormalized; ``requests`` sum.
+
+        Determinism contract: inputs are canonicalized by sorting on
+        content hash before any float accumulates, and the merged
+        fields round exactly like ``from_rule_stats`` — the same input
+        set produces the same canonical bytes and the same
+        ``content_hash`` regardless of argument order (fleetgate
+        asserts it).  Mixed ``version`` values raise
+        :class:`ProfileVersionError`."""
+        profiles = list(profiles)
+        if not profiles:
+            raise ValueError("merge() of zero profiles")
+        if len({p.version for p in profiles}) > 1:
+            raise ProfileVersionError([p.version for p in profiles])
+        if weights is None:
+            weights = [float(max(p.requests, 0)) for p in profiles]
+        else:
+            weights = [float(w) for w in weights]
+            if len(weights) != len(profiles):
+                raise ValueError("merge(): %d weights for %d profiles"
+                                 % (len(weights), len(profiles)))
+            if any(w < 0 for w in weights):
+                raise ValueError("merge(): negative weight")
+        # canonical accumulation order: float sums must not depend on
+        # the caller's argument order
+        order = sorted(range(len(profiles)),
+                       key=lambda i: (profiles[i].content_hash(), i))
+        profiles = [profiles[i] for i in order]
+        weights = [weights[i] for i in order]
+        wsum = sum(weights)
+        if wsum <= 0:                 # all-idle fleet: unweighted mean
+            weights = [1.0] * len(profiles)
+            wsum = float(len(profiles))
+
+        rules: Dict[int, Dict[str, float]] = {}
+        for rid in sorted({r for p in profiles for r in p.rules}):
+            cand = conf = 0.0
+            cost_num = cost_den = 0.0
+            qr_num = qr_den = 0.0
+            for p, w in zip(profiles, weights):
+                rec = p.rules.get(rid)
+                if rec is None:
+                    continue          # zero candidates on that node
+                cr = float(rec.get("candidate_rate", 0.0))
+                cand += w * cr
+                conf += w * float(rec.get("confirmed_rate", 0.0))
+                cost_num += w * cr * float(
+                    rec.get("confirm_us_per_candidate", 0.0))
+                cost_den += w * cr
+                qr_num += w * float(rec.get("qr_skip_rate", 0.0))
+                qr_den += w
+            rules[rid] = {
+                "candidate_rate": round(cand / wsum, 6),
+                "confirmed_rate": round(conf / wsum, 6),
+                "confirm_us_per_candidate":
+                    round(cost_num / cost_den, 3) if cost_den > 0
+                    else 0.0,
+                "qr_skip_rate":
+                    round(qr_num / qr_den, 4) if qr_den > 0 else 0.0,
+            }
+
+        acc = np.zeros(256, dtype=np.float64)
+        freq_w = 0.0
+        for p, w in zip(profiles, weights):
+            if len(p.byte_freq) == 256 and w > 0:
+                acc += w * np.asarray(p.byte_freq, dtype=np.float64)
+                freq_w += w
+        freq: List[float] = []
+        if freq_w > 0 and acc.sum() > 0:
+            freq = [round(float(x), 9) for x in (acc / acc.sum())]
+
+        src = "+".join(sorted({p.source for p in profiles if p.source}))
+        if not src or len(src) > 96:
+            src = "merge-of-%d" % len(profiles)
+        return cls(version=profiles[0].version, source=src,
+                   requests=sum(int(p.requests) for p in profiles),
+                   rules=rules, byte_freq=freq)
 
     # -------------------------------------------------------- serialize
 

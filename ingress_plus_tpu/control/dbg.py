@@ -20,6 +20,14 @@ state.  Same idea against our HTTP plane:
     python -m ingress_plus_tpu.control.dbg scoring  [--swap head.npz] [--force]
     python -m ingress_plus_tpu.control.dbg breaker  [--server host:port]
     python -m ingress_plus_tpu.control.dbg faults   [--set 'site:times=1']
+    python -m ingress_plus_tpu.control.dbg fleet    [--server host:port]
+
+``fleet`` renders the fleet telemetry plane (docs/OBSERVABILITY.md
+"Fleet telemetry") from the aggregator's ``/fleet/healthz`` +
+``/fleet/slo``: the node table (up/stale, pack generation, requests,
+p99, confirm share), skew findings, the merged-profile hash, and the
+SLO burn-rate table.  ``--server`` points at the aggregator
+(``control/fleetobs.py``, default port 9911), not a serve node.
 
 ``rules`` renders the detection-plane telemetry (ISSUE 3): top rules by
 prefilter candidates with confirm outcomes and false-candidate rates
@@ -510,6 +518,69 @@ def render_drift(drift: dict, top: int = 20) -> str:
     return "\n".join(lines)
 
 
+def render_fleet(health: dict, slo: dict) -> str:
+    """Terminal tables for `dbg fleet` (ISSUE 18): the node table,
+    skew findings, and the SLO burn-rate table from the aggregator's
+    /fleet/healthz + /fleet/slo."""
+    lines = ["fleet: %s  (%d up, %d stale, %d scrape cycles)"
+             % (health.get("status", "?"), health.get("nodes_up", 0),
+                health.get("nodes_stale", 0),
+                health.get("scrape_cycles", 0)), ""]
+    lines.append("%-10s %-5s %-5s %-22s %10s %10s %8s %8s"
+                 % ("node", "up", "stale", "generation", "requests",
+                    "p99_us", "cf_share", "scr_ms"))
+    for n in health.get("nodes", []):
+        lines.append(
+            "%-10s %-5s %-5s %-22s %10s %10s %8s %8s"
+            % (n.get("name", "?"),
+               "yes" if n.get("up") else "NO",
+               "yes" if n.get("stale") else "-",
+               (n.get("generation") or "-")[:22],
+               ("%d" % n["requests_total"])
+               if n.get("requests_total") is not None else "-",
+               ("%.1f" % n["p99_e2e_us"])
+               if n.get("p99_e2e_us") is not None else "-",
+               ("%.2f" % n["confirm_share"])
+               if n.get("confirm_share") is not None else "-",
+               n.get("scrape_ms", "-")))
+        if n.get("error"):
+            lines.append("           error: %s" % n["error"])
+    findings = health.get("skew_findings", [])
+    lines.append("")
+    if findings:
+        lines.append("skew findings (%d):" % len(findings))
+        for f in findings:
+            lines.append("  [%s] %s: %s"
+                         % (f.get("kind", "?"), f.get("node", "?"),
+                            f.get("detail", "")))
+    else:
+        lines.append("skew findings: none")
+    prof = health.get("merged_profile") or {}
+    if "content_hash" in prof:
+        lines.append("merged profile: %s (%s requests, %s rules)"
+                     % (prof["content_hash"], prof.get("requests"),
+                        prof.get("rules")))
+    else:
+        lines.append("merged profile: %s"
+                     % (prof.get("error") or "unavailable"))
+    lines.append("")
+    lines.append("%-16s %-6s %-10s %10s %12s %12s"
+                 % ("slo", "window", "verdict", "objective",
+                    "burn", "error_rate"))
+    for name, rec in sorted((slo.get("slos") or {}).items()):
+        for wname, w in sorted(rec.get("windows", {}).items()):
+            lines.append(
+                "%-16s %-6s %-10s %10s %12s %12s"
+                % (name, wname, rec.get("verdict", "?"),
+                   rec.get("objective", "-"),
+                   "-" if w.get("burn") is None else w["burn"],
+                   "-" if w.get("error_rate") is None
+                   else w["error_rate"]))
+    lines.append("")
+    lines.append("fleet SLO verdict: %s" % slo.get("verdict", "?"))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ingress_plus_tpu.control.dbg")
     ap.add_argument("cmd",
@@ -517,7 +588,7 @@ def main(argv=None) -> int:
                              "tenants", "ruleset", "acl", "rulecheck",
                              "concheck", "evadecheck", "rules", "drift",
                              "breaker", "faults", "rollout", "scoring",
-                             "timeline"])
+                             "timeline", "fleet"])
     ap.add_argument("--cycles", type=int, default=6,
                     help="timeline: how many recent cycles to render "
                          "(the Gantt view of /debug/trace)")
@@ -596,6 +667,15 @@ def main(argv=None) -> int:
             else:
                 out = render_faults(json.loads(_call(args.server,
                                                      "/faults")))
+        elif args.cmd == "fleet":
+            # --server here is the AGGREGATOR (control/fleetobs.py),
+            # default port 9911, not a serve node
+            srv = args.server
+            if srv == "127.0.0.1:9901":
+                srv = "127.0.0.1:9911"
+            out = render_fleet(
+                json.loads(_call(srv, "/fleet/healthz")),
+                json.loads(_call(srv, "/fleet/slo")))
         elif args.cmd == "timeline":
             trace = json.loads(_call(
                 args.server, "/debug/trace?cycles=%d"

@@ -26,7 +26,7 @@ import signal
 import sys
 import time
 from pathlib import Path
-from typing import Optional
+from typing import Optional, Tuple
 
 from ingress_plus_tpu.models.pipeline import Verdict
 from ingress_plus_tpu.serve.batcher import Batcher
@@ -735,6 +735,15 @@ class ServeLoop:
                 % self.post.exporter.spool_dropped_bytes,
             ]
         return "\n".join(_with_help(lines)) + "\n"
+
+    def http_get(self, path: str) -> Tuple[str, str, bytes]:
+        """Synchronous in-process GET against the observability plane:
+        (status, content-type, body) exactly as :meth:`_route_http`
+        would serve it over TCP.  The fleet aggregator's in-process
+        transport (fleetgate, tests) scrapes through this instead of
+        binding N real HTTP ports; runs the route on a private event
+        loop, so call it from any thread EXCEPT the serve loop's own."""
+        return asyncio.run(self._route_http("GET", path, b""))
 
     def _scrape_sidecar(self) -> Optional[dict]:
         """One-shot scrape of the sidecar's --status-port JSON (runs in

@@ -38,6 +38,14 @@ site                      injected where / what it does when it fires
 ``export_5xx``            the post exporter's HTTP delivery raises (collector
                           returning 5xx) — exercises exponential backoff +
                           spool bounding
+``scrape_timeout``        the fleet scraper's node fetch times out
+                          (control/fleetobs.py) — the node must be marked
+                          stale, excluded from gauge rollups, with counter
+                          conservation holding over the reachable subset
+``scrape_5xx``            the fleet scraper's node fetch fails hard (node
+                          returning 5xx / connection refused) — same stale
+                          contract as ``scrape_timeout``, distinct site so
+                          plans can stage the two failure shapes separately
 ``slow_confirm``          pipeline confirm stage sleeps ``delay_s`` per batch
                           (pathological regex / CPU contention) — exercises
                           deadline shedding and the brownout ladder.  Fires
@@ -80,7 +88,8 @@ from typing import Dict, List, Optional
 #: a typo'd site would otherwise silently never fire)
 SITES = ("dispatch_hang", "dispatch_raise", "recompile_storm",
          "swap_fail", "export_5xx", "slow_confirm",
-         "shadow_diverge", "lkg_corrupt")
+         "shadow_diverge", "lkg_corrupt",
+         "scrape_timeout", "scrape_5xx")
 
 
 class FaultError(RuntimeError):
@@ -1132,6 +1141,91 @@ def _scenario_tenant_flood_canary(install_plan) -> dict:
         b.close()
 
 
+def _scenario_fleet_scrape(install_plan) -> dict:
+    """A fleet node dying mid-scrape (ISSUE 18): the observer marks it
+    stale, excludes it from every rollup, and counter conservation
+    holds over the reachable subset — while the node itself keeps
+    serving verdicts (a scrape-plane failure must never become a
+    serve-plane failure)."""
+    from ingress_plus_tpu.control.fleetobs import (
+        FleetObserver, serve_loop_transport)
+    from ingress_plus_tpu.serve.server import ServeLoop
+
+    cr = _matrix_ruleset()
+    batchers = [_mk_batcher(cr) for _ in range(3)]
+    violations: List[str] = []
+    try:
+        serves = [ServeLoop(b, socket_path="/tmp/ipt-fleet-%d.sock" % i)
+                  for i, b in enumerate(batchers)]
+        obs = FleetObserver()
+        for i, s in enumerate(serves):
+            obs.add_node("n%d" % i, transport=serve_loop_transport(s))
+
+        def _wave(tag: str, per_node: int = 16) -> int:
+            futs = []
+            for i, b in enumerate(batchers):
+                futs += [b.submit(r) for r in _requests(
+                    per_node, attack_every=8, tag="%s-n%d-" % (tag, i))]
+            vs, viol = _collect(futs, timeout_s=30)
+            _check_verdicts(vs, viol, len(futs))
+            violations.extend(viol)
+            return len(futs)
+
+        sent = _wave("f0")
+        obs.scrape()
+        counters, per_node = obs.counters_snapshot()
+        if counters.get("ipt_requests_total") != float(sent):
+            violations.append(
+                "conservation broke on the full fleet: fleet=%s, "
+                "submitted=%d" % (counters.get("ipt_requests_total"),
+                                  sent))
+        # node 0 dies at the NEXT scrape (first arrival at the site)
+        install_plan(FaultPlan.from_spec("scrape_5xx:times=1"))
+        sent += _wave("f1")
+        health = obs.scrape()
+        if health["nodes_up"] != 2 or health["nodes_stale"] != 1:
+            violations.append("expected 2 up + 1 stale, got %d up + "
+                              "%d stale" % (health["nodes_up"],
+                                            health["nodes_stale"]))
+        if not any(n["stale"] for n in health["nodes"]
+                   if n["name"] == "n0"):
+            violations.append("faulted node n0 was not marked stale")
+        counters, per_node = obs.counters_snapshot()
+        reachable_sum = sum(v for k, v in per_node.get(
+            "ipt_requests_total", {}).items() if k != "n0")
+        if counters.get("ipt_requests_total") != reachable_sum:
+            violations.append(
+                "conservation broke over the reachable subset: "
+                "fleet=%s, sum(up nodes)=%s"
+                % (counters.get("ipt_requests_total"), reachable_sum))
+        if "n0" in per_node.get("ipt_requests_total", {}):
+            violations.append("stale node n0 leaked into the rollup")
+        text = obs.fleet_metrics()
+        if "ipt_fleet_nodes_stale 1" not in text:
+            violations.append("ipt_fleet_nodes_stale gauge did not "
+                              "report the stale node")
+        # plan exhausted (times=1): the node must recover on the next
+        # cycle and conservation widen back to the full fleet
+        sent += _wave("f2")
+        health = obs.scrape()
+        if health["nodes_up"] != 3 or health["nodes_stale"] != 0:
+            violations.append("node n0 never recovered (%d up, %d "
+                              "stale)" % (health["nodes_up"],
+                                          health["nodes_stale"]))
+        counters, _pn = obs.counters_snapshot()
+        if counters.get("ipt_requests_total") != float(sent):
+            violations.append(
+                "conservation broke after recovery: fleet=%s, "
+                "submitted=%d" % (counters.get("ipt_requests_total"),
+                                  sent))
+        return {"ok": not violations, "violations": violations,
+                "requests": sent,
+                "scrape_errors": obs.scrape_errors}
+    finally:
+        for b in batchers:
+            b.close()
+
+
 SCENARIOS = {
     "overload_burst": _scenario_overload,
     "dispatch_hang": _scenario_dispatch_hang,
@@ -1148,6 +1242,7 @@ SCENARIOS = {
     "lane_dispatch_raise": _scenario_lane_dispatch_raise,
     "tenant_flood": _scenario_tenant_flood,
     "tenant_flood_during_canary": _scenario_tenant_flood_canary,
+    "fleet_scrape": _scenario_fleet_scrape,
 }
 
 

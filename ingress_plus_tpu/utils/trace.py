@@ -345,6 +345,61 @@ class Histogram:
             return 0.0
         return _percentile_from_buckets(self.bounds, counts, p)
 
+    @classmethod
+    def from_cumulative(cls, bounds: Sequence[int],
+                        cumulative: Sequence[float],
+                        sum_us: float = 0) -> "Histogram":
+        """Rebuild a histogram from Prometheus *cumulative* bucket
+        counts — the decode direction of :meth:`prometheus`, used by
+        the fleet aggregator to reconstruct per-node histograms from
+        scraped ``_bucket`` lines.  ``cumulative`` must include the
+        ``+Inf`` bucket last; non-monotonic counts raise (a scrape
+        that fails its own shape invariant is skew, not data)."""
+        bounds = tuple(int(b) for b in bounds)
+        if len(cumulative) != len(bounds) + 1:
+            raise ValueError(
+                "cumulative bucket count %d does not match %d bounds "
+                "+ Inf" % (len(cumulative), len(bounds)))
+        h = cls(bounds)
+        prev = 0
+        counts: List[int] = []
+        for c in cumulative:
+            ci = int(c)
+            if ci < prev:
+                raise ValueError("non-monotonic cumulative bucket "
+                                 "counts")
+            counts.append(ci - prev)
+            prev = ci
+        h.counts = counts
+        h.total = prev
+        h.sum_us = int(sum_us)
+        return h
+
+    @classmethod
+    def merge(cls, hists: Sequence["Histogram"]) -> "Histogram":
+        """Bucket-wise sum of histograms sharing identical bounds — the
+        fleet aggregation primitive (per-node latency distributions
+        merge losslessly because every node uses the same fixed log2
+        buckets).  A bounds mismatch raises ValueError; the caller
+        (fleetobs) turns that into a skew finding instead of merging
+        incomparable distributions."""
+        items = list(hists)
+        if not items:
+            return cls()
+        bounds = tuple(items[0].bounds)
+        out = cls(bounds)
+        for h in items:
+            if tuple(h.bounds) != bounds:
+                raise ValueError(
+                    "histogram bucket bounds mismatch: %d bounds vs %d"
+                    % (len(bounds), len(h.bounds)))
+            counts, total, sum_us = h.snapshot()
+            for i, c in enumerate(counts):
+                out.counts[i] += c
+            out.total += total
+            out.sum_us += sum_us
+        return out
+
     def prometheus(self, name: str, labels: Optional[Dict[str, str]] = None
                    ) -> List[str]:
         """Series lines (no # TYPE header — the caller groups same-name

@@ -77,6 +77,15 @@ Four gates, one verdict:
              golden replay, and the retuned pack's measured candidate
              load must not exceed the static pack's
              (reports/RETUNE.json)
+  fleetgate  the fleet telemetry plane (ISSUE 18,
+             docs/OBSERVABILITY.md "Fleet telemetry"): three
+             in-process serve loops under replayed corpus traffic,
+             one aggregator — counter conservation (fleet == Σ
+             per-node == counted traffic, including with one node
+             faulted stale mid-run via the scrape_5xx site),
+             MeasuredProfile.merge content-hash reproducibility, and
+             a promlint-clean aggregated /fleet/metrics exposition
+             (reports/FLEETOBS.json)
   benchtrend the checked-in BENCH_r*.json req/s/chip trajectory
              (tools/bench_trend.py): >10% regression vs the previous
              snapshot fails; SKIPPED with fewer than two artifacts
@@ -110,8 +119,11 @@ MYPY_SCOPE = ["ingress_plus_tpu/compiler", "ingress_plus_tpu/analysis",
               "ingress_plus_tpu/models",  # pipeline + tenant_guard callers
               "ingress_plus_tpu/post/topk.py",
               "ingress_plus_tpu/control/rollout.py",
+              "ingress_plus_tpu/control/fleetobs.py",
               "ingress_plus_tpu/parallel/serve_mesh.py",
-              "ingress_plus_tpu/learn"]
+              "ingress_plus_tpu/learn",
+              "ingress_plus_tpu/utils/promparse.py",
+              "ingress_plus_tpu/utils/slo.py"]
 
 
 def _tool_available(module: str, binary: str) -> bool:
@@ -669,6 +681,156 @@ def run_promlint() -> dict:
     }
 
 
+def run_fleetgate(write_report: bool) -> dict:
+    """Fleet telemetry gate (ISSUE 18, control/fleetobs.py): three
+    IN-PROCESS serve loops, replayed corpus traffic, one aggregator.
+    Asserts the fleet plane's three contracts: (1) counter
+    conservation — the aggregated ipt_requests_total equals the sum of
+    per-node counters equals the independently counted traffic, and
+    keeps holding over the reachable subset when a node is faulted
+    stale mid-run (scrape_5xx site); (2) merge determinism —
+    MeasuredProfile.merge over the scraped per-node profiles
+    reproduces the same content hash twice, argument order shuffled;
+    (3) the aggregated /fleet/metrics exposition passes promlint in
+    fleet mode.  Writes reports/FLEETOBS.json."""
+    t0 = time.time()
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+    from ingress_plus_tpu.analysis.promlint import check_exposition
+    from ingress_plus_tpu.compiler.profile import MeasuredProfile
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.sigpack import load_bundled_rules
+    from ingress_plus_tpu.control.fleetobs import (
+        FleetObserver,
+        serve_loop_transport,
+    )
+    from ingress_plus_tpu.models.pipeline import DetectionPipeline
+    from ingress_plus_tpu.serve.batcher import Batcher
+    from ingress_plus_tpu.serve.server import ServeLoop
+    from ingress_plus_tpu.utils import faults
+    from ingress_plus_tpu.utils.corpus import generate_corpus
+
+    n_nodes = 3
+    checks: dict = {}
+    failures: list = []
+    cr = compile_ruleset(load_bundled_rules())
+    batchers = [Batcher(DetectionPipeline(cr, mode="monitoring"),
+                        max_batch=16) for _ in range(n_nodes)]
+    saved_plan = faults.active()
+    try:
+        serves = [ServeLoop(b, socket_path="/tmp/ipt-fleetgate-%d.sock"
+                            % i) for i, b in enumerate(batchers)]
+        obs = FleetObserver()
+        for i, s in enumerate(serves):
+            obs.add_node("n%d" % i, transport=serve_loop_transport(s))
+
+        def wave(seed: int, per_node: int = 32) -> int:
+            futs = []
+            for i, b in enumerate(batchers):
+                reqs = [lr.request for lr in generate_corpus(
+                    n=per_node, attack_fraction=0.25,
+                    seed=seed * 10 + i)]
+                for j, r in enumerate(reqs):
+                    r.tenant = j % 8
+                futs += [b.submit(r) for r in reqs]
+            for f in futs:
+                f.result(timeout=120)
+            return len(futs)
+
+        # leg 1: full fleet conservation
+        sent = wave(1)
+        obs.scrape()
+        counters, per_node = obs.counters_snapshot()
+        fleet_req = counters.get("ipt_requests_total")
+        node_sum = sum(per_node.get("ipt_requests_total", {}).values())
+        checks["conservation_full"] = {
+            "submitted": sent, "fleet": fleet_req, "node_sum": node_sum,
+            "ok": fleet_req == node_sum == float(sent)}
+        if not checks["conservation_full"]["ok"]:
+            failures.append("conservation (full fleet): fleet=%s "
+                            "node_sum=%s submitted=%d"
+                            % (fleet_req, node_sum, sent))
+
+        # leg 2: aggregated exposition is promlint-clean (fleet mode)
+        findings = check_exposition(obs.fleet_metrics(), fleet=True)
+        checks["promlint_fleet"] = {"findings": findings[:10],
+                                    "ok": not findings}
+        if findings:
+            failures.append("aggregate exposition: %s"
+                            % "; ".join(findings[:5]))
+
+        # leg 3: merge determinism (same inputs, shuffled order,
+        # twice -> same canonical bytes, same content hash)
+        profs = [n.profile for n in obs.nodes if n.profile is not None]
+        h1 = MeasuredProfile.merge(profs).content_hash()
+        h2 = MeasuredProfile.merge(list(reversed(profs))).content_hash()
+        checks["merge_determinism"] = {
+            "hash_1": h1, "hash_2": h2,
+            "profiles": len(profs), "ok": h1 == h2 and len(profs) == 3}
+        if not checks["merge_determinism"]["ok"]:
+            failures.append("profile merge not deterministic: %s vs %s"
+                            % (h1, h2))
+
+        # leg 4: one node faulted stale mid-run — conservation must
+        # hold over the reachable subset, stale node out of rollups
+        faults.install(faults.FaultPlan.from_spec("scrape_5xx:times=1"))
+        sent += wave(2)
+        health = obs.scrape()
+        counters, per_node = obs.counters_snapshot()
+        reach = {k: v for k, v in
+                 per_node.get("ipt_requests_total", {}).items()}
+        checks["conservation_faulted"] = {
+            "nodes_up": health["nodes_up"],
+            "nodes_stale": health["nodes_stale"],
+            "fleet": counters.get("ipt_requests_total"),
+            "reachable_sum": sum(reach.values()),
+            "stale_excluded": "n0" not in reach,
+            "ok": (health["nodes_up"] == n_nodes - 1
+                   and health["nodes_stale"] == 1
+                   and "n0" not in reach
+                   and counters.get("ipt_requests_total")
+                   == sum(reach.values()))}
+        if not checks["conservation_faulted"]["ok"]:
+            failures.append("conservation (faulted): %r"
+                            % checks["conservation_faulted"])
+
+        # leg 5: recovery — plan exhausted, full fleet again
+        faults.clear()
+        health = obs.scrape()
+        counters, _pn = obs.counters_snapshot()
+        checks["recovery"] = {
+            "nodes_up": health["nodes_up"],
+            "fleet": counters.get("ipt_requests_total"),
+            "ok": (health["nodes_up"] == n_nodes
+                   and counters.get("ipt_requests_total")
+                   == float(sent))}
+        if not checks["recovery"]["ok"]:
+            failures.append("recovery: %r" % checks["recovery"])
+    finally:
+        faults.install(saved_plan)
+        for b in batchers:
+            b.close()
+
+    report = {"nodes": n_nodes, "checks": checks,
+              "skew_findings": health.get("skew_findings", []),
+              "passed": not failures}
+    result = {
+        "status": "FAIL" if failures else "OK",
+        "seconds": round(time.time() - t0, 2),
+        "detail": "; ".join(failures[:5]) or
+        "conservation holds (full + 1-node-stale + recovery), merge "
+        "hash %s reproduced, aggregate exposition clean"
+        % checks["merge_determinism"]["hash_1"],
+    }
+    if write_report:
+        out = REPO / "reports" / "FLEETOBS.json"
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(report, indent=2) + "\n")
+        result["report"] = str(out.relative_to(REPO))
+    return result
+
+
 def run_retunegate(write_report: bool) -> dict:
     """Profile-guided retuning gate (ISSUE 15, docs/RETUNE.md): a
     deterministic mini-retune on the bundled pack.  The profile is
@@ -793,7 +955,8 @@ def main(argv=None) -> int:
                     choices=["ruff", "mypy", "rulecheck", "concheck",
                              "evasiongate", "deadrules", "faultmatrix",
                              "swapdrill", "modelgate", "devicegate",
-                             "promlint", "benchtrend", "retunegate"],
+                             "promlint", "benchtrend", "retunegate",
+                             "fleetgate"],
                     default=None)
     args = ap.parse_args(argv)
 
@@ -822,6 +985,8 @@ def main(argv=None) -> int:
         gates["promlint"] = run_promlint()
     if args.only in (None, "retunegate"):
         gates["retunegate"] = run_retunegate(write_report=args.ci)
+    if args.only in (None, "fleetgate"):
+        gates["fleetgate"] = run_fleetgate(write_report=args.ci)
     if args.only in (None, "benchtrend"):
         gates["benchtrend"] = run_benchtrend()
 
