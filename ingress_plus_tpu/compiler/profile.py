@@ -250,9 +250,9 @@ class MeasuredProfile:
     def from_dict(cls, d: Dict) -> "MeasuredProfile":
         v = int(d.get("version", PROFILE_VERSION))
         if v > PROFILE_VERSION:
-            raise ValueError(
-                "profile schema v%d is newer than this compiler "
-                "understands (v%d)" % (v, PROFILE_VERSION))
+            # structured (carries .versions) so the fleet plane can
+            # report WHICH node is ahead instead of a bare string
+            raise ProfileVersionError([v, PROFILE_VERSION])
         return cls(
             version=v,
             source=str(d.get("source", "")),

@@ -581,6 +581,61 @@ def render_fleet(health: dict, slo: dict) -> str:
     return "\n".join(lines)
 
 
+def render_fleetctl(journal: dict, lkg: dict,
+                    daemon_tail: list) -> str:
+    """Terminal view for `dbg fleetctl` (ISSUE 19): the fleet rollout
+    journal (per-node stage + ack ledger), the fleet LKG pointer, and
+    the retune daemon's last cycles — all read from the shared
+    --lkg-dir, so it works with the control plane down (that is the
+    point: this is the view an operator reads DURING an incident)."""
+    lines = []
+    if journal:
+        lines.append("fleet rollout: %s  (wave at node %s)"
+                     % (journal.get("state", "?"),
+                        journal.get("node_idx", "?")))
+        lines.append("candidate: %s   incumbent: %s"
+                     % (journal.get("candidate") or "-",
+                        journal.get("incumbent") or "-"))
+        if journal.get("rollback_reason"):
+            lines.append("last rollback: %s" % journal["rollback_reason"])
+        lines.append("")
+        acks = journal.get("acks") or {}
+        lines.append("%-10s %-10s %-22s" % ("node", "stage", "acked"))
+        for i, name in enumerate(journal.get("nodes") or []):
+            idx = journal.get("node_idx", 0)
+            stage = ("done" if name in acks
+                     else "rolling" if i == idx
+                     and journal.get("state") in ("canary", "promoting")
+                     else "pending")
+            lines.append("%-10s %-10s %-22s"
+                         % (name, stage, acks.get(name, "-")))
+    else:
+        lines.append("fleet rollout: no journal (no wave has run)")
+    lines.append("")
+    if lkg:
+        lines.append("fleet LKG: %s" % lkg.get("version", "?"))
+        lines.append("  artifact: %s" % lkg.get("artifact", "?"))
+        for name, ver in sorted((lkg.get("acks") or {}).items()):
+            lines.append("  ack %-8s %s" % (name, ver))
+    else:
+        lines.append("fleet LKG: none written yet")
+    lines.append("")
+    if daemon_tail:
+        last = daemon_tail[-1]
+        lines.append("retune daemon: last cycle %s  (%s)"
+                     % (last.get("result", "?"),
+                        last.get("detail") or last.get("drift") or ""))
+        lines.append("%-6s %-24s %s" % ("cycle", "result", "detail"))
+        for rec in daemon_tail:
+            lines.append("%-6s %-24s %s"
+                         % (rec.get("cycle", "?"),
+                            rec.get("result", "?"),
+                            (rec.get("detail") or "")[:48]))
+    else:
+        lines.append("retune daemon: no ledger (daemon has not run)")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ingress_plus_tpu.control.dbg")
     ap.add_argument("cmd",
@@ -588,7 +643,7 @@ def main(argv=None) -> int:
                              "tenants", "ruleset", "acl", "rulecheck",
                              "concheck", "evadecheck", "rules", "drift",
                              "breaker", "faults", "rollout", "scoring",
-                             "timeline", "fleet"])
+                             "timeline", "fleet", "fleetctl"])
     ap.add_argument("--cycles", type=int, default=6,
                     help="timeline: how many recent cycles to render "
                          "(the Gantt view of /debug/trace)")
@@ -613,7 +668,39 @@ def main(argv=None) -> int:
     ap.add_argument("--sidecar", default=None,
                     help="latency: also scrape the native sidecar's "
                          "--status-port JSON at this host:port")
+    ap.add_argument("--lkg-dir", default=None,
+                    help="fleetctl: the shared fleet LKG dir (rollout "
+                         "journal + LKG pointer + daemon ledger)")
     args = ap.parse_args(argv)
+
+    if args.cmd == "fleetctl":
+        # file-plane view: reads the shared --lkg-dir directly, no
+        # serve process involved (works mid-incident by design)
+        import os as _os
+
+        from ingress_plus_tpu.control.fleetctl import (
+            FLEET_JOURNAL, load_fleet_lkg)
+        from ingress_plus_tpu.control.retuned import JOURNAL_NAME
+
+        if not args.lkg_dir:
+            ap.error("fleetctl needs --lkg-dir")
+        journal = None
+        jpath = _os.path.join(args.lkg_dir, FLEET_JOURNAL)
+        if _os.path.exists(jpath):
+            with open(jpath) as f:
+                journal = json.load(f)
+        lkg = load_fleet_lkg(args.lkg_dir)
+        tail = []
+        lpath = _os.path.join(args.lkg_dir, JOURNAL_NAME)
+        if _os.path.exists(lpath):
+            with open(lpath) as f:
+                for line in f.read().splitlines()[-12:]:
+                    try:
+                        tail.append(json.loads(line))
+                    except ValueError:
+                        continue
+        print(render_fleetctl(journal, lkg, tail))
+        return 0
 
     if args.cmd in ("rulecheck", "concheck", "evadecheck"):
         # local analysis, no serve plane involved — delegate to the

@@ -58,6 +58,15 @@ class DeployValues:
     lkg_dir: str = "/var/lib/ipt/lkg"    # last-known-good pack store
     export_url: str = ""                 # postanalytics collector
     export_interval_s: float = 5.0
+    # --- fleet tier (ISSUE 19, docs/SERVING.md "Fleet serving"): the
+    # shared admission front + N detection replicas + the telemetry
+    # aggregator + the continuous retune daemon in one pod.  0 fleet
+    # nodes = the tier is not rendered (single-pod layout only).
+    fleet_nodes: int = 3                 # replicas behind the front
+    front_http_port: int = 9921          # front /metrics,/front/nodes
+    fleet_http_port: int = 9911          # aggregator /fleet/*
+    retune_min_interval_s: float = 600.0
+    retune_cooldown_s: float = 1800.0
     tenants: Dict[int, List[str]] = field(default_factory=dict)
 
 
@@ -298,13 +307,201 @@ def render_service(v: DeployValues) -> str:
     return "\n".join(out) + "\n"
 
 
+def _fleet_socket(i: int) -> str:
+    return "/run/ipt/fleet-%d.sock" % i
+
+
+def render_fleet(v: DeployValues) -> str:
+    """The fleet pod (ISSUE 19): N detection replicas behind ONE
+    shared admission front, the telemetry aggregator scraping all of
+    them, and the continuous retune daemon closing the loop.  Every
+    replica carries its own /readyz readiness probe (the front stops
+    routing to an unready node before k8s does); the front's own
+    readiness is 503-when-zero-nodes-up, so the Service only pulls the
+    POD when the whole fleet inside is dark — one dead replica is a
+    capacity event, not a service event."""
+    # fleet replicas' HTTP planes start well clear of the single-pod
+    # tier (http_port..+chips) AND the aggregator/front ports (99xx)
+    node_port = v.http_port + 40
+    backends = ",".join("n%d=%s@127.0.0.1:%d"
+                        % (i, _fleet_socket(i), node_port + i)
+                        for i in range(v.fleet_nodes))
+    out = [
+        "apiVersion: apps/v1",
+        "kind: Deployment",
+        "metadata:",
+        "  name: %s-fleet" % v.name,
+        "  namespace: %s" % v.namespace,
+        "spec:",
+        "  replicas: 1",
+        "  selector:",
+        "    matchLabels: {app: %s-fleet}" % v.name,
+        "  template:",
+        "    metadata:",
+        "      labels: {app: %s-fleet}" % v.name,
+        "    spec:",
+        "      volumes:",
+        "        - name: ipt-run",
+        "          emptyDir: {}",
+        "        - name: ipt-rules",
+        "          configMap: {name: %s}" % v.rules_configmap,
+        "        # ONE shared LKG dir for the whole fleet: the fleet",
+        "        # rollout journal, the FLEET_LKG pointer, and the",
+        "        # retune daemon's cycle ledger all live here — a node",
+        "        # (or the daemon) restarting mid-rollout converges to",
+        "        # this, not to whatever it was serving",
+        "        - name: ipt-fleet-lkg",
+        "          emptyDir: {}",
+        "      containers:",
+    ]
+    for i in range(v.fleet_nodes):
+        out += [
+            "        - name: serve-%d" % i,
+            "          image: %s" % v.image,
+            "          command:",
+            "            - python",
+            "            - -m",
+            "            - ingress_plus_tpu.serve",
+            "            - --socket",
+            "            - %s" % _fleet_socket(i),
+            "            - --mode",
+            "            - %s" % v.mode,
+            "            - --rules-dir",
+            "            - /etc/ipt/rules",
+            "            - --max-batch",
+            "            - \"%d\"" % v.max_batch,
+            "            - --max-delay-us",
+            "            - \"%d\"" % v.batch_window_us,
+            "            - --http-port",
+            "            - \"%d\"" % (node_port + i),
+            "            - --lkg-dir",
+            "            - %s" % v.lkg_dir,
+            "          env:",
+            "            - {name: TPU_VISIBLE_CHIPS, value: \"%d\"}" % i,
+            "          resources:",
+            "            limits: {google.com/tpu: 1}",
+            "          livenessProbe:",
+            "            httpGet: {path: /healthz, port: %d}"
+            % (node_port + i),
+            "            initialDelaySeconds: 30",
+            "            periodSeconds: 5",
+            "          readinessProbe:",
+            "            httpGet: {path: /readyz, port: %d}"
+            % (node_port + i),
+            "            initialDelaySeconds: 10",
+            "            periodSeconds: 3",
+            "          volumeMounts:",
+            "            - {name: ipt-run, mountPath: /run/ipt}",
+            "            - {name: ipt-rules, mountPath: /etc/ipt/rules}",
+            "            - {name: ipt-fleet-lkg, mountPath: %s}" % v.lkg_dir,
+        ]
+    out += [
+        "        # the shared admission front (serve/front.py): one",
+        "        # listener, least-loaded routing, retry-on-connect,",
+        "        # half-open canary re-admission; when EVERY node is",
+        "        # down it serves the fail-open verdict itself",
+        "        - name: front",
+        "          image: %s" % v.image,
+        "          command:",
+        "            - python",
+        "            - -m",
+        "            - ingress_plus_tpu.serve",
+        "            - --front",
+        "            - --socket",
+        "            - /run/ipt/front.sock",
+        "            - --http-port",
+        "            - \"%d\"" % v.front_http_port,
+    ]
+    for i in range(v.fleet_nodes):
+        out += [
+            "            - --backend",
+            "            - n%d=%s@127.0.0.1:%d"
+            % (i, _fleet_socket(i), node_port + i),
+        ]
+    out += [
+        "          readinessProbe:",
+        "            # 503 only when ZERO backends are up: one dead",
+        "            # replica must not pull the pod from rotation",
+        "            httpGet: {path: /readyz, port: %d}" % v.front_http_port,
+        "            initialDelaySeconds: 5",
+        "            periodSeconds: 3",
+        "          volumeMounts:",
+        "            - {name: ipt-run, mountPath: /run/ipt}",
+        "        - name: fleet-aggregator",
+        "          image: %s" % v.image,
+        "          command:",
+        "            - python",
+        "            - -m",
+        "            - ingress_plus_tpu.control.fleetobs",
+        "            - --port",
+        "            - \"%d\"" % v.fleet_http_port,
+        "            - --interval-s",
+        "            - \"%g\"" % v.export_interval_s,
+    ]
+    for i in range(v.fleet_nodes):
+        out += [
+            "            - --node",
+            "            - n%d=127.0.0.1:%d" % (i, node_port + i),
+        ]
+    out += [
+        "          readinessProbe:",
+        "            httpGet: {path: /fleet/healthz, port: %d}"
+        % v.fleet_http_port,
+        "            initialDelaySeconds: 5",
+        "            periodSeconds: 5",
+        "        # the continuous retune daemon (control/retuned.py):",
+        "        # watches /fleet/drift, retunes through the four",
+        "        # gates, hands the winner to the fleet-staged rollout",
+        "        - name: retune-daemon",
+        "          image: %s" % v.image,
+        "          command:",
+        "            - python",
+        "            - -m",
+        "            - ingress_plus_tpu.control.retuned",
+        "            - --fleet-url",
+        "            - 127.0.0.1:%d" % v.fleet_http_port,
+        "            - --lkg-dir",
+        "            - %s" % v.lkg_dir,
+        "            - --min-interval-s",
+        "            - \"%g\"" % v.retune_min_interval_s,
+        "            - --cooldown-s",
+        "            - \"%g\"" % v.retune_cooldown_s,
+    ]
+    for i in range(v.fleet_nodes):
+        out += [
+            "            - --node",
+            "            - n%d=127.0.0.1:%d" % (i, node_port + i),
+        ]
+    out += [
+        "          volumeMounts:",
+        "            - {name: ipt-fleet-lkg, mountPath: %s}" % v.lkg_dir,
+        "---",
+        "apiVersion: v1",
+        "kind: Service",
+        "metadata:",
+        "  name: %s-fleet" % v.name,
+        "  namespace: %s" % v.namespace,
+        "spec:",
+        "  selector: {app: %s-fleet}" % v.name,
+        "  ports:",
+        "    - {name: front-http, port: %d}" % v.front_http_port,
+        "    - {name: fleet-http, port: %d}" % v.fleet_http_port,
+    ]
+    # the fleet replicas' HTTP planes are scraped pod-locally by the
+    # aggregator; only the rollups leave the pod
+    return "\n".join(out) + "\n"
+
+
 def render_all(v: DeployValues) -> Dict[str, str]:
     """filename → manifest text (the chart's template set)."""
-    return {
+    out = {
         "configmap.yaml": render_configmap(v),
         "deployment.yaml": render_deployment(v),
         "service.yaml": render_service(v),
     }
+    if v.fleet_nodes > 0:
+        out["fleet.yaml"] = render_fleet(v)
+    return out
 
 
 def write_static(outdir: str | Path,

@@ -156,8 +156,14 @@ class FleetObserver:
 
     def __init__(self, slos: Tuple[SLO, ...] = DEFAULT_SLOS,
                  clock: Callable[[], float] = time.monotonic,
-                 latency_stage: str = "e2e"):
+                 latency_stage: str = "e2e",
+                 node_timeout_s: float = 5.0,
+                 cycle_timeout_s: float = 15.0):
         self.nodes: List[Node] = []
+        #: per-node scrape budget / whole-cycle bound (ISSUE 19): a
+        #: node past its budget goes stale exactly like a refused one
+        self.node_timeout_s = node_timeout_s
+        self.cycle_timeout_s = cycle_timeout_s
         self.slo_engine = SLOEngine(slos, clock=clock)
         self.latency_stage = latency_stage
         self.scrape_cycles = 0
@@ -188,57 +194,101 @@ class FleetObserver:
 
     # -------------------------------------------------------- scraping
 
-    def _scrape_node(self, node: Node) -> None:
-        t0 = time.perf_counter()
-        # fault sites (utils/faults.py): both shapes of scrape failure,
-        # armed per node-scrape arrival so plans can target the Nth
-        # node of the Nth cycle deterministically
-        if faults.fire("scrape_timeout"):
-            raise ScrapeError("injected scrape timeout")
-        if faults.fire("scrape_5xx"):
-            raise ScrapeError("injected scrape 5xx")
-        metrics = node.fetch("/metrics")
-        healthz = node.fetch("/healthz")
-        profile = node.fetch("/rules/stats?format=profile")
-        drift = node.fetch("/rules/drift")
-        exp = promparse.parse_exposition(
-            metrics.decode("utf-8", "replace"))
-        node.exposition = exp
+    @staticmethod
+    def _fetch_node(node: Node) -> Dict[str, bytes]:
+        """Pure fetch of every scrape path (worker thread).  Mutates
+        NOTHING — a fetch abandoned past its budget can complete late
+        without tearing node state a later cycle already rewrote."""
+        return {"metrics": node.fetch("/metrics"),
+                "healthz": node.fetch("/healthz"),
+                "profile": node.fetch("/rules/stats?format=profile"),
+                "drift": node.fetch("/rules/drift")}
+
+    @staticmethod
+    def _apply_node(node: Node, res: Dict[str, bytes],
+                    ms: float) -> None:
+        """Parse + install one node's fetched payloads (cycle thread)."""
+        node.exposition = promparse.parse_exposition(
+            res["metrics"].decode("utf-8", "replace"))
         try:
-            node.healthz = json.loads(healthz)
+            node.healthz = json.loads(res["healthz"])
         except ValueError:
             node.healthz = {}
-        node.profile_raw = profile
+        node.profile_raw = res["profile"]
         try:
             node.profile = MeasuredProfile.from_json(
-                profile.decode("utf-8", "replace"))
+                res["profile"].decode("utf-8", "replace"))
         except (ValueError, KeyError):
             node.profile = None
         try:
-            node.drift = json.loads(drift)
+            node.drift = json.loads(res["drift"])
         except ValueError:
             node.drift = {}
-        node.scrape_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        node.scrape_ms = round(ms, 3)
 
     def scrape(self) -> Dict:
-        """One synchronous scrape cycle over the registry (sequential —
-        node order and fault-site arrival order are deterministic),
-        then re-aggregate and feed the SLO engine.  Never raises on a
-        node failure; returns the cycle summary."""
+        """One scrape cycle over the registry, then re-aggregate and
+        feed the SLO engine.  Node fetches run CONCURRENTLY, each with
+        its own timeout budget, and the whole cycle is bounded — one
+        hung node costs its own sample, never its siblings' (ISSUE 19).
+        Fault sites still fire on the cycle thread in node order, so a
+        seeded plan replays deterministically; stale accounting is
+        unchanged.  Never raises on a node failure."""
+        import concurrent.futures as cf
+
+        deadline = time.monotonic() + self.cycle_timeout_s
+        ex = cf.ThreadPoolExecutor(
+            max_workers=max(1, min(len(self.nodes) or 1, 8)),
+            thread_name_prefix="fleet-scrape")
+        started: Dict[str, float] = {}
+        futs: Dict[str, "cf.Future"] = {}
+        injected: Dict[str, Exception] = {}
         for node in self.nodes:
             node.scrapes += 1
             try:
-                self._scrape_node(node)
-                node.up = True
-                node.stale = False
-                node.error = ""
-            except Exception as e:       # noqa: BLE001 — resilience is
-                # the contract: one dying node must not stop the cycle
-                node.failures += 1
-                node.stale = node.up or node.stale
-                node.up = False
-                node.error = str(e)
-                self.scrape_errors += 1
+                # fault sites (utils/faults.py): every shape of scrape
+                # failure, armed per node-scrape arrival so plans can
+                # target the Nth node of the Nth cycle deterministically
+                if faults.fire("scrape_timeout"):
+                    raise ScrapeError("injected scrape timeout")
+                if faults.fire("scrape_5xx"):
+                    raise ScrapeError("injected scrape 5xx")
+                if faults.fire("node_partition"):
+                    raise ScrapeError("injected node partition")
+            except ScrapeError as e:
+                injected[node.name] = e
+                continue
+            started[node.name] = time.perf_counter()
+            futs[node.name] = ex.submit(self._fetch_node, node)
+        for node in self.nodes:
+            err: Optional[Exception] = injected.get(node.name)
+            if err is None:
+                fut = futs.get(node.name)
+                if fut is None:
+                    continue
+                budget = min(self.node_timeout_s,
+                             max(0.0, deadline - time.monotonic()))
+                try:
+                    res = fut.result(timeout=budget)
+                    self._apply_node(
+                        node, res,
+                        (time.perf_counter() - started[node.name]) * 1e3)
+                    node.up = True
+                    node.stale = False
+                    node.error = ""
+                    continue
+                except cf.TimeoutError:
+                    err = ScrapeError(
+                        "scrape budget exceeded (%.1fs)" % budget)
+                except Exception as e:   # noqa: BLE001 — resilience is
+                    # the contract: one dying node must not stop the cycle
+                    err = e
+            node.failures += 1
+            node.stale = node.up or node.stale
+            node.up = False
+            node.error = str(err)
+            self.scrape_errors += 1
+        ex.shutdown(wait=False, cancel_futures=True)
         with self._lock:
             self.scrape_cycles += 1
             self._aggregate()

@@ -147,12 +147,17 @@ class ServeLoop:
         self.started = time.time()
         self.connections = 0
         self._servers = []
+        # live UDS connection writers: the in-process node-kill drill
+        # (control/fleetctl.py harness) aborts these so the front sees
+        # a real EOF, exactly like a killed process
+        self._conn_writers = set()
 
     # ------------------------------------------------------- UDS plane
 
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
         self.connections += 1
+        self._conn_writers.add(writer)
         frames = MultiFrameReader({REQ_MAGIC: "req", CHUNK_MAGIC: "chunk",
                                    RSCAN_MAGIC: "rscan", WS_MAGIC: "ws"})
         loop = asyncio.get_running_loop()
@@ -395,6 +400,7 @@ class ServeLoop:
                 # the transport dies with the loop either way, and the
                 # traceback would pollute the driver's bench stderr
                 pass
+            self._conn_writers.discard(writer)
             self.connections -= 1
 
     # ------------------------------------------------------ HTTP plane
@@ -1793,6 +1799,24 @@ def main(argv=None) -> None:
                          "also honored from $IPT_FAULTS "
                          "(utils/faults.py, docs/ROBUSTNESS.md)")
     ap.add_argument("--faults-seed", type=int, default=0)
+    ap.add_argument("--front", action="store_true",
+                    help="run as the shared admission front instead of "
+                         "a detection node: fan requests across the "
+                         "--backend replicas over the same UDS protocol "
+                         "(serve/front.py, docs/SERVING.md 'Fleet "
+                         "serving').  No batcher is built in this mode")
+    ap.add_argument("--backend", action="append", default=[],
+                    metavar="NAME=SOCKET[@HOST:PORT]",
+                    help="one detection replica behind --front: its UDS "
+                         "socket plus optionally its HTTP plane "
+                         "(host:port) for /readyz probing; repeatable")
+    ap.add_argument("--front-inflight-cap", type=int,
+                    default=None,
+                    help="per-node in-flight request cap at the front "
+                         "(default %d)" % 256)
+    ap.add_argument("--probe-interval-s", type=float, default=0.5,
+                    help="front health-probe cadence for /readyz checks "
+                         "and down-node backoff ticks")
     args = ap.parse_args(argv)
 
     from ingress_plus_tpu.utils import faults as faults_mod
@@ -1802,6 +1826,22 @@ def main(argv=None) -> None:
                                            seed=args.faults_seed))
     else:
         faults_mod.install_from_env()
+
+    if args.front:
+        # the front owns no detection state: no batcher, no jax — just
+        # the listener, the routing table, and the health prober
+        from ingress_plus_tpu.serve.front import BackendNode, FrontLoop
+
+        if not args.backend:
+            ap.error("--front requires at least one --backend")
+        nodes = [BackendNode.parse(spec) for spec in args.backend]
+        if args.front_inflight_cap:
+            for n in nodes:
+                n.inflight_cap = args.front_inflight_cap
+        front = FrontLoop(nodes, args.socket, args.http_port,
+                          probe_interval_s=args.probe_interval_s)
+        asyncio.run(front.run_forever())
+        return
 
     if args.debug_locks:
         # BEFORE the batcher builds: named_lock() returns instrumented

@@ -89,7 +89,17 @@ from typing import Dict, List, Optional
 SITES = ("dispatch_hang", "dispatch_raise", "recompile_storm",
          "swap_fail", "export_5xx", "slow_confirm",
          "shadow_diverge", "lkg_corrupt",
-         "scrape_timeout", "scrape_5xx")
+         "scrape_timeout", "scrape_5xx",
+         # fleet control plane (ISSUE 19, docs/SERVING.md):
+         # node_kill — harnesses kill one serve node when it fires;
+         # node_partition — a fleet scrape raises (node reachable for
+         #   serving, unreachable for telemetry);
+         # front_backend_refuse — the front's backend connect refuses
+         #   (exercises retry-on-connect-failure to a sibling);
+         # retune_gate_fail — the retune daemon's gate run is forced
+         #   to fail (the incumbent must keep serving everywhere)
+         "node_kill", "node_partition", "front_backend_refuse",
+         "retune_gate_fail")
 
 
 class FaultError(RuntimeError):
@@ -1226,6 +1236,244 @@ def _scenario_fleet_scrape(install_plan) -> dict:
             b.close()
 
 
+def _front_wave(front, n: int, tag: str, violations: List[str],
+                kill=None, timeout_s: float = 30.0) -> dict:
+    """Push ``n`` mixed requests through the front's UDS listener on
+    one pipelined client connection; returns the verdict ledger keyed
+    by req_id.  ``kill`` (optional thunk) fires once mid-send when the
+    ``node_kill`` site is armed.  Exactly-one-verdict is the audit:
+    a missing, duplicate, or silently-unblocked-attack verdict is a
+    violation."""
+    import socket as socket_mod
+
+    from ingress_plus_tpu.serve.protocol import (
+        RESP_MAGIC, FrameReader, decode_response, encode_request)
+
+    reqs = _requests(n, attack_every=4, tag=tag)
+    s = socket_mod.socket(socket_mod.AF_UNIX)
+    s.connect(front.socket_path)
+    s.settimeout(timeout_s)
+    got: dict = {}
+    try:
+        for i, r in enumerate(reqs):
+            s.sendall(encode_request(r, req_id=i + 1))
+            if kill is not None and i == n // 2 and fire("node_kill"):
+                kill()
+        reader = FrameReader(RESP_MAGIC)
+        while len(got) < n:
+            data = s.recv(65536)
+            if not data:
+                violations.append("%s: front EOF at %d/%d verdicts"
+                                  % (tag, len(got), n))
+                return got
+            for fr in reader.feed(data):
+                v = decode_response(fr)
+                if v["req_id"] in got:
+                    violations.append("%s: DUPLICATE verdict for %d"
+                                      % (tag, v["req_id"]))
+                got[v["req_id"]] = v
+    except OSError as e:
+        violations.append("%s: client error at %d/%d: %s"
+                          % (tag, len(got), n, e))
+    finally:
+        s.close()
+    for i in range(n):
+        if i % 4 == 0:   # the attack slots of _requests()
+            v = got.get(i + 1)
+            if v and not v["blocked"] and not v["fail_open"]:
+                violations.append("%s: attack %d passed unblocked "
+                                  "WITHOUT the fail-open flag (silent "
+                                  "degradation)" % (tag, i + 1))
+    return got
+
+
+def _front_node_state(front, name: str) -> str:
+    for row in front.status()["nodes"]:
+        if row["name"] == name:
+            return row["state"]
+    return "?"
+
+
+def _scenario_fleet_node_kill(install_plan) -> dict:
+    """A backend node dies under live load behind the shared admission
+    front (ISSUE 19): requests already in flight on the dead node come
+    back as SYNTHESIZED fail-open verdicts, everything not yet written
+    reroutes to a sibling — exactly one verdict per request, no attack
+    passes silently unblocked — the dead node is ejected, and a revived
+    node is re-admitted through the half-open canary without help."""
+    import tempfile
+
+    from ingress_plus_tpu.control.fleetctl import build_drill_fleet
+
+    violations: List[str] = []
+    with tempfile.TemporaryDirectory(prefix="ipt-fkill-") as tmp:
+        harnesses, front, fleet, _obs = build_drill_fleet(
+            3, tmp, socket_prefix="/tmp/ipt-fkill")
+        try:
+            install_plan(FaultPlan.from_spec("node_kill:times=1"))
+            _front_wave(front, 32, "warm", violations)
+            # the site decides the kill moment: one node dies with the
+            # wave half-sent and its in-flight verdicts unresolved
+            kill_got = _front_wave(front, 64, "kill", violations,
+                                   kill=harnesses[1].kill)
+            if len(kill_got) != 64:
+                violations.append("kill wave lost verdicts: %d of 64"
+                                  % len(kill_got))
+            deadline = time.monotonic() + 5.0
+            while (time.monotonic() < deadline
+                   and _front_node_state(front, "n1") == "up"):
+                time.sleep(0.05)
+            if _front_node_state(front, "n1") == "up":
+                violations.append("front never ejected the dead node")
+            # survivors: full service, zero fail-open, attacks blocked
+            post = _front_wave(front, 32, "post", violations)
+            if any(v["fail_open"] for v in post.values()):
+                violations.append("fail-open verdicts AFTER the dead "
+                                  "node was ejected (degradation must "
+                                  "be capacity, not service)")
+            # revive → half-open probe → canary → re-admitted
+            harnesses[1].revive()
+            deadline = time.monotonic() + 15.0
+            while (time.monotonic() < deadline
+                   and _front_node_state(front, "n1") != "up"):
+                time.sleep(0.1)
+            if _front_node_state(front, "n1") != "up":
+                violations.append("revived node was never re-admitted "
+                                  "(state %s)"
+                                  % _front_node_state(front, "n1"))
+            st = front.status()
+            return {"ok": not violations, "violations": violations,
+                    "front": {k: st[k] for k in
+                              ("requests_total", "retries_total",
+                               "fail_open_front_total")},
+                    "synth_fail_open": sum(
+                        n["synth_fail_open"] for n in st["nodes"])}
+        finally:
+            front.stop()
+            for h in harnesses:
+                h.close()
+
+
+def _scenario_fleet_rollout_node_death(install_plan) -> dict:
+    """A node dies MID-FLEET-ROLLOUT (ISSUE 19): the canary node has
+    already acked the candidate and the second node is walking its
+    staged ramp when it dies — the fleet controller must converge
+    EVERY node (the already-promoted canary included) back to the
+    fleet LKG, never leaving the fleet split across generations."""
+    import tempfile
+
+    from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+    from ingress_plus_tpu.compiler.seclang import parse_seclang
+    from ingress_plus_tpu.control.fleetctl import (
+        FLEET_CANARY, FLEET_PROMOTING, FLEET_ROLLED_BACK,
+        build_drill_fleet, load_fleet_lkg)
+    from ingress_plus_tpu.control.rollout import _DRILL_CANDIDATE
+
+    violations: List[str] = []
+    with tempfile.TemporaryDirectory(prefix="ipt-frkill-") as tmp:
+        harnesses, front, fleet, _obs = build_drill_fleet(
+            3, tmp, socket_prefix="/tmp/ipt-frkill")
+        try:
+            install_plan(FaultPlan.from_spec("node_kill:times=1"))
+            incumbent = fleet.nodes[0].serving_version
+            cr_good = compile_ruleset(parse_seclang(_DRILL_CANDIDATE))
+            rep = fleet.begin(ruleset=cr_good)
+            if not rep.get("ok"):
+                violations.append("central admission rejected the good "
+                                  "candidate: %r" % rep)
+                return {"ok": False, "violations": violations}
+            deadline = time.monotonic() + 120.0
+            while (fleet.state in (FLEET_CANARY, FLEET_PROMOTING)
+                   and time.monotonic() < deadline):
+                fleet.traffic_pump(
+                    fleet.nodes[min(fleet._idx, len(fleet.nodes) - 1)])
+                # canary acked + next node mid-ramp = the kill moment
+                if len(fleet.acks) == 1 and fire("node_kill"):
+                    harnesses[1].kill()
+                    fleet.nodes[1].abort("node_death")
+                fleet.poll()
+            if fleet.state != FLEET_ROLLED_BACK:
+                violations.append("fleet did not roll back (state %s, "
+                                  "reason %r)" % (fleet.state,
+                                                  fleet.rollback_reason))
+            lkg = load_fleet_lkg(tmp)
+            if not lkg or lkg["version"] != incumbent:
+                violations.append("fleet LKG is not the incumbent: %r"
+                                  % (lkg and lkg["version"]))
+            for node in fleet.nodes:
+                if node.serving_version != incumbent:
+                    violations.append(
+                        "node %s left split on %s (fleet LKG %s)"
+                        % (node.name, node.serving_version, incumbent))
+            return {"ok": not violations, "violations": violations,
+                    "rollback_reason": fleet.rollback_reason,
+                    "acks_at_death": 1}
+        finally:
+            front.stop()
+            for h in harnesses:
+                h.close()
+
+
+def _scenario_fleet_partition_daemon(install_plan) -> dict:
+    """A node partitions away DURING a retune-daemon cycle (ISSUE 19):
+    the scrape marks it stale and excludes it, the daemon's cycle
+    degrades to a structured skip (never a crash), the serve plane on
+    every node — the partitioned one included — keeps answering with
+    exactly one verdict per request, and the next cycle after the
+    partition heals re-admits the node's telemetry."""
+    import tempfile
+
+    from ingress_plus_tpu.control.fleetctl import build_drill_fleet
+    from ingress_plus_tpu.control.retuned import (
+        CYCLE_ERROR, RetuneDaemon)
+
+    violations: List[str] = []
+    with tempfile.TemporaryDirectory(prefix="ipt-fpart-") as tmp:
+        harnesses, front, fleet, obs = build_drill_fleet(
+            3, tmp, socket_prefix="/tmp/ipt-fpart", observer=True)
+        try:
+            daemon = RetuneDaemon(obs, fleet, tmp, min_interval_s=0.0)
+            obs.scrape()     # healthy baseline cycle
+            install_plan(FaultPlan.from_spec("node_partition:times=1"))
+            # partition fires inside the daemon's scrape: n0 unreachable
+            health = obs.scrape()
+            if health["nodes_up"] != 2 or health["nodes_stale"] != 1:
+                violations.append("expected 2 up + 1 stale during the "
+                                  "partition, got %d up + %d stale"
+                                  % (health["nodes_up"],
+                                     health["nodes_stale"]))
+            rec = daemon.cycle()
+            if rec["result"] == CYCLE_ERROR:
+                violations.append("daemon cycle CRASHED during the "
+                                  "partition: %s" % rec["detail"])
+            if not rec["result"].startswith("skip:"):
+                violations.append("daemon acted on partitioned "
+                                  "telemetry instead of a structured "
+                                  "skip: %r" % rec["result"])
+            # the serve plane must not notice the telemetry partition —
+            # the partitioned node included
+            for i, h in enumerate(harnesses):
+                vs, viol = _collect(
+                    [h.batcher.submit(r) for r in _requests(
+                        12, attack_every=4, tag="part-n%d-" % i)],
+                    timeout_s=30)
+                _check_verdicts(vs, viol, 12)
+                violations.extend(viol)
+            # plan exhausted: the next scrape heals the partition
+            health = obs.scrape()
+            if health["nodes_up"] != 3:
+                violations.append("partitioned node never rejoined the "
+                                  "telemetry plane (%d up)"
+                                  % health["nodes_up"])
+            return {"ok": not violations, "violations": violations,
+                    "daemon_cycle": rec["result"],
+                    "journal": daemon.journal_tail(4)}
+        finally:
+            front.stop()
+            for h in harnesses:
+                h.close()
+
+
 SCENARIOS = {
     "overload_burst": _scenario_overload,
     "dispatch_hang": _scenario_dispatch_hang,
@@ -1243,6 +1491,9 @@ SCENARIOS = {
     "tenant_flood": _scenario_tenant_flood,
     "tenant_flood_during_canary": _scenario_tenant_flood_canary,
     "fleet_scrape": _scenario_fleet_scrape,
+    "fleet_node_kill": _scenario_fleet_node_kill,
+    "fleet_rollout_node_death": _scenario_fleet_rollout_node_death,
+    "fleet_partition_daemon": _scenario_fleet_partition_daemon,
 }
 
 

@@ -38,6 +38,37 @@ def test_render_contains_architecture():
     assert "attacks" not in out["service.yaml"]  # no hot-path port leaks
 
 
+def test_render_fleet_topology():
+    """Fleet tier (ISSUE 19): front + N serve replicas + aggregator +
+    retune daemon in one pod, readiness probes on every layer."""
+    v = DeployValues(fleet_nodes=4, front_http_port=9931,
+                     fleet_http_port=9912)
+    fleet = render_all(v)["fleet.yaml"]
+    # N replicas, each on its own UDS + HTTP plane with its own probes
+    assert fleet.count("name: serve-") == 4
+    for i in range(4):
+        assert "/run/ipt/fleet-%d.sock" % i in fleet
+    assert fleet.count("path: /readyz") == 4 + 1  # replicas + front
+    assert fleet.count("path: /healthz") == 4
+    # the front knows every backend by socket AND HTTP plane
+    assert "- --front" in fleet
+    assert fleet.count("- --backend") == 4
+    assert "n0=/run/ipt/fleet-0.sock@127.0.0.1:9941" in fleet
+    # aggregator scrapes all replicas; daemon closes the loop on the
+    # aggregator's /fleet/* surfaces and shares the fleet LKG volume
+    assert "ingress_plus_tpu.control.fleetobs" in fleet
+    assert "ingress_plus_tpu.control.retuned" in fleet
+    assert fleet.count("- --node") == 8  # aggregator + daemon
+    assert "path: /fleet/healthz" in fleet
+    assert "- 127.0.0.1:9912" in fleet  # daemon -> aggregator, pod-local
+    assert fleet.count("name: ipt-fleet-lkg") >= 6  # volume + mounts
+    # front + aggregator are the only ports the Service exposes; the
+    # replicas' HTTP planes stay pod-local (scraped by the aggregator)
+    assert "port: 9931" in fleet and "port: 9912" in fleet
+    # fleet tier is opt-out: 0 nodes renders no fleet manifest at all
+    assert "fleet.yaml" not in render_all(DeployValues(fleet_nodes=0))
+
+
 def test_static_manifests_in_sync(tmp_path):
     """deploy/static must equal a fresh default render (the reference
     regenerates deploy/static from the chart the same way)."""

@@ -1,0 +1,203 @@
+"""Fleet control plane (ISSUE 19): node-by-node staged rollout with
+the fleet LKG pointer, crash-mid-wave recovery, the retune daemon's
+structured-skip ladder, and the fleet fault-matrix scenarios."""
+
+import json
+
+import pytest
+
+from ingress_plus_tpu.compiler.ruleset import compile_ruleset
+from ingress_plus_tpu.compiler.seclang import parse_seclang
+from ingress_plus_tpu.control.fleetctl import (
+    FLEET_CANARY,
+    FLEET_IDLE,
+    FLEET_LIVE,
+    FleetController,
+    build_drill_fleet,
+    load_fleet_lkg,
+)
+from ingress_plus_tpu.control.retuned import (
+    CYCLE_ERROR,
+    SKIP_COOLDOWN,
+    SKIP_MIN_INTERVAL,
+    SKIP_NO_DRIFT,
+    SKIP_NO_PROFILE,
+    RetuneDaemon,
+)
+from ingress_plus_tpu.control.rollout import _DRILL_CANDIDATE
+from ingress_plus_tpu.utils.faults import run_fault_matrix
+
+
+def _teardown(harnesses, front):
+    front.stop()
+    for h in harnesses:
+        h.close()
+
+
+# --------------------------------------------------- staged fleet wave
+
+def test_fleet_wave_to_live_and_lkg(tmp_path):
+    """Happy path: central admission, canary, node-by-node promote,
+    fleet LKG advanced with every node's ack."""
+    harnesses, front, fleet, _ = build_drill_fleet(
+        2, tmp_path, socket_prefix="/tmp/ipt-tfc1")
+    try:
+        cr = compile_ruleset(parse_seclang(_DRILL_CANDIDATE))
+        adm = fleet.begin(ruleset=cr)
+        assert adm["ok"], adm
+        assert fleet.state == FLEET_CANARY
+        assert fleet.drive(deadline_s=60) == FLEET_LIVE
+        assert all(n.serving_version == cr.version for n in fleet.nodes)
+        assert fleet.acks == {n.name: cr.version for n in fleet.nodes}
+        lkg = load_fleet_lkg(tmp_path)
+        assert lkg and lkg["version"] == cr.version
+        # the journal is terminal — a restart must NOT re-converge
+        again = FleetController(fleet.nodes, tmp_path)
+        assert again.recover()["recovered"] is False
+    finally:
+        _teardown(harnesses, front)
+
+
+def test_fleet_recover_converges_mid_wave_crash(tmp_path):
+    """Crash mid-wave: a fresh controller over the same journal + LKG
+    dir converges every node back to the fleet LKG before anything
+    else happens (the daemon calls recover() at every startup)."""
+    harnesses, front, fleet, _ = build_drill_fleet(
+        2, tmp_path, socket_prefix="/tmp/ipt-tfc2")
+    try:
+        incumbent = fleet.nodes[0].serving_version
+        cr = compile_ruleset(parse_seclang(_DRILL_CANDIDATE))
+        assert fleet.begin(ruleset=cr)["ok"]
+        # walk the wave until the canary node is actually mid-ramp,
+        # then "crash": drop the controller on the floor
+        for _ in range(3):
+            fleet.traffic_pump(fleet.nodes[0])
+            fleet.poll()
+        assert json.loads(fleet.journal_path.read_text())["state"] in (
+            FLEET_CANARY, "promoting")
+        reborn = FleetController(fleet.nodes, tmp_path)
+        rep = reborn.recover()
+        assert rep["recovered"] is True
+        assert rep["lkg"] == incumbent
+        assert all(v == "converged" for v in rep["nodes"].values())
+        assert all(n.serving_version == incumbent for n in reborn.nodes)
+        assert reborn.state == FLEET_IDLE
+        # idempotent: the rewritten journal is terminal now
+        assert reborn.recover()["recovered"] is False
+    finally:
+        _teardown(harnesses, front)
+
+
+# ------------------------------------------------ retune daemon ladder
+
+class _Obs:
+    """Observer twin: scripted /fleet/drift + merged-profile answers."""
+
+    def __init__(self, drift=None, profile=None, err=""):
+        self.drift = drift if drift is not None else {}
+        self.profile = profile
+        self.err = err
+
+    def fleet_drift(self):
+        if isinstance(self.drift, Exception):
+            raise self.drift
+        return self.drift
+
+    def merged_profile(self):
+        return self.profile
+
+    def healthz(self):
+        return {"merged_profile": {"error": self.err}}
+
+
+def _daemon(tmp_path, obs, **kw):
+    # the fleet is only touched past the profile gate; the ladder
+    # tests never get there, so a bare object is an honest stand-in
+    return RetuneDaemon(obs, object(), tmp_path, **kw)
+
+
+def test_daemon_drift_probe(tmp_path):
+    def probe(drift):
+        return _daemon(tmp_path, _Obs(drift=drift))._drift_reason()
+
+    assert probe({"fleet_went_quiet": [942100, 942440]}) \
+        == "fleet_went_quiet:2 rules"
+    assert probe({"nodes": {"n0": {"rules": [{"delta": -0.05}]}}}) \
+        == "hit_rate_delta:0.0500"
+    assert probe({"nodes": {"n0": {"rules": [{"delta": 0.001}]}}}) is None
+    assert probe(RuntimeError("aggregator down")) is None
+
+
+def test_daemon_skips_are_typed_and_journaled(tmp_path):
+    obs = _Obs(drift={})
+    d = _daemon(tmp_path, obs)
+    rec = d.cycle()
+    assert rec["result"] == SKIP_NO_DRIFT
+    assert d.journal_tail()[-1]["result"] == SKIP_NO_DRIFT
+
+    # actionable drift but the merged profile is degraded away (e.g. a
+    # node publishing a newer PROFILE_VERSION): typed skip, not a crash
+    obs2 = _Obs(drift={"fleet_went_quiet": [1]}, profile=None,
+                err="node n2 profile schema v9 newer than v1")
+    rec2 = _daemon(tmp_path, obs2).cycle()
+    assert rec2["result"] == SKIP_NO_PROFILE
+    assert "newer" in rec2["detail"]
+    assert rec2["drift"] == "fleet_went_quiet:1 rules"
+
+
+def test_daemon_rate_limit_and_cooldown(tmp_path):
+    now = [1000.0]
+    d = _daemon(tmp_path, _Obs(drift={"fleet_went_quiet": [1]}),
+                min_interval_s=600.0, cooldown_s=300.0,
+                clock=lambda: now[0])
+    # a retune just happened: the limiter holds even under drift
+    d._last_retune_at = 900.0
+    assert d.cycle()["result"] == SKIP_MIN_INTERVAL
+    # force bypasses the limiter AND the drift probe (break-glass) —
+    # with no profile it then skips one rung further down the ladder
+    rec = d.cycle(force=True)
+    assert rec["result"] == SKIP_NO_PROFILE and rec["drift"] == "forced"
+    # cooldown after a fleet rollback outranks even force
+    d._cooldown_until = now[0] + 200.0
+    rec = d.cycle(force=True)
+    assert rec["result"] == SKIP_COOLDOWN
+    assert "200s left" in rec["detail"]
+    now[0] += 201.0
+    assert d.cycle(force=True)["result"] != SKIP_COOLDOWN
+    assert d.status()["cooldown_left_s"] == 0.0
+
+
+def test_daemon_cycle_never_raises(tmp_path, monkeypatch):
+    d = _daemon(tmp_path, _Obs())
+    monkeypatch.setattr(
+        d, "_cycle_inner",
+        lambda now, force: (_ for _ in ()).throw(RuntimeError("boom")))
+    rec = d.cycle()
+    assert rec["result"] == CYCLE_ERROR
+    assert "RuntimeError: boom" in rec["detail"]
+    assert d.journal_tail()[-1]["result"] == CYCLE_ERROR
+
+
+def test_daemon_journal_bounded(tmp_path):
+    d = _daemon(tmp_path, _Obs(drift={}), max_journal_entries=8)
+    for _ in range(30):
+        d.cycle()
+    lines = d.journal_path.read_text().splitlines()
+    assert len(lines) <= 8
+    assert json.loads(lines[-1])["cycle"] == 30   # newest survives
+    # torn/corrupt lines are skipped, not fatal
+    with d.journal_path.open("a") as f:
+        f.write('{"cycle": 31, "result"')
+    tail = d.journal_tail()
+    assert tail and tail[-1]["cycle"] == 30
+
+
+# --------------------------------------------------- fault matrix
+
+@pytest.mark.parametrize("scenario", [
+    "fleet_node_kill", "fleet_rollout_node_death",
+    "fleet_partition_daemon"])
+def test_fleet_fault_matrix_scenario(scenario):
+    rep = run_fault_matrix(only=[scenario])
+    res = rep["scenarios"][scenario]
+    assert res["ok"], res["violations"]

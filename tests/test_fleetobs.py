@@ -275,6 +275,69 @@ def test_profile_merge_rejects_cross_version():
         MeasuredProfile.merge([])
 
 
+def test_profile_merge_all_idle_fleet():
+    """Every node at zero requests (a fleet that just booted): the
+    merge must not divide by zero — it falls back to the unweighted
+    mean so a retune against the cold fleet still has a profile."""
+    a = _prof("a", 0, 0.2, 10.0)
+    b = _prof("b", 0, 0.4, 30.0)
+    m = MeasuredProfile.merge([a, b])
+    assert m.requests == 0
+    rec = m.rules[942100]
+    assert rec["candidate_rate"] == pytest.approx(0.3)
+    # per-candidate cost weights by candidate volume even at w=1:
+    # (0.2*10 + 0.4*30) / (0.2 + 0.4)
+    assert rec["confirm_us_per_candidate"] == pytest.approx(23.333)
+    # and the result is still order-canonical
+    assert (MeasuredProfile.merge([b, a]).content_hash()
+            == m.content_hash())
+
+
+def test_profile_merge_single_node_is_near_identity():
+    """A one-node fleet merges to the same rates it reported — the
+    daemon must behave identically whether it fronts 1 node or 10."""
+    a = _prof("solo", 500, 0.25, 15.0)
+    a.byte_freq = [1.0 / 256] * 256
+    m = MeasuredProfile.merge([a])
+    assert m.requests == 500 and m.version == a.version
+    assert m.rules[942100]["candidate_rate"] == pytest.approx(0.25)
+    assert m.rules[942100]["confirm_us_per_candidate"] == \
+        pytest.approx(15.0)
+    assert m.rules[942100]["qr_skip_rate"] == pytest.approx(0.5)
+    assert len(m.byte_freq) == 256
+    assert sum(m.byte_freq) == pytest.approx(1.0)
+
+
+def test_profile_merge_rule_absent_on_some_nodes():
+    """A rule only one node ever saw still dilutes over ALL traffic
+    weight (absence == zero candidates on that node), and an idle
+    zero-request node alongside busy ones contributes nothing."""
+    busy = _prof("busy", 300, 0.2, 10.0)
+    quiet = MeasuredProfile(source="quiet", requests=100, rules={})
+    idle = _prof("idle", 0, 0.9, 99.0)
+    m = MeasuredProfile.merge([busy, quiet, idle])
+    rec = m.rules[942100]
+    # (300*0.2) / 400 — the quiet node's 100 requests count as zeros,
+    # the idle node's w=0 silences its (stale) rates entirely
+    assert rec["candidate_rate"] == pytest.approx(0.15)
+    assert rec["confirm_us_per_candidate"] == pytest.approx(10.0)
+    assert m.requests == 400
+
+
+def test_profile_from_dict_rejects_newer_schema():
+    """A node running a NEWER profile schema must be a structured skip
+    at decode time (ProfileVersionError), not a silent mis-merge —
+    the fleet plane turns this into a per-node merge error."""
+    d = _prof("future", 10, 0.1, 1.0).to_dict()
+    d["version"] = PROFILE_VERSION + 1
+    with pytest.raises(ProfileVersionError):
+        MeasuredProfile.from_dict(d)
+    # same-or-older versions decode fine
+    ok = MeasuredProfile.from_dict(
+        _prof("now", 10, 0.1, 1.0).to_dict())
+    assert ok.rules[942100]["candidate_rate"] == pytest.approx(0.1)
+
+
 # ----------------------------------------------------------------- skew
 
 def test_generation_p99_and_confirm_share_skew():

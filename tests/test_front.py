@@ -1,0 +1,133 @@
+"""Shared admission front (ISSUE 19, serve/front.py): least-loaded
+routing, ejection with backoff + half-open re-admission, the all-down
+fail-open path, and the HTTP observability plane."""
+
+import json
+import time
+
+import pytest
+
+from ingress_plus_tpu.control.fleetctl import build_drill_fleet
+from ingress_plus_tpu.serve.front import (
+    DOWN,
+    UP,
+    BackendNode,
+    FrontLoop,
+)
+from ingress_plus_tpu.utils.faults import _front_wave
+
+
+# ---------------------------------------------------------- unit layer
+
+def test_backend_parse():
+    n = BackendNode.parse("n0=/run/ipt/f0.sock@127.0.0.1:9941")
+    assert (n.name, n.socket_path, n.readyz) \
+        == ("n0", "/run/ipt/f0.sock", "127.0.0.1:9941")
+    bare = BackendNode.parse("n1=/tmp/a.sock")
+    assert bare.readyz is None and bare.ready()  # no probe = only UDS gates
+    with pytest.raises(ValueError, match="NAME=SOCKET"):
+        BackendNode.parse("just-a-socket-path")
+
+
+def _front3():
+    nodes = [BackendNode(name="n%d" % i, socket_path="/tmp/x%d" % i)
+             for i in range(3)]
+    return FrontLoop(nodes, "/tmp/unused-front.sock"), nodes
+
+
+def test_pick_is_least_loaded_and_skips_tried():
+    front, (a, b, c) = _front3()
+    a.inflight, b.inflight, c.inflight = 5, 1, 3
+    assert front.pick(set()) is b
+    # per-request retry excludes nodes already tried on this request
+    assert front.pick({"n1"}) is c
+    c.state = DOWN
+    assert front.pick({"n1"}) is a
+    # every ready node at its inflight cap = shed, loudly counted
+    a.inflight = a.inflight_cap
+    assert front.pick({"n1"}) is None
+    assert front.shed_capacity == 1
+    # but a fully-tried fleet is NOT a capacity shed
+    shed_before = front.shed_capacity
+    assert front.pick({"n0", "n1", "n2"}) is None
+    assert front.shed_capacity == shed_before
+
+
+def test_eject_backoff_and_readmit_counters():
+    front, (a, _b, _c) = _front3()
+    front.eject(a, "connect_refused")
+    assert (a.state, a.eject_reason, a.ejections) \
+        == (DOWN, "connect_refused", 1)
+    assert a.next_probe > time.monotonic()
+    # idempotent: a down node cannot be ejected twice
+    front.eject(a, "again")
+    assert a.ejections == 1 and a.eject_reason == "connect_refused"
+    front._readmit(a)
+    assert (a.state, a.eject_reason, a.readmissions) == (UP, "", 1)
+
+
+def test_route_http_surfaces():
+    front, (a, b, c) = _front3()
+    code, ctype, body = front.route_http("/metrics")
+    assert code == "200 OK" and "text/plain" in ctype
+    assert b"ipt_front_nodes_up 3" in body
+    assert b'ipt_front_node_up{node="n1"} 1' in body
+
+    code, _, body = front.route_http("/readyz?verbose=1")
+    assert code == "200 OK" and json.loads(body)["nodes_up"] == 3
+    for n in (a, b, c):
+        front.eject(n, "drill")
+    code, _, body = front.route_http("/readyz")
+    # zero nodes: still answering (fail-open) but advertising 503 so
+    # an upstream LB prefers a healthier front
+    assert code == "503 Service Unavailable"
+    assert json.loads(body) == {"ready": False, "nodes_up": 0}
+
+    _, _, body = front.route_http("/front/nodes")
+    rows = json.loads(body)          # the bare list, not a wrapper
+    assert [r["name"] for r in rows] == ["n0", "n1", "n2"]
+    assert all(r["state"] == DOWN for r in rows)
+    assert front.route_http("/nope")[0].startswith("404")
+
+
+# --------------------------------------------------- integration layer
+
+def test_front_round_trip_kill_and_readmit(tmp_path):
+    """One real node behind the front: verdicts round-trip; killing
+    the node degrades EXPLICITLY (synthesized fail-open verdicts, no
+    lost requests); reviving it re-admits via the half-open canary."""
+    harnesses, front, _fleet, _ = build_drill_fleet(
+        1, tmp_path, socket_prefix="/tmp/ipt-tfr")
+    try:
+        violations = []
+        _front_wave(front, 16, "warm", violations)
+        assert violations == []
+        assert front.requests_total >= 16
+        assert front.nodes[0].completed >= 16
+        assert front.fail_open_front_total == 0
+
+        harnesses[0].kill()
+        _front_wave(front, 16, "dark", violations)
+        assert violations == []      # exactly one verdict per request
+        st = front.status()
+        assert st["nodes_up"] == 0
+        # every dark-window verdict was the synthesized fail-open one
+        assert st["fail_open_front_total"] >= 16
+        assert st["all_down_served"] >= 1
+
+        harnesses[0].revive()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if front.nodes[0].state == UP:
+                break
+            time.sleep(0.1)
+        assert front.nodes[0].state == UP
+        assert front.nodes[0].readmissions >= 1
+        _front_wave(front, 16, "back", violations)
+        assert violations == []
+        assert front.status()["fail_open_front_total"] \
+            == st["fail_open_front_total"]   # no fail-open after revive
+    finally:
+        front.stop()
+        for h in harnesses:
+            h.close()

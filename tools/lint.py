@@ -86,6 +86,15 @@ Four gates, one verdict:
              MeasuredProfile.merge content-hash reproducibility, and
              a promlint-clean aggregated /fleet/metrics exposition
              (reports/FLEETOBS.json)
+  fleetdrill the fleet control plane (ISSUE 19, docs/SERVING.md
+             "Fleet serving"): a 3-node in-process fleet behind the
+             shared admission front — one node killed mid-wave with
+             zero verdict loss, the good pack staged node-by-node to
+             LIVE with the fleet LKG pointer written, the broken pack
+             stopped at central admission, a mid-wave node death
+             rolling the whole fleet back to LKG, and one forced
+             retune-daemon cycle landing fleet-wide
+             (reports/FLEETDRILL.json)
   benchtrend the checked-in BENCH_r*.json req/s/chip trajectory
              (tools/bench_trend.py): >10% regression vs the previous
              snapshot fails; SKIPPED with fewer than two artifacts
@@ -120,6 +129,8 @@ MYPY_SCOPE = ["ingress_plus_tpu/compiler", "ingress_plus_tpu/analysis",
               "ingress_plus_tpu/post/topk.py",
               "ingress_plus_tpu/control/rollout.py",
               "ingress_plus_tpu/control/fleetobs.py",
+              "ingress_plus_tpu/control/fleetctl.py",
+              "ingress_plus_tpu/control/retuned.py",
               "ingress_plus_tpu/parallel/serve_mesh.py",
               "ingress_plus_tpu/learn",
               "ingress_plus_tpu/utils/promparse.py",
@@ -947,6 +958,44 @@ def run_benchtrend() -> dict:
     }
 
 
+def run_fleetdrill(write_report: bool) -> dict:
+    """Fleet control-plane gate (ISSUE 19, control/fleetctl.py): the
+    whole fleet choreography proven in one process — a 3-node front
+    wave with one node killed mid-send (zero verdict loss), the good
+    candidate promoted node by node to LIVE with the fleet LKG written,
+    the broken pack stopped at central admission, a mid-wave node
+    failure rolling the WHOLE fleet back to LKG, and one forced
+    retune-daemon cycle end to end (profile → four gates →
+    fleet-staged rollout).  Writes reports/FLEETDRILL.json."""
+    t0 = time.time()
+    from ingress_plus_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+    from ingress_plus_tpu.control.fleetctl import run_fleet_drill
+
+    report = run_fleet_drill()
+    failed = {name: leg for name, leg in report["legs"].items()
+              if not leg["ok"]}
+    result = {
+        "status": "OK" if report["passed"] else "FAIL",
+        "seconds": round(time.time() - t0, 2),
+        "legs": {name: leg["ok"] for name, leg in report["legs"].items()},
+        "detail": "; ".join("%s: %s" % (n, leg.get("violations")
+                                        or leg.get("reason")
+                                        or leg.get("result"))
+                            for n, leg in failed.items()) or
+                  "front kill zero-loss, fleet LIVE + LKG, bad pack "
+                  "stopped, mid-wave death rolled the fleet back, "
+                  "daemon cycle to LIVE",
+    }
+    if write_report:
+        out = REPO / "reports" / "FLEETDRILL.json"
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(report, indent=2, default=str) + "\n")
+        result["report"] = str(out.relative_to(REPO))
+    return result
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tools/lint.py")
     ap.add_argument("--ci", action="store_true",
@@ -956,7 +1005,7 @@ def main(argv=None) -> int:
                              "evasiongate", "deadrules", "faultmatrix",
                              "swapdrill", "modelgate", "devicegate",
                              "promlint", "benchtrend", "retunegate",
-                             "fleetgate"],
+                             "fleetgate", "fleetdrill"],
                     default=None)
     args = ap.parse_args(argv)
 
@@ -987,6 +1036,8 @@ def main(argv=None) -> int:
         gates["retunegate"] = run_retunegate(write_report=args.ci)
     if args.only in (None, "fleetgate"):
         gates["fleetgate"] = run_fleetgate(write_report=args.ci)
+    if args.only in (None, "fleetdrill"):
+        gates["fleetdrill"] = run_fleetdrill(write_report=args.ci)
     if args.only in (None, "benchtrend"):
         gates["benchtrend"] = run_benchtrend()
 
