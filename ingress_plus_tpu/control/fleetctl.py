@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 from pathlib import Path
@@ -102,7 +103,7 @@ class FleetNode:
     """fleetctl's handle on one serve node (in-process flavor): its
     batcher (for direct LKG convergence) and its RolloutController
     (for staged rollouts).  ``HttpFleetNode`` is the wire twin — same
-    six methods over /configuration/ruleset + /rollout."""
+    surface over /configuration/ruleset + /rollout."""
 
     def __init__(self, name: str, batcher, rollout: RolloutController):
         self.name = name
@@ -124,6 +125,9 @@ class FleetNode:
 
     def state(self) -> str:
         return self.rollout.state
+
+    def candidate_version(self) -> str:
+        return self.rollout.status().get("candidate") or ""
 
     def failure_reason(self) -> str:
         ro = self.rollout
@@ -173,6 +177,7 @@ class HttpFleetNode:
 
     def _call(self, method: str, path: str,
               payload: Optional[dict] = None) -> dict:
+        import urllib.error
         import urllib.request
 
         req = urllib.request.Request(
@@ -190,6 +195,13 @@ class HttpFleetNode:
                 return json.loads(e.read() or b"{}")
             except ValueError:
                 return {"error": "http %d" % e.code}
+        except (urllib.error.URLError, OSError) as e:
+            # an unreachable node must surface as a node-level failure
+            # (converge_failed / unreachable), never an exception — a
+            # dead node is precisely when fleet_rollback runs, and it
+            # promises "partial failures are reported, not raised"
+            return {"error":
+                    "unreachable: %s" % (getattr(e, "reason", None) or e)}
 
     @property
     def serving_version(self) -> str:
@@ -217,11 +229,18 @@ class HttpFleetNode:
         pass  # the remote batcher ticks its own rollout
 
     def state(self) -> str:
-        return str(self._call("GET", "/rollout").get("state", "idle"))
+        st = self._call("GET", "/rollout")
+        if "state" not in st and st.get("error"):
+            return "unreachable"
+        return str(st.get("state", "idle"))
+
+    def candidate_version(self) -> str:
+        return str(self._call("GET", "/rollout").get("candidate") or "")
 
     def failure_reason(self) -> str:
         st = self._call("GET", "/rollout")
-        return str(st.get("rollback_reason") or st.get("state", ""))
+        return str(st.get("rollback_reason") or st.get("error")
+                   or st.get("state", ""))
 
     def abort(self, reason: str) -> bool:
         return bool(self._call("POST", "/rollout",
@@ -355,8 +374,7 @@ class FleetController:
             self.last_admission = {"ok": False, **e.report}
             self._write_journal()
             return self.last_admission
-        self.candidate_version = \
-            self.nodes[0].rollout.status()["candidate"] or ""
+        self.candidate_version = self.nodes[0].candidate_version()
         self.last_admission = {"ok": True, **report}
         with self._lock:
             self.state = FLEET_CANARY
@@ -386,9 +404,17 @@ class FleetController:
                 return "%s:%s" % (kind, node)
             if kind == "generation_skew":
                 # mid-wave incumbent/candidate split is the PLAN; a
-                # generation outside that pair is an alien pack
-                detail = f.get("detail", "")
-                if not any("%r" % v in detail for v in expected if v):
+                # generation outside that pair is an alien pack.  Only
+                # the node's OWN generation decides (the detail string
+                # also names the fleet majority, which almost always IS
+                # incumbent or candidate — matching against it would
+                # never flag the alien node)
+                gen = f.get("generation")
+                if gen is None:  # older observers: first %r in detail
+                    m = re.match(r"serving pack generation '([^']*)'",
+                                 f.get("detail", ""))
+                    gen = m.group(1) if m else None
+                if gen is not None and gen not in expected:
                     self._tripwire_seen.add(key)
                     return "alien_generation:%s" % node
         return None

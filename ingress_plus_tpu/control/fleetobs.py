@@ -555,6 +555,7 @@ class FleetObserver:
             for name in sorted(versions[ver]):
                 out.append({
                     "kind": "generation_skew", "node": name,
+                    "generation": ver,
                     "detail": "serving pack generation %r; fleet "
                               "majority is %r" % (ver, majority)})
         return out

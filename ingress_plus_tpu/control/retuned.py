@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 from pathlib import Path
 from typing import Dict, List, Optional
@@ -95,6 +96,10 @@ class RetuneDaemon:
 
     # ------------------------------------------------------- journal
 
+    #: no journal record serializes under this many bytes — lets a
+    #: stat() rule out truncation without reading the ledger
+    _MIN_ENTRY_BYTES = 32
+
     def _journal(self, rec: dict) -> None:
         """Append one cycle record; rewrite keeping the newest half
         when the ledger exceeds its bound (bounded disk, ISSUE 19)."""
@@ -102,14 +107,42 @@ class RetuneDaemon:
         try:
             with self.journal_path.open("a") as f:
                 f.write(json.dumps(rec) + "\n")
+            self._maybe_truncate()
+        except OSError:
+            pass  # the ledger is observability, not a serving dependency
+
+    def _maybe_truncate(self) -> None:
+        """Halve the ledger once it exceeds its entry bound.  The
+        read-truncate-replace pass runs only when a cheap size check
+        says it is due, and under an O_EXCL lock — a second writer
+        (``--once`` beside the daemon, same shared lkg_dir) appending
+        mid-rewrite must not have its record silently replaced away."""
+        if (self.journal_path.stat().st_size
+                <= self.max_journal_entries * self._MIN_ENTRY_BYTES):
+            return
+        lock = self.journal_path.with_suffix(".lock")
+        try:
+            os.close(os.open(str(lock),
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+        except OSError:
+            try:  # a writer that crashed mid-pass must not leak the
+                if time.time() - lock.stat().st_mtime > 60.0:  # lock
+                    lock.unlink()
+            except OSError:
+                pass
+            return  # another writer is truncating; ours lands next pass
+        try:
             lines = self.journal_path.read_text().splitlines()
             if len(lines) > self.max_journal_entries:
                 keep = lines[-self.max_journal_entries // 2:]
                 tmp = self.journal_path.with_suffix(".tmp")
                 tmp.write_text("\n".join(keep) + "\n")
                 tmp.replace(self.journal_path)
-        except OSError:
-            pass  # the ledger is observability, not a serving dependency
+        finally:
+            try:
+                lock.unlink()
+            except OSError:
+                pass
 
     def journal_tail(self, n: int = 16) -> List[dict]:
         try:

@@ -303,7 +303,7 @@ class _ClientConn:
         self.front.requests_total += 1
         link = await self.acquire(set())
         if link is None:
-            self.front.all_down_served += 1
+            self.front.note_unrouted()
             await self.synth_fail_open(req_id)
             return
         magic = REQ_MAGIC if kind == "req" else RSCAN_MAGIC
@@ -345,7 +345,7 @@ class _ClientConn:
         if link is None or link.closed:
             link = await self.acquire(set())
             if link is None:
-                self.front.all_down_served += 1
+                self.front.note_unrouted()
                 await self.synth_fail_open(req_id)
                 return
             self.ws_owner[stream_id] = link
@@ -400,6 +400,13 @@ class FrontLoop:
                 self.shed_capacity += 1   # every ready node at its cap
             return None
         return min(ready, key=lambda n: n.inflight)
+
+    def note_unrouted(self) -> None:
+        """No node could take a request: it is a total outage only when
+        nothing is UP — pure capacity shedding (every node UP but at
+        its cap) is already counted by pick() as shed_capacity."""
+        if not any(n.state == UP for n in self.nodes):
+            self.all_down_served += 1
 
     def eject(self, node: BackendNode, reason: str) -> None:
         if node.state == DOWN:

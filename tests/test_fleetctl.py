@@ -12,7 +12,9 @@ from ingress_plus_tpu.control.fleetctl import (
     FLEET_CANARY,
     FLEET_IDLE,
     FLEET_LIVE,
+    FLEET_LKG_POINTER,
     FleetController,
+    HttpFleetNode,
     build_drill_fleet,
     load_fleet_lkg,
 )
@@ -86,6 +88,75 @@ def test_fleet_recover_converges_mid_wave_crash(tmp_path):
         assert reborn.recover()["recovered"] is False
     finally:
         _teardown(harnesses, front)
+
+
+# ---------------------------------------------------- skew tripwires
+
+class _StubNode:
+    def __init__(self, name):
+        self.name = name
+
+
+class _StubObs:
+    def __init__(self, findings):
+        self.findings = findings
+
+    def healthz(self):
+        return {"skew_findings": self.findings}
+
+
+def test_alien_generation_tripwire(tmp_path):
+    """A node serving a generation that is neither incumbent nor
+    candidate trips the wave even when the fleet majority IS the
+    incumbent — the finding's detail names both generations, so only
+    the node's OWN generation may decide."""
+    def fleet_with(findings):
+        f = FleetController([_StubNode("n0"), _StubNode("n1")],
+                            tmp_path, observer=_StubObs(findings))
+        f.incumbent_version, f.candidate_version = "inc-1", "cand-2"
+        return f
+
+    def skew(node, gen, structured=True):
+        f = {"kind": "generation_skew", "node": node,
+             "detail": "serving pack generation %r; fleet majority "
+                       "is %r" % (gen, "inc-1")}
+        if structured:
+            f["generation"] = gen
+        return f
+
+    # majority == incumbent: the alien node must still be flagged
+    assert fleet_with([skew("n1", "evil-9")])._check_tripwires() \
+        == "alien_generation:n1"
+    # detail-only findings (older observers): parse the node's own %r
+    assert fleet_with([skew("n1", "evil-9", structured=False)]) \
+        ._check_tripwires() == "alien_generation:n1"
+    # mid-wave incumbent/candidate split is the plan, not a tripwire
+    assert fleet_with([skew("n0", "cand-2")])._check_tripwires() is None
+    assert fleet_with([skew("n1", "inc-1")])._check_tripwires() is None
+
+
+# --------------------------------------------- unreachable HTTP nodes
+
+def test_http_node_unreachable_is_reported_not_raised(tmp_path):
+    """A dead node is exactly when the fleet layer acts on it: every
+    HttpFleetNode surface degrades to a structured answer, and
+    fleet_rollback reports converge_failed instead of aborting
+    mid-iteration with URLError."""
+    node = HttpFleetNode("nx", "127.0.0.1:1", timeout_s=0.5)
+    assert node.serving_version == ""
+    assert node.state() == "unreachable"
+    assert node.abort("drill") is False
+    assert node.converge_to(None, artifact=tmp_path / "x.pack") is False
+    assert "unreachable" in node.failure_reason()
+    assert node.status_brief()["rollout_state"] == "unreachable"
+
+    (tmp_path / FLEET_LKG_POINTER).write_text(json.dumps(
+        {"artifact": "nope.pack", "version": "v1", "acks": {}}))
+    fleet = FleetController([node], tmp_path)
+    rep = fleet.fleet_rollback("node_dead_drill")
+    assert rep["nodes"] == {"nx": "converge_failed"}
+    assert json.loads(fleet.journal_path.read_text())["state"] \
+        == "rolled_back"
 
 
 # ------------------------------------------------ retune daemon ladder
